@@ -1,0 +1,43 @@
+"""Test harness: force an 8-device virtual CPU platform *before* jax import.
+
+Multi-chip behavior (shard_map reducers, hierarchical meshes) is validated on
+virtual devices exactly as SURVEY.md §4 prescribes for the rebuild; real-TPU
+runs happen via bench.py / the driver's dryrun.
+"""
+
+import os
+
+# Force, don't setdefault: the session env pins JAX_PLATFORMS to the real
+# TPU tunnel; the test suite always runs on the virtual 8-device CPU mesh.
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# jax may already have been imported by a pytest plugin (jaxtyping), which
+# captured JAX_PLATFORMS before we overrode it — force the config explicitly.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_threefry_partitionable", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_cgx_env(monkeypatch):
+    """Isolate CGX_* env mutations per test (the config layer re-reads env on
+    every call, matching reference ResetParamsFromEnv semantics)."""
+    for key in list(os.environ):
+        if key.startswith("CGX_"):
+            monkeypatch.delenv(key, raising=False)
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clear_registry():
+    import torch_cgx_tpu
+
+    torch_cgx_tpu.clear_registry()
+    yield
+    torch_cgx_tpu.clear_registry()

@@ -1,0 +1,153 @@
+"""Codec unit tests — the layer the reference never unit-tested (SURVEY.md §4).
+
+Oracles transplanted from the reference integration suite
+(/root/reference/test/test_cgx.py):
+* constant buckets quantize bit-exactly (test_cgx.py:69-78),
+* varying data obeys the per-bucket quantization-error envelope
+  unit/2 = (max-min)/(2^bits-1)/2 per value (test_cgx.py:91-93 analogue),
+plus packing roundtrip/density checks the reference lacks.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_cgx_tpu.ops import codec
+
+
+@pytest.mark.parametrize("bits", [1, 2, 3, 4, 5, 6, 7, 8])
+def test_pack_unpack_roundtrip(bits):
+    rng = np.random.default_rng(bits)
+    m = 1000  # deliberately not a multiple of 32
+    lvl = rng.integers(0, 1 << bits, size=m).astype(np.uint32)
+    packed = codec.pack_levels(jnp.asarray(lvl), bits)
+    assert packed.dtype == jnp.uint32
+    assert packed.shape[0] == codec.packed_words(m, bits)
+    out = codec.unpack_levels(packed, bits, m)
+    np.testing.assert_array_equal(np.asarray(out), lvl)
+
+
+def test_packing_density_matches_reference():
+    # For 32-aligned n, bit-plane words = exactly n*bits/8 bytes — the same
+    # payload density as the reference byte packing (compressor.cc:401-419).
+    for bits in range(1, 9):
+        n = 4096
+        assert codec.packed_words(n, bits) * 4 == n * bits // 8
+        ours = codec.wire_bytes(n, bits, 512, 4)
+        ref = codec.reference_wire_bytes(n, bits, 512, 4)
+        assert ours <= ref + 8
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("bits", [2, 4, 8])
+@pytest.mark.parametrize("size", [1, 100, 512, 10000])
+def test_constant_tensor_exact(dtype, bits, size):
+    # Constant buckets: max == min -> unit = 0 -> level 0 -> decode == min.
+    x = jnp.full((size,), 3.0, dtype=dtype)
+    q = codec.quantize(x, bits, 512)
+    y = codec.dequantize(q)
+    assert y.dtype == dtype
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+@pytest.mark.parametrize("bits", [1, 2, 3, 4, 5, 6, 7, 8])
+@pytest.mark.parametrize("bucket_size", [64, 512, 2048])
+@pytest.mark.parametrize("size", [128, 50_000])
+def test_error_envelope(bits, bucket_size, size):
+    # Deterministic rounding error is at most unit/2 per value (+ float eps).
+    x = jnp.linspace(-1.0, 1.0, size, dtype=jnp.float32)
+    q = codec.quantize(x, bits, bucket_size)
+    y = codec.dequantize(q)
+    eff_bucket = min(bucket_size, size)
+    step = 2.0 / (size - 1)
+    unit = (eff_bucket - 1) * step / ((1 << bits) - 1)
+    err = np.max(np.abs(np.asarray(y) - np.asarray(x)))
+    assert err <= unit / 2 + 1e-5, (err, unit)
+
+
+def test_nonaligned_sizes_roundtrip_bounds():
+    # Sizes that are not multiples of bucket_size or 32.
+    for size in [1, 2, 31, 33, 63, 513, 517, 1025]:
+        x = jnp.asarray(np.random.default_rng(size).normal(size=size), jnp.float32)
+        q = codec.quantize(x, 4, 64)
+        y = np.asarray(codec.dequantize(q))
+        xb = np.asarray(x)
+        # every decoded value within the bucket range
+        assert y.min() >= xb.min() - 1e-6
+        assert y.max() <= xb.max() + 1e-6
+
+
+def test_stochastic_rounding_unbiased():
+    key = jax.random.PRNGKey(0)
+    x = jnp.full((512,), 0.3, dtype=jnp.float32)
+    # Put one 0 and one 1 in the bucket so unit > 0 and 0.3 is between levels.
+    x = x.at[0].set(0.0).at[1].set(1.0)
+    reps = 200
+    keys = jax.random.split(key, reps)
+
+    def roundtrip(k):
+        q = codec.quantize(x, 1, 512, stochastic=True, key=k)
+        return codec.dequantize(q)
+
+    ys = jax.vmap(roundtrip)(keys)
+    mean = np.asarray(ys).mean(axis=0)
+    # E[decode] == x for stochastic rounding; tolerance ~ 3*sigma/sqrt(reps)
+    np.testing.assert_allclose(mean[2:], 0.3, atol=0.12)
+
+
+def test_stochastic_requires_key():
+    x = jnp.ones((32,), jnp.float32)
+    with pytest.raises(ValueError):
+        codec.quantize(x, 4, 32, stochastic=True)
+
+
+def test_dequantize_add_fuses_accumulation():
+    x = jnp.linspace(0, 1, 256, dtype=jnp.float32)
+    acc = jnp.full((256,), 10.0, jnp.float32)
+    q = codec.quantize(x, 8, 64)
+    y = codec.dequantize(q)
+    y_add = codec.dequantize(q, add_to=acc)
+    np.testing.assert_allclose(np.asarray(y_add), np.asarray(y) + 10.0, rtol=1e-6)
+
+
+def test_skip_incomplete_buckets_residual_exact():
+    size = 512 + 37  # 37-element partial bucket carried raw
+    x = jnp.asarray(np.random.default_rng(0).normal(size=size), jnp.float32)
+    q = codec.quantize(x, 2, 512, skip_incomplete_buckets=True)
+    assert q.residual.shape[0] == 37
+    y = np.asarray(codec.dequantize(q))
+    np.testing.assert_array_equal(y[512:], np.asarray(x)[512:])  # tail exact
+
+
+def test_dummy_codec_identity():
+    x = jnp.asarray(np.random.default_rng(1).normal(size=333), jnp.float32)
+    q = codec.quantize_dummy(x)
+    y = codec.dequantize_dummy(q)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+
+
+def test_quantize_jit_compatible():
+    x = jnp.linspace(-1, 1, 2048, dtype=jnp.float32)
+
+    @jax.jit
+    def roundtrip(x):
+        q = codec.quantize(x, 4, 512)
+        return codec.dequantize(q)
+
+    y = roundtrip(x)
+    assert y.shape == x.shape
+
+
+def test_bf16_error_envelope():
+    size, bits, bucket = 4096, 4, 512
+    x = jnp.linspace(-1.0, 1.0, size, dtype=jnp.bfloat16)
+    q = codec.quantize(x, bits, bucket)
+    y = codec.dequantize(q)
+    assert y.dtype == jnp.bfloat16
+    xf = np.asarray(x, np.float32)
+    step = 2.0 / (size - 1)
+    unit = (bucket - 1) * step / ((1 << bits) - 1)
+    # bf16 meta adds ~2^-8 relative slop on unit*level (level <= 15 here).
+    err = np.max(np.abs(np.asarray(y, np.float32) - xf))
+    assert err <= unit / 2 + 0.02, (err, unit)

@@ -1,0 +1,95 @@
+"""Config-system tests: env surface, per-layer registries, zero-backfill."""
+
+import pytest
+
+import torch_cgx_tpu
+from torch_cgx_tpu import config as cfg
+
+
+def test_defaults_match_reference():
+    c = cfg.default_compression_config()
+    assert c.bits == 32 and c.bucket_size == 512
+    assert not c.enabled
+    assert cfg.minimal_size() == 16
+    assert cfg.fusion_threshold_elems(4) == 64 * 1024 * 1024 // 4
+
+
+def test_env_reread_per_call(monkeypatch):
+    monkeypatch.setenv(cfg.COMPRESSION_QUANTIZATION_BITS, "4")
+    assert cfg.default_compression_config().bits == 4
+    monkeypatch.setenv(cfg.COMPRESSION_QUANTIZATION_BITS, "2")
+    assert cfg.default_compression_config().bits == 2  # ResetParamsFromEnv
+
+
+def test_set_bits_without_register():
+    # Regression: the setters must work on layers never registered.
+    torch_cgx_tpu.set_quantization_bits((0, 0), 4)
+    assert cfg.get_layer_config((0, 0)).bits == 4
+    torch_cgx_tpu.set_quantization_bucket_size((1, 2), 128)
+    got = cfg.get_layer_config((1, 2))
+    assert got.bucket_size == 128
+    assert got.bits == 32  # back-filled from env default
+
+
+def test_register_layer_zero_inherits_env(monkeypatch):
+    # Regression: zeros stored by register_layer must inherit the env default
+    # at lookup time, not be pinned to 32 at registration time.
+    torch_cgx_tpu.register_layer(0, 0, numel=1000)  # bits=0, bucket=0
+    monkeypatch.setenv(cfg.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cfg.COMPRESSION_BUCKET_SIZE, "256")
+    got = cfg.get_layer_config((0, 0))
+    assert got.bits == 4 and got.bucket_size == 256
+
+
+def test_register_layer_sizes_and_order():
+    torch_cgx_tpu.register_layer(0, 0, numel=10, bits=8)
+    torch_cgx_tpu.register_layer(0, 1, numel=20, bits=2, bucket_size=64)
+    assert cfg.registered_layer_sizes(0) == [10, 20]
+    assert cfg.get_layer_config((0, 1)).bits == 2
+    with pytest.raises(ValueError):
+        torch_cgx_tpu.register_layer(0, 5, numel=1)  # out of order
+
+
+def test_reduction_env_parsing(monkeypatch):
+    monkeypatch.setenv(cfg.INNER_REDUCTION_TYPE, "Ring")
+    monkeypatch.setenv(cfg.CROSS_REDUCTION_TYPE, "SRA")
+    t = cfg.topology_from_env()
+    assert t.intra_reduction == cfg.REDUCTION_RING
+    assert t.cross_reduction == cfg.REDUCTION_SRA
+    monkeypatch.setenv(cfg.INNER_REDUCTION_TYPE, "bogus")
+    with pytest.raises(ValueError):
+        cfg.topology_from_env()
+
+
+def test_alltoall_debug_override(monkeypatch):
+    monkeypatch.setenv(cfg.DEBUG_ALL_TO_ALL_REDUCTION, "1")
+    t = cfg.topology_from_env()
+    assert t.intra_reduction == cfg.REDUCTION_ALLTOALL
+    assert t.cross_reduction == cfg.REDUCTION_ALLTOALL
+
+
+def test_intra_flags(monkeypatch):
+    t = cfg.topology_from_env()
+    assert t.intra_broadcast and t.intra_compress  # reference defaults on
+    monkeypatch.setenv(cfg.INTRA_BROADCAST, "0")
+    monkeypatch.setenv(cfg.INTRA_COMPRESS, "false")
+    t = cfg.topology_from_env()
+    assert not t.intra_broadcast and not t.intra_compress
+
+
+def test_pattern_registry(monkeypatch):
+    monkeypatch.setenv(cfg.COMPRESSION_QUANTIZATION_BITS, "8")
+    torch_cgx_tpu.set_layer_pattern_config(
+        r"attn.*kernel", cfg.CompressionConfig(bits=2, bucket_size=0)
+    )
+    got = cfg.resolve_pattern_config("layers.0.attn.q.kernel")
+    assert got.bits == 2
+    assert got.bucket_size == 512  # zero back-filled from default
+    assert cfg.resolve_pattern_config("layers.0.mlp.kernel") is None
+
+
+def test_negative_bits_rejected():
+    with pytest.raises(ValueError):
+        cfg.CompressionConfig(bits=-1)
+    with pytest.raises(ValueError):
+        cfg.CompressionConfig(bucket_size=-5)

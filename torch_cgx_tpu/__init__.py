@@ -1,0 +1,38 @@
+"""torch_cgx_tpu — TPU-native gradient-compression framework.
+
+A from-scratch JAX/XLA/Pallas re-design of the capabilities of
+IST-DASLab/torch_cgx (reference mounted read-only at /root/reference):
+bucketwise max-min 1-8 bit gradient quantization, quantized
+Scatter-Reduce-AllGather and Ring allreduce over hierarchical ICI x DCN
+device meshes, per-layer compression configs, tensor fusion, a JAX-native
+data-parallel front end, and a pure-Python torch.distributed backend.
+"""
+
+__version__ = "0.1.0"
+
+from . import config
+from .config import (
+    CompressionConfig,
+    TopologyConfig,
+    clear_registry,
+    register_layer,
+    set_layer_pattern_config,
+    set_quantization_bits,
+    set_quantization_bucket_size,
+)
+from .ops import QTensor, dequantize, quantize
+
+__all__ = [
+    "config",
+    "CompressionConfig",
+    "TopologyConfig",
+    "clear_registry",
+    "register_layer",
+    "set_layer_pattern_config",
+    "set_quantization_bits",
+    "set_quantization_bucket_size",
+    "QTensor",
+    "quantize",
+    "dequantize",
+    "__version__",
+]

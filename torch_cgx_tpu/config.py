@@ -1,0 +1,305 @@
+"""Configuration system for the TPU-native CGX rebuild.
+
+Reproduces the reference's three-tier config surface
+(/root/reference/src/common/common.h:24-41, compressor.h:34-43,
+compressor.cc:39-60 — see SURVEY.md §5.6):
+
+1. ``CGX_*`` environment variables, re-read on every allreduce call
+   (the reference re-reads env per DDP bucket,
+   mpi_allreduce_operations.cc:238; tests mutate env between calls).
+2. A per-layer registry keyed by ``(bucket_idx, layer_idx)`` — numeric, for
+   torch-bridge parity with ``torch_cgx.register_layer``
+   (ProcessGroupCGX.cc:837-857) — plus a JAX-idiomatic name-pattern registry
+   for pytree leaves.
+3. Static defaults (compile-time flags in the reference become plain
+   defaults here).
+
+Everything that influences traced shapes (bits, bucket_size, reduction
+algorithm, world sizes) is hashable/static so jit caches per config.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, Optional, Tuple
+
+from .utils import env as _env
+
+# ---------------------------------------------------------------------------
+# Env var names — parity with reference src/common/common.h:24-41.
+# ---------------------------------------------------------------------------
+
+COMPRESSION_QUANTIZATION_BITS = "CGX_COMPRESSION_QUANTIZATION_BITS"
+COMPRESSION_BUCKET_SIZE = "CGX_COMPRESSION_BUCKET_SIZE"
+COMPRESSION_MINIMAL_SIZE = "CGX_COMPRESSION_MINIMAL_SIZE"
+COMPRESSION_SKIP_INCOMPLETE_BUCKETS = "CGX_COMPRESSION_SKIP_INCOMPLETE_BUCKETS"
+COMPRESSION_FAKE_RATIO = "CGX_COMPRESSION_FAKE_RATIO"
+FUSION_BUFFER_SIZE_MB = "CGX_FUSION_BUFFER_SIZE_MB"
+INNER_COMMUNICATOR_TYPE = "CGX_INNER_COMMUNICATOR_TYPE"
+CROSS_COMMUNICATOR_TYPE = "CGX_CROSS_COMMUNICATOR_TYPE"
+INNER_REDUCTION_TYPE = "CGX_INNER_REDUCTION_TYPE"
+CROSS_REDUCTION_TYPE = "CGX_CROSS_REDUCTION_TYPE"
+INTRA_BROADCAST = "CGX_INTRA_BROADCAST"
+INTRA_COMPRESS = "CGX_INTRA_COMPRESS"
+REMOTE_BUF_COMPRESSION = "CGX_REMOTE_BUF_COMPRESSION"
+DEBUG_DUMMY_COMPRESSION = "CGX_DEBUG_DUMMY_COMPRESSION"
+DEBUG_ALL_TO_ALL_REDUCTION = "CGX_DEBUG_ALL_TO_ALL_REDUCTION"
+# TPU-only additions (no reference analogue):
+STOCHASTIC_ROUNDING = "CGX_STOCHASTIC_ROUNDING"  # QSGD_DETERMENISTIC inverse
+CODEC_IMPL = "CGX_CODEC_IMPL"  # "xla" | "pallas" | "auto"
+SEED = "CGX_SEED"
+LOG_LEVEL = "CGX_LOG_LEVEL"
+
+# Defaults — reference values (common.h:24-41, compressor.h:32,
+# mpi_allreduce_operations.h:32).
+DEFAULT_BITS = 32  # 32 == compression off
+DEFAULT_BUCKET_SIZE = 512
+DEFAULT_MINIMAL_SIZE = 16  # MIN_LAYER_SIZE: tiny tensors bypass compression
+DEFAULT_FUSION_MB = 64
+MIN_FUSION_SIZE = 2048
+MAX_BITS = 8  # compression active iff bits <= 8
+
+# Reduction algorithms (utils.h ReductionType; SRA default intra, Ring default
+# cross — mpi_allreduce_operations.cc:74-115).
+REDUCTION_SRA = "SRA"
+REDUCTION_RING = "RING"
+REDUCTION_ALLTOALL = "ALLTOALL"  # CGX_DEBUG_ALL_TO_ALL_REDUCTION analogue
+REDUCTION_PSUM = "PSUM"  # XLA-native fallback (uncompressed)
+
+_VALID_REDUCTIONS = (REDUCTION_SRA, REDUCTION_RING, REDUCTION_ALLTOALL, REDUCTION_PSUM)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompressionConfig:
+    """Per-layer compression parameters.
+
+    Mirror of the reference ``CompressionLayerConfig`` (compressor.h:34-43):
+    ``bits`` (1-8 active, >8 = off), quantization ``bucket_size``, and the
+    skip-incomplete-buckets toggle (residual tail sent raw).
+    """
+
+    bits: int = DEFAULT_BITS
+    bucket_size: int = DEFAULT_BUCKET_SIZE
+    skip_incomplete_buckets: bool = False
+    stochastic: bool = False
+
+    def __post_init__(self):
+        # 0 is the "unset — inherit env default at lookup" sentinel, matching
+        # the reference's zero-backfill (compressor.cc:47-60).
+        if self.bits < 0:
+            raise ValueError(f"bits must be >= 0, got {self.bits}")
+        if self.bucket_size < 0:
+            raise ValueError(f"bucket_size must be >= 0, got {self.bucket_size}")
+
+    @property
+    def enabled(self) -> bool:
+        """Compression eligibility on bits alone (compressor.cc:421-425);
+        0 = unset."""
+        return 1 <= self.bits <= MAX_BITS
+
+    def merged_with_default(self, default: "CompressionConfig") -> "CompressionConfig":
+        """Back-fill unset (zero/None) fields from the default config.
+
+        The reference back-fills zeros from env defaults at lookup time
+        (compressor.cc:47-60).
+        """
+        return CompressionConfig(
+            bits=self.bits if self.bits else default.bits,
+            bucket_size=self.bucket_size if self.bucket_size else default.bucket_size,
+            skip_incomplete_buckets=self.skip_incomplete_buckets
+            or default.skip_incomplete_buckets,
+            stochastic=self.stochastic or default.stochastic,
+        )
+
+
+def default_compression_config() -> CompressionConfig:
+    """Read the env-default config (re-read on every call, like
+    ``ResetParamsFromEnv`` compressor.cc:258-263)."""
+    return CompressionConfig(
+        bits=_env.get_int_env_or_default(COMPRESSION_QUANTIZATION_BITS, DEFAULT_BITS),
+        bucket_size=_env.get_int_env_or_default(
+            COMPRESSION_BUCKET_SIZE, DEFAULT_BUCKET_SIZE
+        ),
+        skip_incomplete_buckets=_env.get_bool_env_or_default(
+            COMPRESSION_SKIP_INCOMPLETE_BUCKETS, False
+        ),
+        stochastic=_env.get_bool_env_or_default(STOCHASTIC_ROUNDING, False),
+    )
+
+
+def minimal_size() -> int:
+    return _env.get_int_env_or_default(COMPRESSION_MINIMAL_SIZE, DEFAULT_MINIMAL_SIZE)
+
+
+def fusion_threshold_elems(element_size: int = 4) -> int:
+    """Fusion slice capacity in elements (reference: 64 MB slices,
+    mpi_allreduce_operations.cc:128-133, common.h:40)."""
+    mb = _env.get_int_env_or_default(FUSION_BUFFER_SIZE_MB, DEFAULT_FUSION_MB)
+    return max(MIN_FUSION_SIZE, (mb * 1024 * 1024) // element_size)
+
+
+def _reduction_from_env(name: str, default: str) -> str:
+    raw = _env.get_str_env_or_default(name, default).upper()
+    if raw in ("SRA", "SCATTER_REDUCE_ALLGATHER"):
+        return REDUCTION_SRA
+    if raw == "RING":
+        return REDUCTION_RING
+    if raw in ("ALLTOALL", "ALL_TO_ALL"):
+        return REDUCTION_ALLTOALL
+    if raw == "PSUM":
+        return REDUCTION_PSUM
+    raise ValueError(f"{name}={raw!r}: expected one of {_VALID_REDUCTIONS}")
+
+
+@dataclasses.dataclass(frozen=True)
+class TopologyConfig:
+    """Hierarchical reduction strategy over the (cross, intra) mesh axes.
+
+    TPU mapping of the reference's two-level MPI topology
+    (mpi_context.cc:25-35, mpi_allreduce_operations.cc:70-115,139-185):
+    the intra/"local" level rides the ICI mesh axis, the cross level the DCN
+    axis. Communicator *types* (SHM/MPI/NCCL) are accepted for CLI/env parity
+    but are advisory on TPU — the transport is always XLA collectives.
+    """
+
+    intra_reduction: str = REDUCTION_SRA
+    cross_reduction: str = REDUCTION_RING
+    intra_broadcast: bool = True  # CGX_INTRA_BROADCAST default on (.cc:134)
+    intra_compress: bool = True  # CGX_INTRA_COMPRESS default on (.cc:135)
+    cross_compress: bool = True
+
+    def __post_init__(self):
+        for r in (self.intra_reduction, self.cross_reduction):
+            if r not in _VALID_REDUCTIONS:
+                raise ValueError(f"unknown reduction {r!r}")
+
+
+def topology_from_env() -> TopologyConfig:
+    if _env.get_bool_env_or_default(DEBUG_ALL_TO_ALL_REDUCTION, False):
+        intra = cross = REDUCTION_ALLTOALL
+    else:
+        intra = _reduction_from_env(INNER_REDUCTION_TYPE, REDUCTION_SRA)
+        cross = _reduction_from_env(CROSS_REDUCTION_TYPE, REDUCTION_RING)
+    return TopologyConfig(
+        intra_reduction=intra,
+        cross_reduction=cross,
+        intra_broadcast=_env.get_bool_env_or_default(INTRA_BROADCAST, True),
+        intra_compress=_env.get_bool_env_or_default(INTRA_COMPRESS, True),
+    )
+
+
+def dummy_compression() -> bool:
+    """CGX_DEBUG_DUMMY_COMPRESSION: pass-through codec for debugging
+    (mpi_allreduce_operations.cc:46-54)."""
+    return _env.get_bool_env_or_default(DEBUG_DUMMY_COMPRESSION, False)
+
+
+def codec_impl() -> str:
+    """Which codec implementation to use: "xla" (pure lax ops), "pallas"
+    (fused TPU kernels), or "auto" (pallas on TPU, xla elsewhere)."""
+    impl = _env.get_str_env_or_default(CODEC_IMPL, "auto").lower()
+    if impl not in ("xla", "pallas", "auto"):
+        raise ValueError(f"{CODEC_IMPL} must be xla|pallas|auto, got {impl!r}")
+    return impl
+
+
+def global_seed() -> int:
+    return _env.get_int_env_or_default(SEED, 0)
+
+
+# ---------------------------------------------------------------------------
+# Per-layer registries.
+# ---------------------------------------------------------------------------
+
+LayerId = Tuple[int, int]  # (bucket_idx, layer_idx) — reference LayerId
+
+# Numeric registry: exact parity with the reference's static
+# ``layers_configs`` map (compressor.h:93-107) + ``layers_sizes_``
+# (mpi_allreduce_operations.h:37-49). Used by the torch bridge.
+_layer_configs: Dict[LayerId, CompressionConfig] = {}
+_layer_sizes: Dict[int, list] = {}  # bucket_idx -> [numel per layer]
+
+# Name-pattern registry: JAX-idiomatic — regex over pytree leaf paths.
+_pattern_configs: Dict[str, CompressionConfig] = {}
+
+
+def register_layer(
+    bucket_idx: int,
+    layer_idx: int,
+    numel: int,
+    bits: int = 0,
+    bucket_size: int = 0,
+) -> None:
+    """Parity API with ``torch_cgx.register_layer``
+    (ProcessGroupCGX.cc:837-846, mpi_allreduce_operations.h:37-49).
+
+    Zero bits/bucket_size mean "inherit env default at use time".
+    Note: the reference's ``set_quantization_bucket_size`` pybind export
+    mistakenly forwards to SetQBits (ProcessGroupCGX.cc:848-850,
+    SURVEY.md §8.1) — fixed here, not reproduced.
+    """
+    sizes = _layer_sizes.setdefault(bucket_idx, [])
+    if layer_idx == len(sizes):
+        sizes.append(numel)
+    elif layer_idx < len(sizes):
+        sizes[layer_idx] = numel
+    else:
+        raise ValueError(
+            f"layer_idx {layer_idx} out of order for bucket {bucket_idx} "
+            f"(have {len(sizes)} layers)"
+        )
+    # Zeros are stored as-is and back-filled from the env default at lookup
+    # time (get_layer_config), like the reference.
+    _layer_configs[(bucket_idx, layer_idx)] = CompressionConfig(
+        bits=bits, bucket_size=bucket_size
+    )
+
+
+def set_quantization_bits(layer_id: LayerId, bits: int) -> None:
+    cfg = _layer_configs.get(layer_id, CompressionConfig(bits=0, bucket_size=0))
+    _layer_configs[layer_id] = dataclasses.replace(cfg, bits=bits)
+
+
+def set_quantization_bucket_size(layer_id: LayerId, bucket_size: int) -> None:
+    cfg = _layer_configs.get(layer_id, CompressionConfig(bits=0, bucket_size=0))
+    _layer_configs[layer_id] = dataclasses.replace(cfg, bucket_size=bucket_size)
+
+
+def get_layer_config(layer_id: LayerId) -> CompressionConfig:
+    """Resolved config for a (bucket, layer): registered values with zeros
+    back-filled from the env default (compressor.cc:47-60)."""
+    default = default_compression_config()
+    cfg = _layer_configs.get(layer_id)
+    if cfg is None:
+        return default
+    return cfg.merged_with_default(default)
+
+
+def registered_layer_sizes(bucket_idx: int) -> Optional[list]:
+    return _layer_sizes.get(bucket_idx)
+
+
+def set_layer_pattern_config(pattern: str, config: CompressionConfig) -> None:
+    """JAX-native per-layer config: regex over parameter tree paths
+    (e.g. ``r".*kernel$"``). Later registrations win."""
+    re.compile(pattern)  # validate eagerly
+    _pattern_configs[pattern] = config
+
+
+def resolve_pattern_config(path: str) -> Optional[CompressionConfig]:
+    match = None
+    for pattern, cfg in _pattern_configs.items():
+        if re.search(pattern, path):
+            match = cfg
+    if match is None:
+        return None
+    return match.merged_with_default(default_compression_config())
+
+
+def clear_registry() -> None:
+    """Reset all per-layer registries (the reference keeps them in-process
+    statics that survive only until restart — SURVEY.md §5.4)."""
+    _layer_configs.clear()
+    _layer_sizes.clear()
+    _pattern_configs.clear()

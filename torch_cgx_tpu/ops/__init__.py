@@ -1,0 +1,29 @@
+from .codec import (
+    QTensor,
+    allreduce_error_bound,
+    dequantize,
+    dequantize_dummy,
+    num_buckets,
+    pack_levels,
+    packed_words,
+    quantize,
+    quantize_dummy,
+    reference_wire_bytes,
+    unpack_levels,
+    wire_bytes,
+)
+
+__all__ = [
+    "QTensor",
+    "allreduce_error_bound",
+    "dequantize",
+    "dequantize_dummy",
+    "num_buckets",
+    "pack_levels",
+    "packed_words",
+    "quantize",
+    "quantize_dummy",
+    "reference_wire_bytes",
+    "unpack_levels",
+    "wire_bytes",
+]

@@ -1,0 +1,341 @@
+"""Bucketwise max-min uniform quantization codec (the compression engine).
+
+TPU-native re-expression of the reference's compressor + CUDA kernels
+(/root/reference/src/common/compressor.cc:301-419,
+src/common/compression/cuda_compression_operations.cu:68-217 — see
+SURVEY.md §2.1). Same math, different packing layout:
+
+* **Quantize** (``MaxMinEncodeValue``, .cu:68-84): per bucket of
+  ``bucket_size`` values compute ``min``/``max``; ``unit = (max - min) /
+  (2^bits - 1)``; ``level = clamp(floor((x - min)/unit + r), 0, 2^bits-1)``
+  with ``r = 0.5`` (deterministic round-to-nearest, the reference's
+  ``QSGD_DETERMENISTIC`` mode, gpu_rand.h:52-58) or ``r ~ U[0,1)``
+  (stochastic QSGD rounding).
+* **Meta** (``find_meta``, .cu:98-153): two values per bucket —
+  ``unit`` and ``min`` — stored in the input dtype
+  (2 * num_buckets * elem_size wire bytes, compressor.cc:401-419).
+* **Packing**: the reference packs 8-value groups into ``bits`` bytes
+  (PACK_SIZE=8, .cu:155-217). TPUs have no byte-addressable scatter, so we
+  pack 32-value groups into ``bits`` uint32 words in a **bit-plane layout**
+  (word ``w`` of a group holds bit ``w`` of each of the 32 values) — the
+  same wire density (n*bits/8 bytes for 32-aligned n), fully vectorizable
+  on the VPU with shifts/ors, uniform for every bits in 1..8.
+* **fp16 → bfloat16**: TPU-native 16-bit float replaces the reference's
+  ``__half`` support; fp32 is identical.
+
+Two implementations share this module's math: the pure-``lax`` path here
+(compiled by XLA; also the oracle for tests) and fused Pallas kernels in
+``codec_pallas.py``. Constant buckets (max == min) encode to level 0 and
+decode to exactly ``min`` — this preserves the reference's bit-exactness
+oracle on constant tensors (test/test_cgx.py:69-78).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LANE_GROUP = 32  # values per packing group (uint32 analogue of PACK_SIZE=8)
+EPS = 1e-10  # reference gpu_def.h
+
+
+def num_buckets(n: int, bucket_size: int) -> int:
+    return -(-n // bucket_size)
+
+
+def packed_words(n: int, bits: int) -> int:
+    """uint32 words for n quantized values."""
+    return -(-n // LANE_GROUP) * bits
+
+
+def wire_bytes(n: int, bits: int, bucket_size: int, elem_size: int) -> int:
+    """Actual wire footprint of our layout: meta + bit-plane payload."""
+    return 2 * num_buckets(n, bucket_size) * elem_size + packed_words(n, bits) * 4
+
+
+def reference_wire_bytes(n: int, bits: int, bucket_size: int, elem_size: int) -> int:
+    """The reference's wire-size formula (compressor.cc:401-419): meta +
+    byte-packed payload rounded to 8-byte alignment."""
+    payload = -(-n * bits // 8)
+    payload = ((payload + 7) // 8) * 8
+    return 2 * num_buckets(n, bucket_size) * elem_size + payload
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class QTensor:
+    """Quantized wire tensor: packed bit-plane payload + per-bucket meta.
+
+    ``packed``: uint32[packed_words(numel_main, bits)]
+    ``meta``:   dtype[2, num_buckets] — row 0 = unit, row 1 = min
+    ``residual``: raw tail for skip_incomplete_buckets mode (possibly
+    length-0), carried uncompressed like the reference's residual memcpy
+    (compressor.cc:315-339).
+    Static fields make the pytree safely jit-traversable.
+    """
+
+    packed: jax.Array
+    meta: jax.Array
+    residual: jax.Array
+    numel: int
+    bits: int
+    bucket_size: int
+    dtype: np.dtype
+
+    def tree_flatten(self):
+        return (
+            (self.packed, self.meta, self.residual),
+            (self.numel, self.bits, self.bucket_size, self.dtype),
+        )
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        packed, meta, residual = children
+        numel, bits, bucket_size, dtype = aux
+        return cls(packed, meta, residual, numel, bits, bucket_size, dtype)
+
+    @property
+    def numel_main(self) -> int:
+        return self.numel - self.residual.shape[-1]
+
+    def wire_bytes(self) -> int:
+        return (
+            self.packed.size * 4
+            + self.meta.size * self.meta.dtype.itemsize
+            + self.residual.size * self.residual.dtype.itemsize
+        )
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane packing (replaces pack_value/unpack_value, .cu:155-217,411-472).
+# ---------------------------------------------------------------------------
+
+
+def pack_levels(levels: jax.Array, bits: int) -> jax.Array:
+    """Pack uint32 levels (< 2^bits) into bit-plane uint32 words.
+
+    levels: flat uint32[m] -> uint32[ceil(m/32) * bits], grouped as
+    ``bits`` consecutive words per 32-value group.
+    """
+    m = levels.shape[0]
+    groups = -(-m // LANE_GROUP) if m else 0
+    if m == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    padded = jnp.pad(levels, (0, groups * LANE_GROUP - m))
+    g = padded.reshape(groups, LANE_GROUP)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (1, LANE_GROUP), 1)
+    planes = []
+    for w in range(bits):
+        plane = (g >> np.uint32(w)) & np.uint32(1)
+        planes.append(jnp.sum(plane << lane, axis=1, dtype=jnp.uint32))
+    return jnp.stack(planes, axis=1).reshape(-1)
+
+
+def unpack_levels(words: jax.Array, bits: int, m: int) -> jax.Array:
+    """Inverse of :func:`pack_levels` -> uint32[m]."""
+    if m == 0:
+        return jnp.zeros((0,), jnp.uint32)
+    groups = -(-m // LANE_GROUP)
+    w2 = words.reshape(groups, bits)
+    lane = jax.lax.broadcasted_iota(jnp.uint32, (1, LANE_GROUP), 1)
+    lvl = jnp.zeros((groups, LANE_GROUP), jnp.uint32)
+    for w in range(bits):
+        plane = (w2[:, w : w + 1] >> lane) & np.uint32(1)
+        lvl = lvl | (plane << np.uint32(w))
+    return lvl.reshape(-1)[:m]
+
+
+# ---------------------------------------------------------------------------
+# Quantize / dequantize (XLA implementation; the test oracle).
+# ---------------------------------------------------------------------------
+
+
+def _split_residual(n: int, bucket_size: int, skip_incomplete: bool) -> Tuple[int, int]:
+    """(main_n, residual_n): residual = incomplete final bucket if skipped."""
+    rem = n % bucket_size
+    if skip_incomplete and rem:
+        return n - rem, rem
+    return n, 0
+
+
+def compute_meta(
+    xb: jax.Array, bits: int
+) -> Tuple[jax.Array, jax.Array]:
+    """Per-bucket (unit, min) in float32. xb: f32[nb, bucket_size]."""
+    bmax = jnp.max(xb, axis=1)
+    bmin = jnp.min(xb, axis=1)
+    unit = (bmax - bmin) / np.float32((1 << bits) - 1)
+    return unit, bmin
+
+
+def encode_levels(
+    xb: jax.Array,
+    unit: jax.Array,
+    bmin: jax.Array,
+    bits: int,
+    rand: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Levels in uint32[nb, bucket_size]. ``rand`` in [0,1) or None (0.5)."""
+    safe = jnp.where(unit > 0, unit, np.float32(1.0))
+    r = np.float32(0.5) if rand is None else rand
+    lvl = jnp.floor((xb - bmin[:, None]) / safe[:, None] + r)
+    return jnp.clip(lvl, 0, (1 << bits) - 1).astype(jnp.uint32)
+
+
+def quantize(
+    x: jax.Array,
+    bits: int,
+    bucket_size: int,
+    *,
+    stochastic: bool = False,
+    key: Optional[jax.Array] = None,
+    skip_incomplete_buckets: bool = False,
+) -> QTensor:
+    """Quantize a tensor into a :class:`QTensor` wire buffer."""
+    if not (1 <= bits <= 8):
+        raise ValueError(f"bits must be in 1..8, got {bits}")
+    dtype = x.dtype
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    main_n, res_n = _split_residual(n, bucket_size, skip_incomplete_buckets)
+    residual = flat[main_n:]
+    main = flat[:main_n]
+
+    nb = num_buckets(main_n, bucket_size)
+    if nb == 0:
+        return QTensor(
+            packed=jnp.zeros((0,), jnp.uint32),
+            meta=jnp.zeros((2, 0), dtype),
+            residual=residual,
+            numel=n,
+            bits=bits,
+            bucket_size=bucket_size,
+            dtype=np.dtype(dtype),
+        )
+
+    pad = nb * bucket_size - main_n
+    # Edge-pad: the pad value is an existing member of the final bucket, so
+    # bucket max/min — and therefore constant-bucket exactness — are
+    # unaffected (the reference instead tracks exact partial-bucket bounds).
+    padded = jnp.pad(main, (0, pad), mode="edge") if pad else main
+    xb = padded.reshape(nb, bucket_size).astype(jnp.float32)
+
+    unit, bmin = compute_meta(xb, bits)
+    rand = None
+    if stochastic:
+        if key is None:
+            raise ValueError("stochastic rounding requires a PRNG key")
+        rand = jax.random.uniform(key, xb.shape, dtype=jnp.float32)
+    lvl = encode_levels(xb, unit, bmin, bits, rand)
+
+    packed = pack_levels(lvl.reshape(-1), bits)
+    meta = jnp.stack([unit, bmin]).astype(dtype)
+    return QTensor(
+        packed=packed,
+        meta=meta,
+        residual=residual,
+        numel=n,
+        bits=bits,
+        bucket_size=bucket_size,
+        dtype=np.dtype(dtype),
+    )
+
+
+def decode_levels(
+    lvl: jax.Array, unit: jax.Array, bmin: jax.Array
+) -> jax.Array:
+    """f32[nb, bucket_size] decoded values."""
+    return bmin[:, None] + unit[:, None] * lvl.astype(jnp.float32)
+
+
+def dequantize(
+    q: QTensor,
+    *,
+    add_to: Optional[jax.Array] = None,
+    out_dtype=None,
+) -> jax.Array:
+    """Decode a :class:`QTensor` back to a flat tensor.
+
+    ``add_to``: flat accumulator — fuses the reference's decompress-with-add
+    (``UnpackArray<ADD>``, .cu:474-544) used by every reducer; accumulation
+    is float32 regardless of wire dtype (an upgrade over the reference's
+    in-dtype adds, deliberate for bf16). Result dtype: ``out_dtype`` if
+    given, else the accumulator's dtype, else the wire dtype.
+    """
+    if out_dtype is None:
+        out_dtype = add_to.dtype if add_to is not None else q.dtype
+    main_n = q.numel_main
+    nb = num_buckets(main_n, q.bucket_size)
+    if nb:
+        padded_n = nb * q.bucket_size
+        lvl = unpack_levels(q.packed, q.bits, padded_n).reshape(nb, q.bucket_size)
+        unit = q.meta[0].astype(jnp.float32)
+        bmin = q.meta[1].astype(jnp.float32)
+        vals = decode_levels(lvl, unit, bmin).reshape(-1)[:main_n]
+    else:
+        vals = jnp.zeros((0,), jnp.float32)
+    full = jnp.concatenate([vals, q.residual.astype(jnp.float32)])
+    if add_to is not None:
+        return (add_to.astype(jnp.float32) + full).astype(out_dtype)
+    return full.astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dummy (pass-through) codec — CGX_DEBUG_DUMMY_COMPRESSION
+# (compressor.cc:222-253).
+# ---------------------------------------------------------------------------
+
+
+def quantize_dummy(x: jax.Array) -> QTensor:
+    """Identity "compression": payload = raw bits. Debug-only parity with the
+    reference's memcpy DummyCompressor."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    as_f32 = flat.astype(jnp.float32)
+    packed = jax.lax.bitcast_convert_type(as_f32, jnp.uint32)
+    return QTensor(
+        packed=packed,
+        meta=jnp.zeros((2, 0), x.dtype),
+        residual=jnp.zeros((0,), x.dtype),
+        numel=n,
+        bits=0,
+        bucket_size=0,
+        dtype=np.dtype(x.dtype),
+    )
+
+
+def dequantize_dummy(
+    q: QTensor, *, add_to: Optional[jax.Array] = None, out_dtype=None
+) -> jax.Array:
+    out_dtype = out_dtype or q.dtype
+    vals = jax.lax.bitcast_convert_type(q.packed, jnp.float32)
+    if add_to is not None:
+        return (add_to.astype(jnp.float32) + vals).astype(out_dtype)
+    return vals.astype(out_dtype)
+
+
+# ---------------------------------------------------------------------------
+# Error envelope (the reference's analytic test oracle, test_cgx.py:91-93).
+# ---------------------------------------------------------------------------
+
+
+def allreduce_error_bound(
+    n: int, bits: int, bucket_size: int, world_size: int, value_range: float = 1.0
+) -> float:
+    """Sup-norm bound for a ws-way quantized allreduce of values whose
+    per-bucket range is <= ``value_range`` * min(bucket, n) spacing — the
+    envelope asserted by the reference test suite:
+    ``2 * min(bucket, n) / (2^bits - 1) * ws * (ws + 1)`` (scaled by the
+    data's linspace step in the caller)."""
+    return (
+        2.0
+        * min(bucket_size, n)
+        / float((1 << bits) - 1)
+        * world_size
+        * (world_size + 1)
+        * value_range
+    )

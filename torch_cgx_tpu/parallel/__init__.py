@@ -1,0 +1,49 @@
+from .mesh import (
+    CROSS_AXIS,
+    DP_AXIS,
+    INTRA_AXIS,
+    flat_mesh,
+    hierarchical_mesh,
+    make_training_mesh,
+)
+from .allreduce import allreduce_flat, allreduce_tree, resolve_leaf_config
+from .grad_sync import (
+    compressed_allreduce_transform,
+    gradient_sync,
+    make_train_step,
+    replicate,
+    shard_batch,
+)
+from .reducers import (
+    allgather_quantized,
+    alltoall_allreduce,
+    hierarchical_allreduce,
+    quantized_allreduce,
+    reduce_scatter_quantized,
+    ring_allreduce,
+    sra_allreduce,
+)
+
+__all__ = [
+    "allreduce_flat",
+    "allreduce_tree",
+    "resolve_leaf_config",
+    "compressed_allreduce_transform",
+    "gradient_sync",
+    "make_train_step",
+    "replicate",
+    "shard_batch",
+    "CROSS_AXIS",
+    "DP_AXIS",
+    "INTRA_AXIS",
+    "flat_mesh",
+    "hierarchical_mesh",
+    "make_training_mesh",
+    "allgather_quantized",
+    "alltoall_allreduce",
+    "hierarchical_allreduce",
+    "quantized_allreduce",
+    "reduce_scatter_quantized",
+    "ring_allreduce",
+    "sra_allreduce",
+]

@@ -1,0 +1,207 @@
+"""Tree-level gradient allreduce: layer registry + tensor fusion + dispatch.
+
+TPU-native re-design of ``MPIAllReduce_Operation``
+(/root/reference/src/mpi_allreduce_operations.cc — SURVEY.md §2.1): the
+reference slices DDP buckets into per-layer views (``extractLayers``,
+.cc:257-285), partitions them by compression eligibility (.cc:240-247),
+fuses them into <=64 MB wire slices (.cc:201-227), and runs each slice
+through the reducers. Here the "bucket" is a gradient pytree: leaves are
+resolved to per-layer configs (name-pattern registry, falling back to the
+``CGX_*`` env defaults re-read on every call), grouped by (config, dtype),
+concatenated, split into fusion slices, reduced, and scattered back.
+
+Fixes deliberately not inherited (SURVEY.md §8.5): every fusion batch is
+flushed — the reference silently drops trailing layers after an oversized
+one.
+
+All grouping/slicing decisions are static Python (shapes + configs), so jit
+caches one program per (tree structure, config) — the registry doubles as
+the static-shape cache key exactly as planned in SURVEY.md §7.4.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig, TopologyConfig
+from ..utils.logging import metrics
+from ..utils.tracing import named_scope
+from ..utils.tree import path_str
+from . import mesh as mesh_mod
+from .reducers import hierarchical_allreduce, quantized_allreduce
+
+_FLOAT_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
+
+
+def _is_float(leaf) -> bool:
+    return any(leaf.dtype == d for d in _FLOAT_DTYPES)
+
+
+def resolve_leaf_config(
+    path: str, leaf, *, compress_small: bool = False
+) -> CompressionConfig:
+    """Per-leaf config resolution.
+
+    Mirrors the two-stage eligibility decision (SURVEY.md §8.7): the Python
+    hook's ``should_compress_`` (dim<=1 or tiny tensors -> uncompressed,
+    allreduce_hooks.py:42-45) and the compressor's ``isEnabled``
+    (numel > minimal and bits <= 8, compressor.cc:421-425).
+    """
+    cc = cfg_mod.resolve_pattern_config(path) or cfg_mod.default_compression_config()
+    if not _is_float(leaf):
+        return dataclasses.replace(cc, bits=32)
+    if leaf.size < cfg_mod.minimal_size():
+        return dataclasses.replace(cc, bits=32)
+    if not compress_small and leaf.ndim <= 1:
+        # biases / layernorms: the hook leaves them uncompressed
+        return dataclasses.replace(cc, bits=32)
+    return cc
+
+
+@dataclasses.dataclass(frozen=True)
+class _Group:
+    cc: CompressionConfig
+    dtype: np.dtype
+    indices: Tuple[int, ...]  # leaf positions in flattened tree
+
+
+def _group_leaves(paths_leaves, compress_small: bool) -> List[_Group]:
+    groups: Dict[Tuple, List[int]] = {}
+    order: List[Tuple] = []
+    for i, (path, leaf) in enumerate(paths_leaves):
+        cc = resolve_leaf_config(path, leaf, compress_small=compress_small)
+        if not cc.enabled:
+            cc = CompressionConfig(bits=32)
+        k = (cc, np.dtype(leaf.dtype))
+        if k not in groups:
+            groups[k] = []
+            order.append(k)
+        groups[k].append(i)
+    return [_Group(cc=k[0], dtype=k[1], indices=tuple(groups[k])) for k in order]
+
+
+def _fusion_slices(n: int, elem_size: int) -> List[Tuple[int, int]]:
+    """(offset, length) slices bounded by the fusion threshold
+    (CGX_FUSION_BUFFER_SIZE_MB, 64 MB default — common.h:40). Every slice is
+    emitted (reference bug §8.5 not reproduced)."""
+    cap = cfg_mod.fusion_threshold_elems(elem_size)
+    out = []
+    off = 0
+    while off < n:
+        ln = min(cap, n - off)
+        out.append((off, ln))
+        off += ln
+    return out
+
+
+def allreduce_flat(
+    flat: jax.Array,
+    cc: CompressionConfig,
+    *,
+    mesh,
+    axes: Sequence[str],
+    topology: Optional[TopologyConfig] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
+    shard_map). Slicing by the fusion threshold happens here so oversized
+    buffers are chunked like performOperationSingle (.cc:187-199)."""
+    topo = topology or cfg_mod.topology_from_env()
+    n = flat.shape[0]
+    pieces = []
+    for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
+        piece = lax.slice(flat, (off,), (off + ln,))
+        k = jax.random.fold_in(key, off) if key is not None else None
+        if len(axes) == 1:
+            ws = mesh.shape[axes[0]]
+            red = (
+                topo.intra_reduction
+                if axes[0] != mesh_mod.CROSS_AXIS
+                else topo.cross_reduction
+            )
+            pieces.append(quantized_allreduce(piece, axes[0], ws, cc, red, k))
+        elif len(axes) == 2:
+            cross_axis, intra_axis = axes
+            pieces.append(
+                hierarchical_allreduce(
+                    piece,
+                    intra_axis=intra_axis,
+                    cross_axis=cross_axis,
+                    ws_intra=mesh.shape[intra_axis],
+                    ws_cross=mesh.shape[cross_axis],
+                    cc=cc,
+                    topology=topo,
+                    key=k,
+                )
+            )
+        else:
+            raise ValueError(f"axes must have 1 or 2 names, got {axes!r}")
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
+def allreduce_tree(
+    tree,
+    *,
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    topology: Optional[TopologyConfig] = None,
+    key: Optional[jax.Array] = None,
+    average: bool = False,
+    compress_small: bool = False,
+):
+    """Quantized allreduce of a gradient pytree (call inside shard_map).
+
+    ``average=True`` divides by the total axis world size *before*
+    quantization — the reference hook's semantics (grads pre-divided in
+    Python, backend sums; allreduce_hooks.py:53-54, SURVEY.md §8.12).
+    """
+    axes = tuple(axes)
+    ws_total = int(np.prod([mesh.shape[a] for a in axes]))
+    with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    paths_leaves = [(path_str(p), l) for p, l in with_path]
+    flat_leaves = [l for _, l in paths_leaves]
+
+    if average and ws_total > 1:
+        flat_leaves = [
+            (l / ws_total if _is_float(l) else l) for l in flat_leaves
+        ]
+
+    groups = _group_leaves(paths_leaves, compress_small)
+    out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
+    for g in groups:
+        leaves = [flat_leaves[i] for i in g.indices]
+        fused = (
+            jnp.concatenate([l.reshape(-1) for l in leaves])
+            if len(leaves) > 1
+            else leaves[0].reshape(-1)
+        )
+        with named_scope(
+            f"cgx_allreduce_b{g.cc.bits}_{np.dtype(g.dtype).name}"
+        ):
+            # NOTE: these counters increment at *trace* time (once per
+            # compiled program), so they measure elems per traced allreduce
+            # program, not per executed step.
+            if g.cc.enabled:
+                metrics.add("trace.allreduce.compressed_elems", float(fused.shape[0]))
+                reduced = allreduce_flat(
+                    fused, g.cc, mesh=mesh, axes=axes, topology=topology, key=key
+                )
+            else:
+                metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))
+                reduced = fused
+                for a in axes:
+                    if mesh.shape[a] > 1:
+                        reduced = lax.psum(reduced, a)
+        off = 0
+        for i, leaf in zip(g.indices, leaves):
+            n = leaf.size
+            out[i] = lax.slice(reduced, (off,), (off + n,)).reshape(leaf.shape)
+            off += n
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,96 @@
+"""Device-mesh topology — the TPU replacement for MPIContext.
+
+The reference builds a two-level topology from MPI communicator splits
+(/root/reference/src/common/mpi_context.cc:25-35): a node-local "local"
+communicator and a per-local-rank "cross" communicator. On TPU the same
+hierarchy is a 2-D ``jax.sharding.Mesh`` with a fast intra-slice **ICI** axis
+and a cross-slice **DCN** axis; XLA schedules the actual transport
+(SURVEY.md §5.8). Axis names used throughout the framework:
+
+* ``"intra"`` — ICI (the reference's local/SHM level)
+* ``"cross"`` — DCN (the reference's cross-node MPI level)
+* flat data-parallel meshes use a single ``"dp"`` axis.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+INTRA_AXIS = "intra"
+CROSS_AXIS = "cross"
+DP_AXIS = "dp"
+
+
+def flat_mesh(devices: Optional[Sequence] = None, axis: str = DP_AXIS) -> Mesh:
+    """Single-axis data-parallel mesh over all (or given) devices."""
+    devices = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.asarray(devices), (axis,))
+
+
+def hierarchical_mesh(
+    devices: Optional[Sequence] = None,
+    intra_size: Optional[int] = None,
+) -> Mesh:
+    """2-D (cross, intra) mesh.
+
+    ``intra_size`` defaults to the number of devices per process/host (the
+    reference's node-local world, MPI_Comm_split_type(SHARED)) or, failing
+    that, the largest power-of-two divisor <= 8.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = len(devices)
+    if intra_size is None:
+        local = jax.local_device_count()
+        intra_size = local if (0 < local <= n and n % local == 0) else _pow2_div(n)
+    if n % intra_size != 0:
+        raise ValueError(f"{n} devices not divisible by intra_size={intra_size}")
+    arr = np.asarray(devices).reshape(n // intra_size, intra_size)
+    return Mesh(arr, (CROSS_AXIS, INTRA_AXIS))
+
+
+def _pow2_div(n: int) -> int:
+    p = 1
+    while p * 2 <= min(n, 8) and n % (p * 2) == 0:
+        p *= 2
+    return p
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    return mesh.shape[axis]
+
+
+def make_training_mesh(
+    n_devices: Optional[int] = None,
+    *,
+    dp: Optional[int] = None,
+    tp: int = 1,
+    sp: int = 1,
+    pp: int = 1,
+    ep: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """General training mesh with (dp, pp, sp, tp, ep-folded-into-dp) axes.
+
+    Axes with size 1 are still present so sharding specs are uniform; expert
+    parallelism reuses the ``dp`` axis group by convention (experts sharded
+    over dp) unless ``ep > 1`` which adds a dedicated axis.
+    """
+    devices = list(devices) if devices is not None else jax.devices()
+    n = n_devices or len(devices)
+    devices = devices[:n]
+    used = tp * sp * pp * ep
+    if dp is None:
+        if n % used:
+            raise ValueError(f"{n} devices not divisible by tp*sp*pp*ep={used}")
+        dp = n // used
+    if dp * used != n:
+        raise ValueError(f"dp*tp*sp*pp*ep={dp * used} != {n} devices")
+    names = ("dp", "pp", "sp", "tp", "ep")
+    shape = (dp, pp, sp, tp, ep)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, names)

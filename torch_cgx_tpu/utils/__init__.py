@@ -1,0 +1,16 @@
+from . import env
+from .logging import get_logger, metrics
+from .tracing import named_scope, trace_span
+from .tree import leaf_paths, path_str, round_up, tree_size_bytes
+
+__all__ = [
+    "env",
+    "get_logger",
+    "metrics",
+    "named_scope",
+    "trace_span",
+    "leaf_paths",
+    "path_str",
+    "round_up",
+    "tree_size_bytes",
+]

@@ -1,0 +1,53 @@
+"""Environment-variable helpers.
+
+TPU-native re-expression of the reference env-parsing layer
+(/root/reference/src/common/utils.h:30-57, utils.cc:25-91): the same
+``CGX_*`` surface, read lazily so tests can mutate variables between calls
+(the reference re-reads env on every bucket, mpi_allreduce_operations.cc:238).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def get_int_env_or_default(name: str, default: int) -> int:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        raise ValueError(f"env var {name} must be an int, got {raw!r}")
+
+
+def get_float_env_or_default(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValueError(f"env var {name} must be a float, got {raw!r}")
+
+
+def get_bool_env_or_default(name: str, default: bool) -> bool:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip().lower() in ("1", "true", "yes", "on")
+
+
+def get_str_env_or_default(name: str, default: str) -> str:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return default
+    return raw.strip()
+
+
+def get_optional_str_env(name: str) -> Optional[str]:
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return None
+    return raw.strip()

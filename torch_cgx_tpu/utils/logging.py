@@ -1,0 +1,61 @@
+"""Structured logging + metrics.
+
+The reference has printf-only observability (SURVEY.md §5.5); here we provide
+leveled logging (``CGX_LOG_LEVEL``) and a tiny in-process metrics registry so
+benchmarks/tests can assert on counters.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from collections import defaultdict
+from typing import Dict
+
+_LOGGER_NAME = "torch_cgx_tpu"
+
+
+def get_logger() -> logging.Logger:
+    logger = logging.getLogger(_LOGGER_NAME)
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s %(name)s %(levelname)s] %(message)s")
+        )
+        logger.addHandler(handler)
+        level = os.environ.get("CGX_LOG_LEVEL", "WARNING").upper()
+        logger.setLevel(getattr(logging, level, logging.WARNING))
+        logger.propagate = False
+    return logger
+
+
+class Metrics:
+    """Process-wide counter/gauge registry (thread-safe)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = defaultdict(float)
+
+    def add(self, name: str, value: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._counters[name] = value
+
+    def get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+metrics = Metrics()

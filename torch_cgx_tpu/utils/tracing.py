@@ -1,0 +1,35 @@
+"""Tracing / profiling spans.
+
+The reference's only tracing is c10d ``profilingTitle`` strings surfaced to
+torch.profiler (SURVEY.md §5.1). The TPU-native equivalent: every collective
+wraps itself in a ``jax.profiler.TraceAnnotation`` (visible in XLA/Perfetto
+traces) and records wall-clock spans into the metrics registry for host-side
+inspection.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+from .logging import metrics
+
+
+@contextlib.contextmanager
+def trace_span(name: str):
+    """Annotate a host-side span: XLA trace annotation + duration counter
+    (``span.<name>.seconds`` / ``span.<name>.count`` in ``metrics``)."""
+    start = time.perf_counter()
+    with jax.profiler.TraceAnnotation(name):
+        yield
+    dur = time.perf_counter() - start
+    metrics.add(f"span.{name}.seconds", dur)
+    metrics.add(f"span.{name}.count", 1.0)
+
+
+def named_scope(name: str):
+    """Annotation for traced (jitted) code regions — shows up in the XLA HLO
+    and device profile."""
+    return jax.named_scope(name)

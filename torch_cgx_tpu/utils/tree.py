@@ -1,0 +1,45 @@
+"""Pytree helpers: flatten-with-paths, alignment, size utilities."""
+
+from __future__ import annotations
+
+from typing import Any, List, Tuple
+
+import jax
+import numpy as np
+
+
+def round_up(value: int, multiple: int) -> int:
+    """Reference ``round_to`` (utils.cc) — round up to a multiple."""
+    if multiple <= 0:
+        return value
+    return ((value + multiple - 1) // multiple) * multiple
+
+
+def leaf_paths(tree: Any) -> List[Tuple[str, Any]]:
+    """Flatten a pytree into (dotted-path, leaf) pairs, stable order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        out.append((path_str(path), leaf))
+    return out
+
+
+def path_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(p.name)
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return ".".join(parts)
+
+
+def tree_size_bytes(tree: Any) -> int:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return int(sum(np.prod(l.shape) * l.dtype.itemsize for l in leaves))
