@@ -1,0 +1,130 @@
+"""Model zoo smoke tests: forward shapes, grad step, compressed-DP training."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from torch_cgx_tpu.models import (
+    GPT2,
+    Bert,
+    BertConfig,
+    GPT2Config,
+    ResNet18,
+    ResNet50,
+    ViT,
+    ViTConfig,
+    lm_loss,
+    mlm_loss,
+)
+
+
+def test_resnet18_forward_and_grad():
+    model = ResNet18(num_classes=10, cifar_stem=True)
+    x = jnp.zeros((4, 32, 32, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (4, 10)
+
+    def loss_fn(params):
+        out, _ = model.apply(
+            {"params": params, "batch_stats": variables["batch_stats"]},
+            x,
+            train=True,
+            mutable=["batch_stats"],
+        )
+        return jnp.mean(out**2)
+
+    g = jax.grad(loss_fn)(variables["params"])
+    assert jax.tree_util.tree_structure(g) == jax.tree_util.tree_structure(
+        variables["params"]
+    )
+
+
+def test_resnet50_forward():
+    model = ResNet50(num_classes=100, cifar_stem=False)
+    x = jnp.zeros((2, 64, 64, 3), jnp.float32)
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    assert model.apply(variables, x, train=False).shape == (2, 100)
+
+
+def test_gpt2_forward_loss_grad():
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    toks = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (2, 32))
+    )
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    logits = model.apply({"params": params}, toks)
+    assert logits.shape == (2, 32, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+
+    def loss(p):
+        return lm_loss(model.apply({"params": p}, toks), toks)
+
+    l0 = float(loss(params))
+    assert np.isfinite(l0) and l0 < 2 * np.log(cfg.vocab_size)
+    g = jax.grad(loss)(params)
+    assert jnp.isfinite(g["wte"]["embedding"]).all()
+
+
+def test_bert_mlm():
+    cfg = BertConfig.tiny()
+    model = Bert(cfg)
+    toks = jnp.asarray(
+        np.random.default_rng(1).integers(0, cfg.vocab_size, (2, 24))
+    )
+    params = model.init(jax.random.PRNGKey(0), toks)["params"]
+    logits = model.apply({"params": params}, toks)
+    assert logits.shape == (2, 24, cfg.vocab_size)
+    mask = jnp.zeros((2, 24)).at[:, :4].set(1.0)
+    l = mlm_loss(logits, toks, mask)
+    assert np.isfinite(float(l))
+
+
+def test_vit_forward():
+    cfg = ViTConfig.tiny()
+    model = ViT(cfg)
+    x = jnp.zeros((2, 32, 32, 3), jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x)["params"]
+    assert model.apply({"params": params}, x).shape == (2, 10)
+
+
+@pytest.mark.slow
+def test_gpt2_compressed_dp_training(monkeypatch):
+    """End-to-end: tiny GPT-2, 8 devices, 4-bit grads, loss decreases."""
+    import os
+
+    from torch_cgx_tpu import config as cgx_config
+    from torch_cgx_tpu.parallel import (
+        flat_mesh,
+        make_train_step,
+        replicate,
+        shard_batch,
+    )
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cgx_config.COMPRESSION_BUCKET_SIZE, "512")
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    rng = np.random.default_rng(0)
+    # learnable data: repeated pattern
+    data = np.tile(np.arange(32) % 64, (64, 1)).astype(np.int32)
+    mesh = flat_mesh()
+    params = replicate(
+        model.init(jax.random.PRNGKey(0), jnp.asarray(data[:2]))["params"], mesh
+    )
+    opt = optax.adam(1e-2)
+    opt_state = replicate(opt.init(params), mesh)
+
+    def loss_fn(p, batch):
+        return lm_loss(model.apply({"params": p}, batch), batch)
+
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+    losses = []
+    for i in range(12):
+        batch = shard_batch(jnp.asarray(data), mesh)
+        params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
+        losses.append(float(loss))
+    assert losses[-1] < 0.6 * losses[0], losses

@@ -1,0 +1,22 @@
+from .bert import Bert, BertConfig, mlm_loss
+from .gpt2 import GPT2, GPT2Config, dense_attention, lm_loss, tp_param_spec
+from .resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101
+from .vit import ViT, ViTConfig
+
+__all__ = [
+    "Bert",
+    "BertConfig",
+    "mlm_loss",
+    "GPT2",
+    "GPT2Config",
+    "dense_attention",
+    "lm_loss",
+    "tp_param_spec",
+    "ResNet",
+    "ResNet18",
+    "ResNet34",
+    "ResNet50",
+    "ResNet101",
+    "ViT",
+    "ViTConfig",
+]

@@ -1,0 +1,100 @@
+"""BERT-style bidirectional encoder with MLM head (flax.linen) —
+BASELINE.md's "BERT-base fine-tune DDP, 8-bit, layer_min_size filter on
+LN/bias" config. The LN/bias filter itself lives in the allreduce layer
+(parallel/allreduce.py resolve_leaf_config, ndim<=1 -> uncompressed)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from .gpt2 import dense_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    max_seq: int = 512
+    type_vocab: int = 2
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def base(**kw):
+        return BertConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        defaults = dict(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                        max_seq=128)
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+
+class BertLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        cfg = self.cfg
+        h = cfg.n_head
+        d_head = cfg.d_model // h
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
+
+        o = dense_attention(heads(q), heads(k), heads(v), causal=False)
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        o = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + o).astype(cfg.dtype)
+        y = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
+        return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(cfg.dtype)
+
+
+class Bert(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, token_types=None, train: bool = True):
+        cfg = self.cfg
+        b, s = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
+        x = wte(tokens)
+        x = x + nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype,
+                         name="wpe")(jnp.arange(s)[None, :])
+        if token_types is None:
+            token_types = jnp.zeros_like(tokens)
+        x = x + nn.Embed(cfg.type_vocab, cfg.d_model, dtype=cfg.dtype,
+                         name="wtt")(token_types)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x).astype(cfg.dtype)
+        for i in range(cfg.n_layer):
+            x = BertLayer(cfg, name=f"layer_{i}")(x)
+        # MLM head: transform + tied decoder
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm_transform")(x)
+        y = nn.gelu(y)
+        y = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(y)
+        logits = y.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros, (cfg.vocab_size,)
+        )
+        return logits
+
+
+def mlm_loss(logits, targets, mask):
+    """Masked-LM cross entropy over positions where mask==1."""
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    denom = jnp.maximum(jnp.sum(mask), 1)
+    return -jnp.sum(ll * mask) / denom

@@ -1,0 +1,141 @@
+"""GPT-2 style decoder-only transformer (flax.linen) — the flagship model.
+
+BASELINE.md's "GPT-2 medium pretrain DDP, 2-bit QSGD" config needs a real
+decoder; the reference itself ships no models (SURVEY.md §0). TPU-first
+choices: bf16 activations with f32 params/logits, fused qkv projection,
+einsum attention shaped for the MXU, and tensor-parallel-ready parameter
+layouts (column-parallel qkv/mlp-in, row-parallel proj/mlp-out — apply
+:func:`tp_param_spec` with jit in_shardings and GSPMD inserts the TP
+collectives). ``attn_fn`` plugs in ring-attention sequence parallelism
+(parallel/ring_attention.py) for long contexts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    max_seq: int = 1024
+    dropout: float = 0.0
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def small(**kw):
+        return GPT2Config(**kw)
+
+    @staticmethod
+    def medium(**kw):
+        return GPT2Config(n_layer=24, n_head=16, d_model=1024, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        """Test/dryrun config."""
+        defaults = dict(vocab_size=512, n_layer=2, n_head=4, d_model=128,
+                        max_seq=128)
+        defaults.update(kw)
+        return GPT2Config(**defaults)
+
+
+def dense_attention(q, k, v, *, causal: bool = True):
+    """(B, H, S, D) einsum attention on the MXU; f32 softmax."""
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.float32(np.sqrt(d))
+    if causal:
+        s = q.shape[2]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, np.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class Block(nn.Module):
+    cfg: GPT2Config
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        cfg = self.cfg
+        h = cfg.n_head
+        d_head = cfg.d_model // h
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, D) -> (B, H, S, d)
+            b, s, _ = t.shape
+            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
+
+        attn = self.attn_fn or dense_attention
+        o = attn(heads(q), heads(k), heads(v), causal=True)
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        o = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
+        x = x + o
+
+        y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
+        y = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class GPT2(nn.Module):
+    cfg: GPT2Config
+    attn_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens, train: bool = True):
+        cfg = self.cfg
+        b, s = tokens.shape
+        wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
+        pos = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype, name="wpe")
+        x = wte(tokens) + pos(jnp.arange(s)[None, :])
+        for i in range(cfg.n_layer):
+            x = Block(cfg, attn_fn=self.attn_fn, name=f"h_{i}")(x, train=train)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # tied embedding head, f32 logits
+        logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T
+        return logits
+
+
+def lm_loss(logits, tokens):
+    """Next-token cross entropy (shifted)."""
+    logp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    tgt = tokens[:, 1:]
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def tp_param_spec(path: str, leaf) -> P:
+    """Tensor-parallel PartitionSpec for a GPT-2 param by tree path.
+
+    Megatron-style: qkv and mlp_in are column-parallel (shard output dim over
+    'tp'), attn_proj and mlp_out row-parallel (shard input dim), embeddings
+    sharded on the feature dim. Biases of row-parallel layers stay
+    replicated. GSPMD derives the matching collectives.
+    """
+    if leaf.ndim < 1:
+        return P()
+    if "attn_qkv" in path or "mlp_in" in path:
+        return P(None, "tp") if leaf.ndim == 2 else P("tp")
+    if "attn_proj" in path or "mlp_out" in path:
+        return P("tp", None) if leaf.ndim == 2 else P()
+    if "wte" in path or "wpe" in path:
+        return P(None, "tp")
+    return P()

@@ -1,0 +1,90 @@
+"""Vision Transformer (flax.linen) — BASELINE.md's ViT-L/16 multi-host DDP
+config. TPU-first: patchify via a single conv, bf16 activations, MXU-shaped
+attention reused from the GPT-2 module."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .gpt2 import dense_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    num_classes: int = 1000
+    d_model: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    mlp_ratio: int = 4
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def large(**kw):
+        return ViTConfig(d_model=1024, n_layer=24, n_head=16, **kw)
+
+    @staticmethod
+    def tiny(**kw):
+        defaults = dict(image_size=32, patch_size=8, num_classes=10,
+                        d_model=64, n_layer=2, n_head=4)
+        defaults.update(kw)
+        return ViTConfig(**defaults)
+
+
+class EncoderBlock(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        h = cfg.n_head
+        d_head = cfg.d_model // h
+
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
+        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            b, s, _ = t.shape
+            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
+
+        o = dense_attention(heads(q), heads(k), heads(v), causal=False)
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
+        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
+
+        y = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
+        y = nn.Dense(cfg.mlp_ratio * cfg.d_model, dtype=cfg.dtype,
+                     name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    cfg: ViTConfig
+
+    @nn.compact
+    def __call__(self, images, train: bool = True):
+        cfg = self.cfg
+        p = cfg.patch_size
+        x = nn.Conv(cfg.d_model, (p, p), strides=(p, p), dtype=cfg.dtype,
+                    name="patchify")(images)
+        b, hh, ww, c = x.shape
+        x = x.reshape(b, hh * ww, c)
+        cls = self.param("cls", nn.initializers.zeros, (1, 1, cfg.d_model))
+        x = jnp.concatenate([jnp.tile(cls, (b, 1, 1)).astype(cfg.dtype), x], 1)
+        pos = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (1, hh * ww + 1, cfg.d_model),
+        )
+        x = x + pos.astype(cfg.dtype)
+        for i in range(cfg.n_layer):
+            x = EncoderBlock(cfg, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])

@@ -1,0 +1,290 @@
+"""Fused Pallas TPU kernels for the max-min codec.
+
+The reference fuses find-meta + encode + bit-pack into two CUDA kernels
+(/root/reference/src/common/compression/cuda_compression_operations.cu:
+578-725 QUANTIZE2, 727-798 DEQUANTIZE). The TPU equivalents here do the same
+in one VMEM pass per direction:
+
+* ``quantize``: per-bucket max/min reduction -> unit/min meta -> level
+  encode (deterministic or hardware-PRNG stochastic rounding via
+  ``pltpu.prng_random_bits``, replacing the reference's xorshift128p state
+  array, gpu_rand.h:22-58) -> bit-plane pack into 32-bit words, without
+  materializing levels in HBM.
+* ``dequantize``: unpack -> decode -> optional fused accumulate
+  (``UnpackArray<ADD>`` analogue).
+
+Wire layout is identical to the XLA codec in ``codec.py`` (word for group
+``g``, plane ``w`` at flat index ``g*bits + w``; meta ``(2, nb)``), so
+payloads interoperate across implementations and devices.
+
+Mosaic constraints shaped the kernels (validated empirically on v5e):
+no uint32 reductions / f32<->uint32 casts (all bit math in int32, bitcasts
+at the boundary), no in-kernel lane reshapes, no strided lane slices, no
+multi-axis reductions, and the MXU f32 matmul is not integer-exact — so
+packing uses a ``pltpu.roll`` log-tree segment sum over lanes, and
+unpacking a masked column broadcast. Blocks are plain 2-D
+``(bucket_rows, bucket_size)`` tiles.
+
+Constraints for the kernel path (callers fall back to the XLA codec
+otherwise — see ``dispatch.py``): bucket_size % 32 == 0, no residual mode.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import codec
+
+LANE_GROUP = codec.LANE_GROUP  # 32
+MAX_BUCKET_ELEMS = 16384  # VMEM guard: (tile, bucket) block must stay small
+
+
+def supports(n: int, bits: int, bucket_size: int, skip_incomplete: bool) -> bool:
+    return (
+        1 <= bits <= 8
+        and bucket_size % LANE_GROUP == 0
+        and bucket_size <= MAX_BUCKET_ELEMS
+        and not skip_incomplete
+        and n >= bucket_size  # tiny tensors: XLA path is cheaper than a grid
+    )
+
+
+def _tile_rows(nb: int) -> int:
+    return 8 if nb < 64 else 32
+
+
+# ---------------------------------------------------------------------------
+# Quantize kernel.
+# ---------------------------------------------------------------------------
+
+
+def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, stochastic):
+    maxlvl = np.float32((1 << bits) - 1)
+    xb = x_ref[:].astype(jnp.float32)  # (T, B)
+    t, b = xb.shape
+    g = b // LANE_GROUP
+    bmax = jnp.max(xb, axis=1, keepdims=True)
+    bmin = jnp.min(xb, axis=1, keepdims=True)
+    unit = (bmax - bmin) / maxlvl
+    safe = jnp.where(unit > 0, unit, np.float32(1.0))
+    if stochastic:
+        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+        rbits = pltpu.bitcast(pltpu.prng_random_bits((t, b)), jnp.uint32)
+        # route through int32: Mosaic lacks uint32->f32 (values < 2^24)
+        r = (rbits >> np.uint32(8)).astype(jnp.int32).astype(jnp.float32) * np.float32(
+            2.0**-24
+        )
+    else:
+        r = np.float32(0.5)
+    lvl = jnp.clip(jnp.floor((xb - bmin) / safe + r), 0, maxlvl).astype(jnp.int32)
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (t, b), 1)
+    shift = lane % LANE_GROUP
+    for w in range(bits):
+        # contribution of each value to its group word (disjoint bits; int32
+        # two's-complement wrap is exact for the lane-31 sign bit)
+        s = ((lvl >> w) & 1) << shift
+        # log-tree circular segment sum: after the rolls, lane 32g holds the
+        # sum over lanes [32g, 32g+31] — the packed word of group g
+        for k in (1, 2, 4, 8, 16):
+            s = s + pltpu.roll(s, b - k, axis=1)
+        for gi in range(g):
+            words_ref[:, gi * bits + w : gi * bits + w + 1] = s[
+                :, LANE_GROUP * gi : LANE_GROUP * gi + 1
+            ]
+    meta_ref[:, 0:1] = unit
+    meta_ref[:, 1:2] = bmin
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bits", "bucket_size", "stochastic", "interpret")
+)
+def _quantize_rows_impl(
+    xs: jax.Array,
+    seed: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    stochastic: bool,
+    interpret: bool = False,
+):
+    """xs: (rows, nb_r * bucket_size) already padded. Returns
+    (words (rows, nb_r*G*bits) uint32, meta (rows, nb_r, 2) f32)."""
+    rows, m = xs.shape
+    nb_r = m // bucket_size
+    nb = rows * nb_r
+    g = bucket_size // LANE_GROUP
+    xb = xs.reshape(nb, bucket_size)
+    tile = _tile_rows(nb)
+    nb_pad = codec.num_buckets(nb, tile) * tile
+    if nb_pad != nb:
+        xb = jnp.pad(xb, ((0, nb_pad - nb), (0, 0)), mode="edge")
+
+    words, meta = pl.pallas_call(
+        functools.partial(_quantize_kernel, bits=bits, stochastic=stochastic),
+        grid=(nb_pad // tile,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((tile, bucket_size), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, g * bits), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((nb_pad, g * bits), jnp.int32),
+            jax.ShapeDtypeStruct((nb_pad, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed.reshape(1, 1).astype(jnp.int32), xb)
+    words = jax.lax.bitcast_convert_type(words[:nb], jnp.uint32)
+    # (nb, g*bits) row-major == flat (g*bits + w) per bucket == pack_levels
+    words = words.reshape(rows, nb_r * g * bits)
+    meta = meta[:nb].reshape(rows, nb_r, 2)
+    return words, meta
+
+
+# ---------------------------------------------------------------------------
+# Dequantize kernel.
+# ---------------------------------------------------------------------------
+
+
+def _dequantize_kernel(words_ref, meta_ref, out_ref, *, bits, g):
+    # words are int32 bitcasts; (x >> s) & 1 extracts bits correctly under
+    # arithmetic shift, and decoded levels (< 2^8) are positive.
+    t = words_ref.shape[0]
+    b = g * LANE_GROUP
+    lane = jax.lax.broadcasted_iota(jnp.int32, (t, b), 1)
+    gidx = lane // LANE_GROUP
+    shift = lane % LANE_GROUP
+    lvl = jnp.zeros((t, b), jnp.int32)
+    for w in range(bits):
+        # broadcast each group's word to its 32 lanes via masked selects
+        rep = jnp.zeros((t, b), jnp.int32)
+        for gi in range(g):
+            col = words_ref[:, gi * bits + w : gi * bits + w + 1]  # (T, 1)
+            rep = jnp.where(gidx == gi, col, rep)
+        lvl = lvl | (((rep >> shift) & 1) << w)
+    unit = meta_ref[:, 0:1]
+    bmin = meta_ref[:, 1:2]
+    out_ref[:] = bmin + unit * lvl.astype(jnp.float32)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bits", "bucket_size", "interpret")
+)
+def _dequantize_rows_impl(
+    words: jax.Array,
+    meta: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    interpret: bool = False,
+):
+    """words: (rows, W) uint32, meta: (rows, nb_r, 2) f32 -> (rows, m) f32."""
+    rows = words.shape[0]
+    g = bucket_size // LANE_GROUP
+    nb_r = words.shape[1] // (g * bits)
+    nb = rows * nb_r
+    w2 = jax.lax.bitcast_convert_type(words, jnp.int32).reshape(nb, g * bits)
+    m2 = meta.reshape(nb, 2)
+    tile = _tile_rows(nb)
+    nb_pad = codec.num_buckets(nb, tile) * tile
+    if nb_pad != nb:
+        w2 = jnp.pad(w2, ((0, nb_pad - nb), (0, 0)))
+        m2 = jnp.pad(m2, ((0, nb_pad - nb), (0, 0)))
+
+    out = pl.pallas_call(
+        functools.partial(_dequantize_kernel, bits=bits, g=g),
+        grid=(nb_pad // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, g * bits), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 2), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, bucket_size), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((nb_pad, bucket_size), jnp.float32),
+        interpret=interpret,
+    )(w2, m2)
+    return out[:nb].reshape(rows, nb_r * bucket_size)
+
+
+# ---------------------------------------------------------------------------
+# Public batch API (rows = independent flat buffers of equal length).
+# ---------------------------------------------------------------------------
+
+
+def seed_from_key(key: Optional[jax.Array]) -> jax.Array:
+    if key is None:
+        return jnp.zeros((), jnp.int32)
+    return jax.random.bits(key, (), jnp.uint32).astype(jnp.int32)
+
+
+def quantize_batch(
+    xs: jax.Array,
+    bits: int,
+    bucket_size: int,
+    *,
+    stochastic: bool = False,
+    key: Optional[jax.Array] = None,
+    interpret: bool = False,
+) -> codec.QTensor:
+    """Quantize each row of ``xs (rows, m)`` independently; returns a QTensor
+    with leading ``rows`` dim on packed/meta/residual (same pytree shape as
+    ``jax.vmap(codec.quantize)``)."""
+    rows, m = xs.shape
+    dtype = xs.dtype
+    nb_r = codec.num_buckets(m, bucket_size)
+    m_pad = nb_r * bucket_size
+    if m_pad != m:
+        xs = jnp.pad(xs, ((0, 0), (0, m_pad - m)), mode="edge")
+    words, meta = _quantize_rows_impl(
+        xs.astype(jnp.float32),
+        seed_from_key(key),
+        bits=bits,
+        bucket_size=bucket_size,
+        stochastic=stochastic,
+        interpret=interpret,
+    )
+    meta = jnp.swapaxes(meta, 1, 2).astype(dtype)  # (rows, 2, nb_r)
+    return codec.QTensor(
+        packed=words,
+        meta=meta,
+        residual=jnp.zeros((rows, 0), dtype),
+        numel=m,
+        bits=bits,
+        bucket_size=bucket_size,
+        dtype=np.dtype(dtype),
+    )
+
+
+def dequantize_batch(
+    q: codec.QTensor,
+    *,
+    add_to: Optional[jax.Array] = None,
+    out_dtype=None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode a batched QTensor -> (rows, numel)."""
+    if out_dtype is None:
+        out_dtype = add_to.dtype if add_to is not None else q.dtype
+    vals = _dequantize_rows_impl(
+        q.packed,
+        jnp.swapaxes(q.meta, 1, 2).astype(jnp.float32),
+        bits=q.bits,
+        bucket_size=q.bucket_size,
+        interpret=interpret,
+    )[:, : q.numel]
+    if add_to is not None:
+        return (add_to.astype(jnp.float32) + vals).astype(out_dtype)
+    return vals.astype(out_dtype)

@@ -1,0 +1,96 @@
+"""Codec implementation dispatch: fused Pallas kernels on TPU, pure-XLA
+elsewhere (CGX_CODEC_IMPL = auto|pallas|xla).
+
+Both implementations emit bit-identical wire payloads (see codec_pallas.py),
+so the choice is purely about speed and can differ between producer and
+consumer.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+from . import codec, codec_pallas
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:
+        return False
+
+
+def _pick(n: int, cc: CompressionConfig) -> str:
+    impl = cfg_mod.codec_impl()
+    ok = codec_pallas.supports(n, cc.bits, cc.bucket_size, cc.skip_incomplete_buckets)
+    if impl == "xla" or not ok:
+        return "xla"
+    if impl == "pallas":
+        return "pallas"
+    return "pallas" if _on_tpu() else "xla"
+
+
+def quantize_batch(
+    xs: jax.Array, cc: CompressionConfig, key: Optional[jax.Array] = None
+) -> codec.QTensor:
+    """Quantize each row of ``xs (rows, m)``; stochastic iff cc.stochastic
+    and a key is given."""
+    stochastic = cc.stochastic and key is not None
+    # pltpu.prng_* has no CPU interpreter lowering — stochastic rounding off
+    # TPU always takes the XLA (threefry) path.
+    if _pick(xs.shape[1], cc) == "pallas" and not (stochastic and not _on_tpu()):
+        return codec_pallas.quantize_batch(
+            xs,
+            cc.bits,
+            cc.bucket_size,
+            stochastic=stochastic,
+            key=key,
+            interpret=not _on_tpu(),
+        )
+    if stochastic:
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
+            jnp.arange(xs.shape[0])
+        )
+        return jax.vmap(
+            lambda r, k: codec.quantize(
+                r,
+                cc.bits,
+                cc.bucket_size,
+                stochastic=True,
+                key=k,
+                skip_incomplete_buckets=cc.skip_incomplete_buckets,
+            )
+        )(xs, keys)
+    return jax.vmap(
+        lambda r: codec.quantize(
+            r,
+            cc.bits,
+            cc.bucket_size,
+            skip_incomplete_buckets=cc.skip_incomplete_buckets,
+        )
+    )(xs)
+
+
+def dequantize_batch(
+    q: codec.QTensor, *, add_to: Optional[jax.Array] = None, out_dtype=None
+) -> jax.Array:
+    """Decode a batched QTensor (leading rows dim) -> (rows, numel)."""
+    cc = CompressionConfig(bits=q.bits or 32, bucket_size=q.bucket_size or 512)
+    if (
+        q.bits
+        and q.residual.shape[-1] == 0
+        and _pick(q.numel, cc) == "pallas"
+    ):
+        return codec_pallas.dequantize_batch(
+            q, add_to=add_to, out_dtype=out_dtype, interpret=not _on_tpu()
+        )
+    if add_to is not None:
+        return jax.vmap(
+            lambda qq, acc: codec.dequantize(qq, add_to=acc, out_dtype=out_dtype)
+        )(q, add_to)
+    return jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=out_dtype))(q)

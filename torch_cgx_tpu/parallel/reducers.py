@@ -43,7 +43,7 @@ from jax import lax
 
 from .. import config as cfg_mod
 from ..config import CompressionConfig, TopologyConfig
-from ..ops import codec
+from ..ops import codec, dispatch
 from ..utils.tree import round_up
 
 
@@ -60,32 +60,32 @@ def _pad_rows(x: jax.Array, ws: int, chunk: int) -> jax.Array:
     return x.reshape(ws, chunk)
 
 
-def _row_keys(key: Optional[jax.Array], ws: int, salt: int = 0):
-    if key is None:
-        return None
-    k = jax.random.fold_in(key, salt)
-    return jax.vmap(lambda i: jax.random.fold_in(k, i))(jnp.arange(ws))
+def _quantize_rows(xs: jax.Array, cc: CompressionConfig, key=None) -> codec.QTensor:
+    """Row-batched quantize via the impl dispatcher (Pallas on TPU)."""
+    return dispatch.quantize_batch(xs, cc, key if cc.stochastic else None)
 
 
 def _quantize_1d(x: jax.Array, cc: CompressionConfig, key=None) -> codec.QTensor:
-    return codec.quantize(
-        x,
-        cc.bits,
-        cc.bucket_size,
-        stochastic=cc.stochastic and key is not None,
-        key=key,
-        skip_incomplete_buckets=cc.skip_incomplete_buckets,
-    )
-
-
-def _quantize_rows(xs: jax.Array, cc: CompressionConfig, keys=None) -> codec.QTensor:
-    if keys is None:
-        return jax.vmap(lambda r: _quantize_1d(r, cc))(xs)
-    return jax.vmap(lambda r, k: _quantize_1d(r, cc, k))(xs, keys)
+    """Single-buffer quantize as a rows=1 batch (keeps the Pallas fast path;
+    leading dim threads through ppermute/all_gather untouched)."""
+    return _quantize_rows(x[None], cc, key)
 
 
 def _dequantize_rows(q: codec.QTensor) -> jax.Array:
-    return jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=jnp.float32))(q)
+    return dispatch.dequantize_batch(q, out_dtype=jnp.float32)
+
+
+def _dequantize_1d(q: codec.QTensor, add_to: Optional[jax.Array] = None) -> jax.Array:
+    return dispatch.dequantize_batch(
+        q, add_to=None if add_to is None else add_to[None], out_dtype=jnp.float32
+    )[0]
+
+
+def _gather_rows(q: codec.QTensor, axis_name: str):
+    """all_gather a rows=1 QTensor into a rows=ws QTensor (tiled concat)."""
+    return jax.tree.map(
+        lambda a: lax.all_gather(a, axis_name, axis=0, tiled=True), q
+    )
 
 
 def _shift_right(q, axis_name: str, ws: int):
@@ -111,7 +111,11 @@ def reduce_scatter_quantized(
     Returns this device's reduced chunk, float32[chunk_size(n, ws)].
     """
     xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
-    q = _quantize_rows(xs, cc, _row_keys(key, ws, salt=1) if cc.stochastic else None)
+    if key is not None:
+        # decorrelate stochastic-rounding streams across devices (the
+        # reference seeds per-process with time(), compressor.cc:441)
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    q = _quantize_rows(xs, cc, key)
     q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
     vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
     return jnp.sum(vals, axis=0)
@@ -133,9 +137,7 @@ def allgather_quantized(
     if key is not None:
         key = jax.random.fold_in(key, lax.axis_index(axis_name))
     q_own = _quantize_1d(chunk_f32.astype(out_dtype), cc, key if cc.stochastic else None)
-    gathered = jax.tree.map(
-        lambda a: lax.all_gather(a, axis_name, axis=0), q_own
-    )
+    gathered = _gather_rows(q_own, axis_name)
     vals = _dequantize_rows(gathered)  # (ws, chunk)
     return vals.reshape(-1)[:n].astype(out_dtype)
 
@@ -195,7 +197,7 @@ def ring_allreduce(
         q = _quantize_1d(seg_out, cc, k)
         q_in = _shift_right(q, axis_name, ws)
         recv_idx = (rank - step - 1) % ws
-        updated = codec.dequantize(q_in, add_to=row(acc, recv_idx), out_dtype=jnp.float32)
+        updated = _dequantize_1d(q_in, add_to=row(acc, recv_idx))
         acc = lax.dynamic_update_slice(acc, updated[None], (recv_idx, 0))
 
     # Phase 2: allgather. Device r owns fully-reduced segment (r + 1) mod ws;
@@ -206,16 +208,12 @@ def ring_allreduce(
     ) else None
     q_own = _quantize_1d(row(acc, own_idx).astype(dtype), cc, k)
     out = jnp.zeros((ws, seg), jnp.float32)
-    out = lax.dynamic_update_slice(
-        out, codec.dequantize(q_own, out_dtype=jnp.float32)[None], (own_idx, 0)
-    )
+    out = lax.dynamic_update_slice(out, _dequantize_1d(q_own)[None], (own_idx, 0))
     cur = q_own
     for step in range(ws - 1):
         cur = _shift_right(cur, axis_name, ws)
         idx = (rank - step) % ws
-        out = lax.dynamic_update_slice(
-            out, codec.dequantize(cur, out_dtype=jnp.float32)[None], (idx, 0)
-        )
+        out = lax.dynamic_update_slice(out, _dequantize_1d(cur)[None], (idx, 0))
     return out.reshape(-1)[:n].astype(dtype)
 
 
@@ -238,7 +236,7 @@ def alltoall_allreduce(
     if key is not None and cc.stochastic:
         k = jax.random.fold_in(key, lax.axis_index(axis_name))
     q = _quantize_1d(x, cc, k)
-    gathered = jax.tree.map(lambda a: lax.all_gather(a, axis_name, axis=0), q)
+    gathered = _gather_rows(q, axis_name)
     vals = _dequantize_rows(gathered)
     return jnp.sum(vals, axis=0).astype(x.dtype)
 
