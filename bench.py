@@ -1,0 +1,143 @@
+"""Benchmark harness — prints ONE JSON line.
+
+Adaptive to available hardware:
+
+* multi-device: quantized 4-bit SRA allreduce of a 64 MB fp32 gradient
+  buffer vs XLA's native fp32 ``psum`` (the reference's headline: compressed
+  allreduce speedup over full-precision, BASELINE.md north star).
+  ``vs_baseline`` = fp32-psum time / quantized time (>1 = faster than fp32).
+* single device: fused Pallas codec throughput (quantize+dequantize round
+  trip, the TPU work this framework adds to the hot path), with
+  ``vs_baseline`` = speedup over the pure-XLA lax-ops codec on the same chip.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+N_ELEMS = 16 * 1024 * 1024  # 64 MB fp32
+BITS = 4
+BUCKET = 512
+WARMUP = 3
+ITERS = 20
+
+
+def _fetch(out) -> None:
+    # Pull one element of every output to host: device queues are in-order,
+    # so this forces completion of all queued executions (block_until_ready
+    # alone does not reliably synchronize through the axon tunnel).
+    for leaf in jax.tree.leaves(out):
+        np.asarray(jax.device_get(leaf.ravel()[:1]))
+
+
+def _time(fn, *args) -> float:
+    for _ in range(WARMUP):
+        _fetch(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        out = fn(*args)
+    _fetch(out)
+    return (time.perf_counter() - t0) / ITERS
+
+
+def bench_allreduce(devices) -> dict:
+    from torch_cgx_tpu.config import CompressionConfig
+    from torch_cgx_tpu.parallel.reducers import quantized_allreduce
+
+    mesh = Mesh(np.asarray(devices), ("dp",))
+    ws = len(devices)
+    cc = CompressionConfig(bits=BITS, bucket_size=BUCKET)
+    x = jax.device_put(
+        jnp.arange(N_ELEMS, dtype=jnp.float32) / N_ELEMS,
+        NamedSharding(mesh, P()),
+    )
+
+    def q_allreduce(x):
+        return quantized_allreduce(x, "dp", ws, cc, "SRA")
+
+    def f32_allreduce(x):
+        return jax.lax.psum(x, "dp")
+
+    shard = dict(mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    q = jax.jit(jax.shard_map(q_allreduce, **shard))
+    f = jax.jit(jax.shard_map(f32_allreduce, **shard))
+    tq, tf = _time(q, x), _time(f, x)
+    gbytes = N_ELEMS * 4 / 1e9
+    return {
+        "metric": f"sra_allreduce_{BITS}bit_64MB_x{ws}",
+        "value": round(gbytes / tq, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tf / tq, 3),
+        "detail": {
+            "t_quantized_ms": round(tq * 1e3, 3),
+            "t_fp32_psum_ms": round(tf * 1e3, 3),
+            "devices": ws,
+        },
+    }
+
+
+def bench_codec() -> dict:
+    """Quantize and dequantize timed separately (a fused round trip lets XLA
+    simplify the whole pipeline away — not what runs inside the reducers,
+    where the packed payload crosses a collective boundary)."""
+    from torch_cgx_tpu.ops import codec, codec_pallas
+
+    on_tpu = jax.default_backend() == "tpu"
+    # 512 MB on real hardware so the op dwarfs timing noise; small in
+    # interpreter mode (CPU fallback) where the Pallas path runs in pure
+    # Python.
+    n = 128 * 1024 * 1024 if on_tpu else 1024 * 1024
+    x = (jnp.arange(n, dtype=jnp.float32) / n)[None]
+
+    def q_pallas(x):
+        return codec_pallas.quantize_batch(
+            x, BITS, BUCKET, stochastic=False, interpret=not on_tpu
+        )
+
+    def q_xla(x):
+        return jax.vmap(lambda r: codec.quantize(r, BITS, BUCKET))(x)
+
+    def d_pallas(q):
+        return codec_pallas.dequantize_batch(
+            q, out_dtype=jnp.float32, interpret=not on_tpu
+        )
+
+    def d_xla(q):
+        return jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=jnp.float32))(q)
+
+    qt = jax.block_until_ready(jax.jit(q_pallas)(x))
+    tpq = _time(jax.jit(q_pallas), x)
+    tpd = _time(jax.jit(d_pallas), qt)
+    txq = _time(jax.jit(q_xla), x)
+    txd = _time(jax.jit(d_xla), qt)
+    gbytes = n * 4 / 1e9
+    tp, tx = tpq + tpd, txq + txd
+    return {
+        "metric": f"pallas_codec_{BITS}bit_{n * 4 // 2**20}MB",
+        "value": round(gbytes / tp, 3),
+        "unit": "GB/s",
+        "vs_baseline": round(tx / tp, 3),
+        "detail": {
+            "t_pallas_quantize_ms": round(tpq * 1e3, 3),
+            "t_pallas_dequantize_ms": round(tpd * 1e3, 3),
+            "t_xla_quantize_ms": round(txq * 1e3, 3),
+            "t_xla_dequantize_ms": round(txd * 1e3, 3),
+            "backend": jax.default_backend(),
+        },
+    }
+
+
+def main() -> None:
+    devices = jax.devices()
+    result = bench_allreduce(devices) if len(devices) > 1 else bench_codec()
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

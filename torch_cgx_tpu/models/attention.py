@@ -1,0 +1,93 @@
+"""Shared multi-head attention + MLP blocks for the transformer model zoo.
+
+One implementation of the qkv-projection / head-split / attention /
+head-merge / output-projection plumbing, reused by GPT-2, BERT, and ViT.
+Parameter names (``attn_qkv``, ``attn_proj``, ``mlp_in``, ``mlp_out``) are
+the contract :func:`torch_cgx_tpu.models.gpt2.tp_param_spec` matches on for
+tensor-parallel sharding — keep them stable.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_attention(q, k, v, *, causal: bool = True, mask=None):
+    """(B, H, S, D) einsum attention on the MXU; f32 softmax.
+
+    ``mask``: optional key-side padding mask, bool (B, S) or broadcastable to
+    (B, H, Sq, Sk); True = attend.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.float32(np.sqrt(d))
+    if causal:
+        s = q.shape[2]
+        cm = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(cm, scores, np.float32(-1e30))
+    if mask is not None:
+        if mask.ndim == 2:  # (B, Sk) key padding
+            mask = mask[:, None, None, :]
+        scores = jnp.where(mask, scores, np.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+class MultiHeadAttention(nn.Module):
+    """qkv projection -> heads -> ``attn_fn`` -> merge -> output projection.
+
+    ``attn_fn(q, k, v, causal=...)`` defaults to :func:`dense_attention`;
+    ring-attention sequence parallelism plugs in here.
+    """
+
+    d_model: int
+    n_head: int
+    dtype: Any = jnp.bfloat16
+    causal: bool = True
+    attn_fn: Optional[Callable] = None
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, mask=None, train: bool = True):
+        h = self.n_head
+        d_head = self.d_model // h
+        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype, name="attn_qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, D) -> (B, H, S, d)
+            b, s, _ = t.shape
+            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
+
+        attn = self.attn_fn or dense_attention
+        kw = {} if mask is None else {"mask": mask}
+        o = attn(heads(q), heads(k), heads(v), causal=self.causal, **kw)
+        b, _, s, _ = o.shape
+        o = o.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
+        o = nn.Dense(self.d_model, dtype=self.dtype, name="attn_proj")(o)
+        if self.dropout:
+            o = nn.Dropout(self.dropout, deterministic=not train)(o)
+        return o
+
+
+class Mlp(nn.Module):
+    """Dense -> gelu -> Dense feed-forward block."""
+
+    d_model: int
+    ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    dropout: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        y = nn.Dense(self.ratio * self.d_model, dtype=self.dtype, name="mlp_in")(x)
+        y = nn.gelu(y)
+        y = nn.Dense(self.d_model, dtype=self.dtype, name="mlp_out")(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return y

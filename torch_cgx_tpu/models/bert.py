@@ -12,7 +12,7 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
-from .gpt2 import dense_attention
+from .attention import Mlp, MultiHeadAttention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -23,6 +23,7 @@ class BertConfig:
     d_model: int = 768
     max_seq: int = 512
     type_vocab: int = 2
+    dropout: float = 0.0
     dtype: Any = jnp.bfloat16
 
     @staticmethod
@@ -41,25 +42,15 @@ class BertLayer(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, train: bool = True):
         cfg = self.cfg
-        h = cfg.n_head
-        d_head = cfg.d_model // h
-        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            b, s, _ = t.shape
-            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
-
-        o = dense_attention(heads(q), heads(k), heads(v), causal=False)
-        b, _, s, _ = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        o = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
+        o = MultiHeadAttention(
+            cfg.d_model, cfg.n_head, dtype=cfg.dtype, causal=False,
+            dropout=cfg.dropout, name="attn",
+        )(x, mask=mask, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x + o).astype(cfg.dtype)
-        y = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="mlp_in")(x)
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
+        y = Mlp(cfg.d_model, dtype=cfg.dtype, dropout=cfg.dropout,
+                name="mlp")(x, train=train)
         return nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x + y).astype(cfg.dtype)
 
 
@@ -67,7 +58,8 @@ class Bert(nn.Module):
     cfg: BertConfig
 
     @nn.compact
-    def __call__(self, tokens, token_types=None, train: bool = True):
+    def __call__(self, tokens, token_types=None, attention_mask=None,
+                 train: bool = True):
         cfg = self.cfg
         b, s = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
@@ -79,8 +71,11 @@ class Bert(nn.Module):
         x = x + nn.Embed(cfg.type_vocab, cfg.d_model, dtype=cfg.dtype,
                          name="wtt")(token_types)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_emb")(x).astype(cfg.dtype)
+        if cfg.dropout:
+            x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
-            x = BertLayer(cfg, name=f"layer_{i}")(x)
+            x = BertLayer(cfg, name=f"layer_{i}")(x, mask=attention_mask,
+                                                  train=train)
         # MLM head: transform + tied decoder
         y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm_transform")(x)
         y = nn.gelu(y)

@@ -21,6 +21,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from .attention import Mlp, MultiHeadAttention, dense_attention
+
 
 @dataclasses.dataclass(frozen=True)
 class GPT2Config:
@@ -49,20 +51,6 @@ class GPT2Config:
         return GPT2Config(**defaults)
 
 
-def dense_attention(q, k, v, *, causal: bool = True):
-    """(B, H, S, D) einsum attention on the MXU; f32 softmax."""
-    d = q.shape[-1]
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                        preferred_element_type=jnp.float32)
-    scores = scores / np.float32(np.sqrt(d))
-    if causal:
-        s = q.shape[2]
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        scores = jnp.where(mask, scores, np.float32(-1e30))
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
-
-
 class Block(nn.Module):
     cfg: GPT2Config
     attn_fn: Optional[Callable] = None
@@ -70,29 +58,15 @@ class Block(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = True):
         cfg = self.cfg
-        h = cfg.n_head
-        d_head = cfg.d_model // h
-
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
-        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):  # (B, S, D) -> (B, H, S, d)
-            b, s, _ = t.shape
-            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
-
-        attn = self.attn_fn or dense_attention
-        o = attn(heads(q), heads(k), heads(v), causal=True)
-        b, _, s, _ = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        o = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
-        x = x + o
-
+        x = x + MultiHeadAttention(
+            cfg.d_model, cfg.n_head, dtype=cfg.dtype, causal=True,
+            attn_fn=self.attn_fn, dropout=cfg.dropout, name="attn",
+        )(y, train=train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
-        y = nn.Dense(4 * cfg.d_model, dtype=cfg.dtype, name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
-        return x + y
+        return x + Mlp(
+            cfg.d_model, dtype=cfg.dtype, dropout=cfg.dropout, name="mlp"
+        )(y, train=train)
 
 
 class GPT2(nn.Module):
@@ -106,6 +80,8 @@ class GPT2(nn.Module):
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
         pos = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype, name="wpe")
         x = wte(tokens) + pos(jnp.arange(s)[None, :])
+        if cfg.dropout:
+            x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
             x = Block(cfg, attn_fn=self.attn_fn, name=f"h_{i}")(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)

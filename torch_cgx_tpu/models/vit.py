@@ -10,7 +10,7 @@ from typing import Any
 import flax.linen as nn
 import jax.numpy as jnp
 
-from .gpt2 import dense_attention
+from .attention import Mlp, MultiHeadAttention
 
 
 @dataclasses.dataclass(frozen=True)
@@ -22,6 +22,7 @@ class ViTConfig:
     n_layer: int = 12
     n_head: int = 12
     mlp_ratio: int = 4
+    dropout: float = 0.0
     dtype: Any = jnp.bfloat16
 
     @staticmethod
@@ -40,30 +41,18 @@ class EncoderBlock(nn.Module):
     cfg: ViTConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, train: bool = True):
         cfg = self.cfg
-        h = cfg.n_head
-        d_head = cfg.d_model // h
-
         y = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
-        qkv = nn.Dense(3 * cfg.d_model, dtype=cfg.dtype, name="attn_qkv")(y)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            b, s, _ = t.shape
-            return t.reshape(b, s, h, d_head).transpose(0, 2, 1, 3)
-
-        o = dense_attention(heads(q), heads(k), heads(v), causal=False)
-        b, _, s, _ = o.shape
-        o = o.transpose(0, 2, 1, 3).reshape(b, s, cfg.d_model)
-        x = x + nn.Dense(cfg.d_model, dtype=cfg.dtype, name="attn_proj")(o)
-
+        x = x + MultiHeadAttention(
+            cfg.d_model, cfg.n_head, dtype=cfg.dtype, causal=False,
+            dropout=cfg.dropout, name="attn",
+        )(y, train=train)
         y = nn.LayerNorm(dtype=jnp.float32)(x).astype(cfg.dtype)
-        y = nn.Dense(cfg.mlp_ratio * cfg.d_model, dtype=cfg.dtype,
-                     name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlp_out")(y)
-        return x + y
+        return x + Mlp(
+            cfg.d_model, ratio=cfg.mlp_ratio, dtype=cfg.dtype,
+            dropout=cfg.dropout, name="mlp",
+        )(y, train=train)
 
 
 class ViT(nn.Module):
@@ -84,7 +73,9 @@ class ViT(nn.Module):
             (1, hh * ww + 1, cfg.d_model),
         )
         x = x + pos.astype(cfg.dtype)
+        if cfg.dropout:
+            x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
-            x = EncoderBlock(cfg, name=f"block_{i}")(x)
+            x = EncoderBlock(cfg, name=f"block_{i}")(x, train=train)
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         return nn.Dense(cfg.num_classes, dtype=jnp.float32, name="head")(x[:, 0])

@@ -10,8 +10,9 @@ in one VMEM pass per direction:
   ``pltpu.prng_random_bits``, replacing the reference's xorshift128p state
   array, gpu_rand.h:22-58) -> bit-plane pack into 32-bit words, without
   materializing levels in HBM.
-* ``dequantize``: unpack -> decode -> optional fused accumulate
-  (``UnpackArray<ADD>`` analogue).
+* ``dequantize``: unpack -> decode in one kernel pass. The accumulate of
+  ``dequantize_batch(add_to=...)`` (``UnpackArray<ADD>`` analogue) is
+  applied as a plain XLA add on the kernel output, not fused in-kernel.
 
 Wire layout is identical to the XLA codec in ``codec.py`` (word for group
 ``g``, plane ``w`` at flat index ``g*bits + w``; meta ``(2, nb)``), so

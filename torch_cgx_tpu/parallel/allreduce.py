@@ -175,7 +175,10 @@ def allreduce_tree(
 
     groups = _group_leaves(paths_leaves, compress_small)
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
-    for g in groups:
+    for gi, g in enumerate(groups):
+        # distinct stochastic-rounding stream per fused group (groups would
+        # otherwise share fold sequences and thus random fields)
+        g_key = jax.random.fold_in(key, gi) if key is not None else None
         leaves = [flat_leaves[i] for i in g.indices]
         fused = (
             jnp.concatenate([l.reshape(-1) for l in leaves])
@@ -191,7 +194,8 @@ def allreduce_tree(
             if g.cc.enabled:
                 metrics.add("trace.allreduce.compressed_elems", float(fused.shape[0]))
                 reduced = allreduce_flat(
-                    fused, g.cc, mesh=mesh, axes=axes, topology=topology, key=key
+                    fused, g.cc, mesh=mesh, axes=axes, topology=topology,
+                    key=g_key,
                 )
             else:
                 metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))

@@ -96,18 +96,34 @@ def make_train_step(
 
     ``loss_fn(params, batch) -> scalar loss`` is evaluated per device on its
     batch shard; gradients are synchronized with the quantized allreduce and
-    the optimizer update runs replicated.
+    the optimizer update runs replicated. A 3-argument
+    ``loss_fn(params, batch, rng)`` also receives a fresh per-step, per-device
+    PRNG key (for dropout etc. — pass it to ``model.apply`` as
+    ``rngs={"dropout": rng}``); it is derived from ``stochastic_seed`` (or 0)
+    folded with the step index and the device's data-parallel position.
 
     Returns ``step(params, opt_state, batch, step_idx) -> (params, opt_state,
     loss)`` where ``batch`` leaves are sharded on their leading dim over
     ``axes`` and params/opt_state are replicated.
     """
+    import inspect
+
     axes = tuple(axes)
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
     batch_spec = P(axes if len(axes) > 1 else axes[0])
+    wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
 
     def _step(params, opt_state, batch, step_idx):
-        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        if wants_rng:
+            r = jax.random.fold_in(
+                jax.random.PRNGKey(stochastic_seed or 0), step_idx
+            )
+            # decorrelate dropout masks across data-parallel devices
+            for a in axes:
+                r = jax.random.fold_in(r, jax.lax.axis_index(a))
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch, r)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         key = None
         if stochastic_seed is not None:
             key = jax.random.fold_in(jax.random.PRNGKey(stochastic_seed), step_idx)

@@ -98,6 +98,19 @@ def _shift_right(q, axis_name: str, ws: int):
 # ---------------------------------------------------------------------------
 
 
+def _phase_key(key, salt: int, axis_name: str):
+    """Decorrelate stochastic-rounding streams across devices AND phases.
+
+    The reference seeds per-process with time() (compressor.cc:441); here the
+    device stream is fold_in(axis_index) and ``salt`` separates the
+    reduce-scatter / allgather / hierarchical-level phases so no two
+    quantizations of related data share a random field.
+    """
+    if key is None:
+        return None
+    return jax.random.fold_in(jax.random.fold_in(key, salt), lax.axis_index(axis_name))
+
+
 def reduce_scatter_quantized(
     x: jax.Array,
     axis_name: str,
@@ -111,10 +124,7 @@ def reduce_scatter_quantized(
     Returns this device's reduced chunk, float32[chunk_size(n, ws)].
     """
     xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
-    if key is not None:
-        # decorrelate stochastic-rounding streams across devices (the
-        # reference seeds per-process with time(), compressor.cc:441)
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    key = _phase_key(key, 1, axis_name)
     q = _quantize_rows(xs, cc, key)
     q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
     vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
@@ -134,8 +144,7 @@ def allgather_quantized(
     owned chunk, all_gather, decode every row — including one's own, which
     realizes the requant+self-dequant error-symmetry trick
     (scatter_reduce_allgather.cc:157-160)."""
-    if key is not None:
-        key = jax.random.fold_in(key, lax.axis_index(axis_name))
+    key = _phase_key(key, 2, axis_name)
     q_own = _quantize_1d(chunk_f32.astype(out_dtype), cc, key if cc.stochastic else None)
     gathered = _gather_rows(q_own, axis_name)
     vals = _dequantize_rows(gathered)  # (ws, chunk)
@@ -302,19 +311,23 @@ def hierarchical_allreduce(
     """
     topo = topology or cfg_mod.topology_from_env()
     n = x.shape[0]
+    # Separate the two levels' stochastic streams: a device's intra and cross
+    # axis_index can coincide, so phase salts alone don't decorrelate them.
+    key_intra = jax.random.fold_in(key, 3) if key is not None else None
+    key_cross = jax.random.fold_in(key, 5) if key is not None else None
     if ws_intra == 1 and ws_cross == 1:
         return x
     if ws_intra == 1:
         return quantized_allreduce(
             x, cross_axis, ws_cross,
             cc if topo.cross_compress else CompressionConfig(bits=32),
-            topo.cross_reduction, key,
+            topo.cross_reduction, key_cross,
         )
     if ws_cross == 1:
         return quantized_allreduce(
             x, intra_axis, ws_intra,
             cc if topo.intra_compress else CompressionConfig(bits=32),
-            topo.intra_reduction, key,
+            topo.intra_reduction, key_intra,
         )
 
     intra_cc = cc if topo.intra_compress else CompressionConfig(bits=32)
@@ -322,24 +335,24 @@ def hierarchical_allreduce(
 
     if not topo.intra_broadcast:
         y = quantized_allreduce(x, intra_axis, ws_intra, intra_cc,
-                                topo.intra_reduction, key)
+                                topo.intra_reduction, key_intra)
         return quantized_allreduce(y, cross_axis, ws_cross, cross_cc,
-                                   topo.cross_reduction, key)
+                                   topo.cross_reduction, key_cross)
 
     # Leader scheme, SPMD-style.
     if intra_cc.enabled and not cfg_mod.dummy_compression():
-        chunk = reduce_scatter_quantized(x, intra_axis, ws_intra, intra_cc, key)
+        chunk = reduce_scatter_quantized(x, intra_axis, ws_intra, intra_cc, key_intra)
     else:
         pad_n = ws_intra * _chunk_size(n, ws_intra)
         xp = jnp.pad(x.astype(jnp.float32), (0, pad_n - n), mode="edge")
         chunk = lax.psum_scatter(xp, intra_axis, scatter_dimension=0, tiled=True)
     chunk = quantized_allreduce(
         chunk.astype(x.dtype), cross_axis, ws_cross, cross_cc,
-        topo.cross_reduction, key,
+        topo.cross_reduction, key_cross,
     ).astype(jnp.float32)
     if intra_cc.enabled and not cfg_mod.dummy_compression():
         return allgather_quantized(
-            chunk, intra_axis, ws_intra, intra_cc, n, x.dtype, key
+            chunk, intra_axis, ws_intra, intra_cc, n, x.dtype, key_intra
         )
     full = lax.all_gather(chunk, intra_axis, axis=0).reshape(-1)
     return full[:n].astype(x.dtype)
