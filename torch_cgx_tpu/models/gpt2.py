@@ -74,12 +74,19 @@ class GPT2(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, train: bool = True):
+    def __call__(self, tokens, positions=None, train: bool = True):
+        """``positions``: optional global token positions (B, S) or (S,) —
+        required under sequence parallelism, where the local shard's
+        positions are not ``arange(s_local)``."""
         cfg = self.cfg
         b, s = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
         pos = nn.Embed(cfg.max_seq, cfg.d_model, dtype=cfg.dtype, name="wpe")
-        x = wte(tokens) + pos(jnp.arange(s)[None, :])
+        if positions is None:
+            positions = jnp.arange(s)[None, :]
+        elif positions.ndim == 1:
+            positions = positions[None, :]
+        x = wte(tokens) + pos(positions)
         if cfg.dropout:
             x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):

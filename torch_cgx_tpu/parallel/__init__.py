@@ -14,6 +14,7 @@ from .grad_sync import (
     replicate,
     shard_batch,
 )
+from .ring_attention import make_sp_attention, ring_attention, ulysses_attention
 from .reducers import (
     allgather_quantized,
     alltoall_allreduce,
@@ -46,4 +47,7 @@ __all__ = [
     "reduce_scatter_quantized",
     "ring_allreduce",
     "sra_allreduce",
+    "make_sp_attention",
+    "ring_attention",
+    "ulysses_attention",
 ]

@@ -1,0 +1,167 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference has **no** attention or sequence-dimension code (SURVEY.md
+§5.7) — long-context support is a from-scratch TPU-native design, built from
+the same ``shard_map`` + collective primitives as the quantized reducers:
+
+* :func:`ring_attention` — blockwise-causal flash attention with the K/V
+  blocks rotating around the mesh axis via ``lax.ppermute`` (one hop per
+  step, compute overlapping communication under XLA's async scheduling) and
+  an online-softmax (running max / normalizer) accumulator, so the full
+  S x S score matrix never materializes and sequence length scales linearly
+  with the number of devices.
+* :func:`ulysses_attention` — DeepSpeed-Ulysses-style: two ``all_to_all``
+  reshards (sequence-sharded -> head-sharded and back) around a plain dense
+  attention; cheaper than the ring when n_head % ws == 0 and the sequence
+  fits per-device memory.
+
+Both match :func:`~torch_cgx_tpu.models.attention.dense_attention` on the
+gathered sequence to f32 tolerance and slot into
+``MultiHeadAttention(attn_fn=...)`` via :func:`make_sp_attention`.
+
+Inputs are (B, H, S_local, D) inside ``shard_map`` with the sequence
+dimension sharded over ``axis_name``.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+NEG_INF = np.float32(-1e30)
+
+
+def _block_scores(q, k, scale):
+    return jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Blockwise ring attention over a sequence-sharded mesh axis.
+
+    Each device owns one query block; K/V blocks hop around the ring
+    (``ppermute``) while a flash-style online softmax folds each block's
+    contribution into a running (max, normalizer, weighted-sum) accumulator.
+    Returns the attention output for the local query block, same
+    shape/dtype as ``q``.
+    """
+    ws = lax.axis_size(axis_name)
+    if ws == 1:
+        from ..models.attention import dense_attention
+
+        return dense_attention(q, k, v, causal=causal)
+
+    b, h, s_local, d = q.shape
+    scale = np.float32(1.0 / np.sqrt(d))
+    rank = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+
+    q_pos = rank * s_local + jnp.arange(s_local)  # global query positions
+
+    # Running accumulators (f32): row max m, normalizer l, weighted sum acc.
+    m = jnp.full((b, h, s_local), NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, s_local), jnp.float32)
+    acc = jnp.zeros((b, h, s_local, d), jnp.float32)
+
+    # kv starts as own block and hops left each step, so at step s the local
+    # kv block originated at rank (rank + s) mod ws.
+    shift_left = [(i, (i - 1) % ws) for i in range(ws)]
+    kv = (k, v)
+
+    for step in range(ws):
+        k_blk, v_blk = kv
+        src = (rank + step) % ws
+        scores = _block_scores(qf, k_blk.astype(jnp.float32), scale)
+        if causal:
+            k_pos = src * s_local + jnp.arange(s_local)
+            mask = q_pos[:, None] >= k_pos[None, :]  # (s_local, s_local)
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        blk_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, blk_max)
+        # guard: fully-masked block rows keep m_new finite via maximum(m, .)
+        p = jnp.exp(scores - m_new[..., None])
+        correction = jnp.exp(m - m_new)
+        l = l * correction + jnp.sum(p, axis=-1)
+        acc = acc * correction[..., None] + jnp.einsum(
+            "bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32)
+        )
+        m = m_new
+        if step != ws - 1:
+            kv = jax.tree.map(
+                lambda a: lax.ppermute(a, axis_name, shift_left), kv
+            )
+
+    out = acc / jnp.maximum(l, np.float32(1e-30))[..., None]
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    causal: bool = True,
+) -> jax.Array:
+    """Ulysses sequence parallelism: all_to_all heads<->sequence reshard.
+
+    (B, H, S/ws, D) -> all_to_all -> (B, H/ws, S, D) -> dense attention ->
+    all_to_all back. Requires n_head divisible by the axis size.
+    """
+    from ..models.attention import dense_attention
+
+    ws = lax.axis_size(axis_name)
+    if ws == 1:
+        return dense_attention(q, k, v, causal=causal)
+    h = q.shape[1]
+    if h % ws:
+        raise ValueError(f"n_head={h} not divisible by sp axis size {ws}")
+
+    def to_heads(t):  # split heads over axis, gather sequence
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def to_seq(t):  # inverse
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
+    out = dense_attention(qh, kh, vh, causal=causal)
+    return to_seq(out)
+
+
+def make_sp_attention(axis_name: str, impl: str = "ring"):
+    """Build an ``attn_fn`` for ``MultiHeadAttention`` running under
+    ``shard_map`` with the sequence dimension sharded over ``axis_name``.
+
+    ``impl``: "ring" (arbitrary axis size, O(S_local^2) memory) or "ulysses"
+    (n_head % ws == 0, lowest traffic on ICI).
+    """
+    if impl == "ring":
+        fn = ring_attention
+    elif impl == "ulysses":
+        fn = ulysses_attention
+    else:
+        raise ValueError(f"unknown sequence-parallel impl {impl!r}")
+
+    @functools.wraps(fn)
+    def attn_fn(q, k, v, *, causal: bool = True, mask=None):
+        if mask is not None:
+            raise NotImplementedError(
+                "sequence-parallel attention does not support padding masks "
+                "yet; pad to full blocks or use dense attention"
+            )
+        return fn(q, k, v, axis_name=axis_name, causal=causal)
+
+    return attn_fn
