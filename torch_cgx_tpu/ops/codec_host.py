@@ -1,0 +1,253 @@
+"""Host-side (CPU) max-min quantization codec — numpy, with optional C++ core.
+
+The torch bridge compresses DDP gradient buckets on the host before they hit
+the wire (the reference does this on-GPU with CUDA kernels,
+/root/reference/src/common/compression/cuda_compression_operations.cu — see
+SURVEY.md §2.1). This module implements the SAME wire format as the JAX codec
+(:mod:`torch_cgx_tpu.ops.codec`): per-bucket ``(unit, min)`` meta in the
+input dtype followed by a 32-value-group bit-plane uint32 payload — so wire
+bytes produced here are byte-identical to the JAX codec's (tested in
+``tests/test_codec_host.py``). Decoded floats are bit-identical between the
+numpy and C++ paths and within 1 ulp of the XLA decode (XLA fuses
+``min + unit*level`` into an FMA; the host paths round the product first).
+
+The inner loops (meta scan, level encode, bit-plane pack/unpack) dispatch to
+the native C++ core (:mod:`torch_cgx_tpu.runtime.native`) when its shared
+library has been built, and fall back to vectorized numpy otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+from . import codec as jcodec
+
+LANE_GROUP = jcodec.LANE_GROUP
+
+
+@dataclasses.dataclass
+class HostQTensor:
+    """Host-side quantized buffer; mirrors :class:`codec.QTensor` fields."""
+
+    packed: np.ndarray  # uint32[packed_words(numel_main, bits)]
+    meta: np.ndarray  # dtype[2, num_buckets] — row 0 = unit, row 1 = min
+    residual: np.ndarray  # dtype[res_n]
+    numel: int
+    bits: int
+    bucket_size: int
+    dtype: np.dtype
+
+    @property
+    def numel_main(self) -> int:
+        return self.numel - self.residual.shape[-1]
+
+    def wire_bytes(self) -> int:
+        return (
+            self.packed.nbytes + self.meta.nbytes + self.residual.nbytes
+        )
+
+    # -- flat byte (de)serialization for the wire -------------------------
+    def to_bytes(self) -> np.ndarray:
+        """Concatenate meta | packed | residual into a uint8 vector."""
+        return np.concatenate(
+            [
+                self.meta.reshape(-1).view(np.uint8),
+                self.packed.view(np.uint8),
+                self.residual.view(np.uint8),
+            ]
+        )
+
+
+def wire_layout(
+    n: int, bits: int, bucket_size: int, dtype, skip_incomplete: bool = False
+) -> Tuple[int, int, int, int]:
+    """(meta_bytes, packed_bytes, residual_bytes, total) for an n-value
+    buffer — static given the config, so both wire ends agree without
+    headers (the reference computes the same sizes on both ends,
+    compressor.cc:401-419)."""
+    dtype = np.dtype(dtype)
+    rem = n % bucket_size
+    res_n = rem if (skip_incomplete and rem) else 0
+    main_n = n - res_n
+    nb = jcodec.num_buckets(main_n, bucket_size)
+    meta_b = 2 * nb * dtype.itemsize
+    # The payload packs the bucket-padded level array (nb*bucket_size values,
+    # matching quantize/dequantize), not main_n — they differ when the final
+    # bucket's padding crosses a 32-lane group boundary.
+    packed_b = jcodec.packed_words(nb * bucket_size, bits) * 4 if nb else 0
+    res_b = res_n * dtype.itemsize
+    return meta_b, packed_b, res_b, meta_b + packed_b + res_b
+
+
+def from_bytes(
+    buf: np.ndarray, n: int, bits: int, bucket_size: int, dtype,
+    skip_incomplete: bool = False,
+) -> HostQTensor:
+    """Rehydrate a :class:`HostQTensor` from its wire bytes."""
+    dtype = np.dtype(dtype)
+    meta_b, packed_b, res_b, total = wire_layout(
+        n, bits, bucket_size, dtype, skip_incomplete
+    )
+    assert buf.nbytes >= total, (buf.nbytes, total)
+    buf = np.ascontiguousarray(buf.reshape(-1).view(np.uint8)[:total])
+    nb = meta_b // (2 * dtype.itemsize)
+    meta = buf[:meta_b].view(dtype).reshape(2, nb)
+    packed = buf[meta_b : meta_b + packed_b].view(np.uint32)
+    residual = buf[meta_b + packed_b :].view(dtype)
+    return HostQTensor(
+        packed=packed, meta=meta, residual=residual, numel=n, bits=bits,
+        bucket_size=bucket_size, dtype=dtype,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Bit-plane pack/unpack (numpy mirror of codec.pack_levels/unpack_levels).
+# ---------------------------------------------------------------------------
+
+
+def pack_levels(levels: np.ndarray, bits: int) -> np.ndarray:
+    m = levels.shape[0]
+    if m == 0:
+        return np.zeros((0,), np.uint32)
+    groups = -(-m // LANE_GROUP)
+    padded = np.zeros(groups * LANE_GROUP, np.uint32)
+    padded[:m] = levels
+    g = padded.reshape(groups, LANE_GROUP)
+    lane = np.arange(LANE_GROUP, dtype=np.uint32)[None, :]
+    out = np.empty((groups, bits), np.uint32)
+    for w in range(bits):
+        plane = (g >> np.uint32(w)) & np.uint32(1)
+        out[:, w] = (plane << lane).sum(axis=1, dtype=np.uint32)
+    return out.reshape(-1)
+
+
+def unpack_levels(words: np.ndarray, bits: int, m: int) -> np.ndarray:
+    if m == 0:
+        return np.zeros((0,), np.uint32)
+    groups = -(-m // LANE_GROUP)
+    w2 = words.reshape(groups, bits)
+    lane = np.arange(LANE_GROUP, dtype=np.uint32)[None, :]
+    lvl = np.zeros((groups, LANE_GROUP), np.uint32)
+    for w in range(bits):
+        plane = (w2[:, w : w + 1] >> lane) & np.uint32(1)
+        lvl |= plane << np.uint32(w)
+    return lvl.reshape(-1)[:m]
+
+
+# ---------------------------------------------------------------------------
+# Quantize / dequantize.
+# ---------------------------------------------------------------------------
+
+
+def _native():
+    """The C++ core, or None (lazy import keeps numpy-only installs clean)."""
+    try:
+        from ..runtime import native
+
+        return native if native.available() else None
+    except Exception:
+        return None
+
+
+def quantize(
+    x: np.ndarray,
+    bits: int,
+    bucket_size: int,
+    *,
+    stochastic: bool = False,
+    rng: Optional[np.random.Generator] = None,
+    skip_incomplete_buckets: bool = False,
+) -> HostQTensor:
+    """Quantize a flat host buffer. Matches ``codec.quantize`` bit-for-bit in
+    deterministic mode (stochastic streams differ: numpy PCG64 vs JAX
+    threefry — both honor the same error envelope)."""
+    if not (1 <= bits <= 8):
+        raise ValueError(f"bits must be in 1..8, got {bits}")
+    dtype = np.dtype(x.dtype)
+    flat = np.ascontiguousarray(x.reshape(-1))
+    n = flat.shape[0]
+    rem = n % bucket_size
+    res_n = rem if (skip_incomplete_buckets and rem) else 0
+    main_n = n - res_n
+    residual = flat[main_n:].copy()
+    main = flat[:main_n]
+
+    nb = jcodec.num_buckets(main_n, bucket_size)
+    if nb == 0:
+        return HostQTensor(
+            packed=np.zeros((0,), np.uint32),
+            meta=np.zeros((2, 0), dtype),
+            residual=residual,
+            numel=n, bits=bits, bucket_size=bucket_size, dtype=dtype,
+        )
+
+    nat = _native()
+    if nat is not None and not stochastic and dtype == np.float32:
+        packed, meta32 = nat.quantize_f32(main, bits, bucket_size)
+        return HostQTensor(
+            packed=packed, meta=meta32.astype(dtype), residual=residual,
+            numel=n, bits=bits, bucket_size=bucket_size, dtype=dtype,
+        )
+
+    pad = nb * bucket_size - main_n
+    padded = (
+        np.concatenate([main, np.repeat(main[-1:], pad)]) if pad else main
+    )
+    xb = padded.reshape(nb, bucket_size).astype(np.float32)
+    bmax = xb.max(axis=1)
+    bmin = xb.min(axis=1)
+    unit = (bmax - bmin) / np.float32((1 << bits) - 1)
+    safe = np.where(unit > 0, unit, np.float32(1.0))
+    if stochastic and rng is None:
+        raise ValueError("stochastic rounding requires an rng")
+    r = (
+        rng.random(xb.shape, dtype=np.float32)
+        if stochastic
+        else np.float32(0.5)
+    )
+    lvl = np.floor((xb - bmin[:, None]) / safe[:, None] + r)
+    lvl = np.clip(lvl, 0, (1 << bits) - 1).astype(np.uint32)
+    packed = pack_levels(lvl.reshape(-1), bits)
+    meta = np.stack([unit, bmin]).astype(dtype)
+    return HostQTensor(
+        packed=packed, meta=meta, residual=residual,
+        numel=n, bits=bits, bucket_size=bucket_size, dtype=dtype,
+    )
+
+
+def dequantize(
+    q: HostQTensor,
+    *,
+    add_to: Optional[np.ndarray] = None,
+    out_dtype=None,
+) -> np.ndarray:
+    """Decode back to a flat host buffer (float32 accumulation, like the JAX
+    codec's decompress-with-add)."""
+    if out_dtype is None:
+        out_dtype = add_to.dtype if add_to is not None else q.dtype
+    main_n = q.numel_main
+    nb = jcodec.num_buckets(main_n, q.bucket_size)
+    if nb:
+        nat = _native()
+        if nat is not None and q.meta.dtype == np.float32:
+            vals = nat.dequantize_f32(
+                q.packed, np.ascontiguousarray(q.meta), q.bits,
+                q.bucket_size, main_n,
+            )
+        else:
+            padded_n = nb * q.bucket_size
+            lvl = unpack_levels(q.packed, q.bits, padded_n).reshape(
+                nb, q.bucket_size
+            )
+            unit = q.meta[0].astype(np.float32)[:, None]
+            bmin = q.meta[1].astype(np.float32)[:, None]
+            vals = (bmin + unit * lvl.astype(np.float32)).reshape(-1)[:main_n]
+    else:
+        vals = np.zeros((0,), np.float32)
+    full = np.concatenate([vals, q.residual.astype(np.float32)])
+    if add_to is not None:
+        return (add_to.astype(np.float32) + full).astype(out_dtype)
+    return full.astype(out_dtype)

@@ -1,0 +1,11 @@
+"""Native host runtime: C++ codec core + background executor.
+
+TPU-native counterpart of the reference's native runtime layer (CUDA
+kernels + worker thread, SURVEY.md §2.1): the device compute path is
+Pallas/XLA; this package accelerates host-side staging (torch bridge) and
+provides the async work queue for its futures.
+"""
+
+from . import native
+
+__all__ = ["native"]

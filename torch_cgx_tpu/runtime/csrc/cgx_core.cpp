@@ -1,0 +1,309 @@
+// cgx_core — native host runtime for the TPU-native CGX rebuild.
+//
+// C++ equivalent of the reference's native runtime layer
+// (/root/reference/src/common/compression/cuda_compression_operations.cu —
+// the quantization kernels — and src/ProcessGroupCGX.cc:300-339 — the
+// background worker queue; see SURVEY.md §2.1). This is a from-scratch
+// host implementation: the TPU compute path uses Pallas kernels; this core
+// accelerates the torch-bridge staging path (DDP buckets living in host
+// memory) and provides the async executor the bridge's Work futures ride on.
+//
+// Wire format (identical to torch_cgx_tpu.ops.codec):
+//   * buckets of `bucket_size` values; meta = (unit, min) per bucket,
+//     stored as meta[0][b] = unit, meta[1][b] = min.
+//   * payload = bit-plane packing: values in groups of 32 lanes; a group
+//     occupies `bits` uint32 words; word w holds bit w of all 32 lanes,
+//     lane i at bit position i.
+//
+// Exposed via a plain C ABI for ctypes (no pybind11 in this image).
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int64_t kLaneGroup = 32;
+
+inline int64_t num_buckets(int64_t n, int64_t bucket) {
+  return (n + bucket - 1) / bucket;
+}
+
+inline int64_t num_groups(int64_t n) {
+  return (n + kLaneGroup - 1) / kLaneGroup;
+}
+
+// Quantize one bucket's worth of levels into the caller-provided level
+// buffer (padded region encoded from the edge value, matching the Python
+// codecs' edge-pad semantics).
+void quantize_range(const float* x, int64_t n, int bits, int64_t bucket,
+                    int64_t b0, int64_t b1, uint32_t* levels, float* meta_unit,
+                    float* meta_min) {
+  const float maxlvl = static_cast<float>((1u << bits) - 1u);
+  for (int64_t b = b0; b < b1; ++b) {
+    const int64_t lo = b * bucket;
+    const int64_t hi_real = std::min(lo + bucket, n);
+    float mn = x[lo], mx = x[lo];
+    for (int64_t i = lo + 1; i < hi_real; ++i) {
+      const float v = x[i];
+      mn = v < mn ? v : mn;
+      mx = v > mx ? v : mx;
+    }
+    const float unit = (mx - mn) / maxlvl;
+    // Divide (not multiply-by-reciprocal): keeps levels bit-identical to the
+    // JAX/numpy codecs, whose floor((x-min)/unit + r) this mirrors.
+    const float safe = unit > 0.f ? unit : 1.f;
+    meta_unit[b] = unit;
+    meta_min[b] = mn;
+    const int64_t hi_pad = lo + bucket;
+    const float edge = x[hi_real - 1];
+    for (int64_t i = lo; i < hi_pad; ++i) {
+      const float v = i < hi_real ? x[i] : edge;
+      float lvl = std::floor((v - mn) / safe + 0.5f);
+      lvl = lvl < 0.f ? 0.f : (lvl > maxlvl ? maxlvl : lvl);
+      levels[i] = static_cast<uint32_t>(lvl);
+    }
+  }
+}
+
+void pack_range(const uint32_t* levels, int64_t padded_n, int bits, int64_t g0,
+                int64_t g1, uint32_t* packed) {
+  for (int64_t g = g0; g < g1; ++g) {
+    const uint32_t* lv = levels + g * kLaneGroup;
+    uint32_t* out = packed + g * bits;
+    for (int w = 0; w < bits; ++w) {
+      uint32_t word = 0;
+      for (int64_t lane = 0; lane < kLaneGroup; ++lane) {
+        word |= ((lv[lane] >> w) & 1u) << lane;
+      }
+      out[w] = word;
+    }
+  }
+  (void)padded_n;
+}
+
+void unpack_decode_range(const uint32_t* packed, const float* meta_unit,
+                         const float* meta_min, int bits, int64_t bucket,
+                         int64_t n, int64_t g0, int64_t g1, float* out,
+                         bool add) {
+  for (int64_t g = g0; g < g1; ++g) {
+    const uint32_t* words = packed + g * bits;
+    const int64_t base = g * kLaneGroup;
+    const int64_t lim = std::min(base + kLaneGroup, n);
+    for (int64_t lane = 0; base + lane < lim; ++lane) {
+      uint32_t lvl = 0;
+      for (int w = 0; w < bits; ++w) {
+        lvl |= ((words[w] >> lane) & 1u) << w;
+      }
+      const int64_t i = base + lane;
+      const int64_t b = i / bucket;
+      const float v = meta_min[b] + meta_unit[b] * static_cast<float>(lvl);
+      if (add) {
+        out[i] += v;
+      } else {
+        out[i] = v;
+      }
+    }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Background executor: worker threads draining a job queue, handle-based
+// futures (the reference's runLoop + WorkMPI future, rebuilt host-side).
+// ---------------------------------------------------------------------------
+
+struct Executor {
+  std::vector<std::thread> workers;
+  std::deque<std::pair<uint64_t, std::function<void()>>> queue;
+  std::unordered_map<uint64_t, int> done;  // job id -> 1 done / <0 error
+  std::mutex mu;
+  std::condition_variable cv_work, cv_done;
+  std::atomic<uint64_t> next_id{1};
+  bool stop = false;
+
+  explicit Executor(int nthreads) {
+    for (int t = 0; t < nthreads; ++t) {
+      workers.emplace_back([this] { run(); });
+    }
+  }
+
+  ~Executor() {
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      stop = true;
+    }
+    cv_work.notify_all();
+    for (auto& w : workers) w.join();
+  }
+
+  void run() {
+    for (;;) {
+      std::pair<uint64_t, std::function<void()>> job;
+      {
+        std::unique_lock<std::mutex> lk(mu);
+        cv_work.wait(lk, [this] { return stop || !queue.empty(); });
+        if (stop && queue.empty()) return;
+        job = std::move(queue.front());
+        queue.pop_front();
+      }
+      int status = 1;
+      try {
+        job.second();
+      } catch (...) {
+        status = -1;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu);
+        done[job.first] = status;
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  uint64_t submit(std::function<void()> fn) {
+    const uint64_t id = next_id.fetch_add(1);
+    {
+      std::lock_guard<std::mutex> lk(mu);
+      queue.emplace_back(id, std::move(fn));
+    }
+    cv_work.notify_one();
+    return id;
+  }
+
+  // wait() consumes the completion entry; test() only peeks, so the
+  // isCompleted()-then-wait() pattern of torch Work objects is safe.
+  int wait(uint64_t id) {
+    std::unique_lock<std::mutex> lk(mu);
+    cv_done.wait(lk, [this, id] { return done.count(id) != 0; });
+    const int st = done[id];
+    done.erase(id);
+    return st;
+  }
+
+  int test(uint64_t id) {
+    std::lock_guard<std::mutex> lk(mu);
+    auto it = done.find(id);
+    return it == done.end() ? 0 : it->second;
+  }
+};
+
+void parallel_for(Executor* ex, int64_t begin, int64_t end, int64_t grain,
+                  const std::function<void(int64_t, int64_t)>& body) {
+  const int64_t span = end - begin;
+  if (ex == nullptr || span <= grain) {
+    body(begin, end);
+    return;
+  }
+  const int64_t nchunks = std::min<int64_t>(
+      (span + grain - 1) / grain, static_cast<int64_t>(ex->workers.size()) + 1);
+  const int64_t step = (span + nchunks - 1) / nchunks;
+  std::vector<uint64_t> ids;
+  for (int64_t c = begin + step; c < end; c += step) {
+    const int64_t lo = c, hi = std::min(c + step, end);
+    ids.push_back(ex->submit([&body, lo, hi] { body(lo, hi); }));
+  }
+  body(begin, std::min(begin + step, end));
+  for (uint64_t id : ids) ex->wait(id);
+}
+
+Executor* default_pool() {
+  static Executor pool(
+      std::max(2u, std::thread::hardware_concurrency() / 2) - 1);
+  return &pool;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t cgx_packed_words(int64_t n, int bits) {
+  return num_groups(n) * bits;
+}
+
+int64_t cgx_num_buckets(int64_t n, int64_t bucket) {
+  return num_buckets(n, bucket);
+}
+
+// x: f32[n] -> packed u32[cgx_packed_words(n, bits)], meta f32[2*nb]
+// (meta[0..nb) = unit, meta[nb..2nb) = min). Deterministic rounding.
+void cgx_quantize_f32(const float* x, int64_t n, int32_t bits,
+                      int64_t bucket, uint32_t* packed, float* meta) {
+  const int64_t nb = num_buckets(n, bucket);
+  const int64_t padded_n = nb * bucket;
+  std::vector<uint32_t> levels(static_cast<size_t>(padded_n));
+  float* unit = meta;
+  float* mn = meta + nb;
+  Executor* ex = default_pool();
+  parallel_for(ex, 0, nb, 64, [&](int64_t b0, int64_t b1) {
+    quantize_range(x, n, bits, bucket, b0, b1, levels.data(), unit, mn);
+  });
+  parallel_for(ex, 0, num_groups(padded_n), 2048, [&](int64_t g0, int64_t g1) {
+    pack_range(levels.data(), padded_n, bits, g0, g1, packed);
+  });
+}
+
+// packed + meta -> out f32[n]; add != 0 accumulates into out.
+void cgx_dequantize_f32(const uint32_t* packed, const float* meta,
+                        int32_t bits, int64_t bucket, int64_t n,
+                        float* out, int32_t add) {
+  const int64_t nb = num_buckets(n, bucket);
+  const float* unit = meta;
+  const float* mn = meta + nb;
+  parallel_for(default_pool(), 0, num_groups(n), 2048,
+               [&](int64_t g0, int64_t g1) {
+                 unpack_decode_range(packed, unit, mn, bits, bucket, n, g0,
+                                     g1, out, add != 0);
+               });
+}
+
+// b += a, elementwise f32 (the reference's CUDA_add analogue).
+void cgx_add_f32(const float* a, float* b, int64_t n) {
+  parallel_for(default_pool(), 0, n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) b[i] += a[i];
+  });
+}
+
+// --- async executor handles (for the torch bridge's Work futures) --------
+
+void* cgx_executor_create(int32_t nthreads) {
+  return new Executor(nthreads < 1 ? 1 : nthreads);
+}
+
+void cgx_executor_destroy(void* ex) { delete static_cast<Executor*>(ex); }
+
+uint64_t cgx_submit_quantize_f32(void* ex, const float* x, int64_t n,
+                                 int32_t bits, int64_t bucket,
+                                 uint32_t* packed, float* meta) {
+  return static_cast<Executor*>(ex)->submit([=] {
+    cgx_quantize_f32(x, n, bits, bucket, packed, meta);
+  });
+}
+
+uint64_t cgx_submit_dequantize_f32(void* ex, const uint32_t* packed,
+                                   const float* meta, int32_t bits,
+                                   int64_t bucket, int64_t n, float* out,
+                                   int32_t add) {
+  return static_cast<Executor*>(ex)->submit([=] {
+    cgx_dequantize_f32(packed, meta, bits, bucket, n, out, add);
+  });
+}
+
+// Blocks until the job finishes; returns 1 ok, -1 error.
+int32_t cgx_wait(void* ex, uint64_t id) {
+  return static_cast<Executor*>(ex)->wait(id);
+}
+
+// 0 = pending, 1 = ok, -1 = error (consumes the result when done).
+int32_t cgx_test(void* ex, uint64_t id) {
+  return static_cast<Executor*>(ex)->test(id);
+}
+
+}  // extern "C"
