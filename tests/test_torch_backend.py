@@ -1,0 +1,346 @@
+"""Multi-process integration tests of the ``"cgx"`` torch.distributed
+backend — the rebuild of the reference's test strategy (SURVEY.md §4,
+/root/reference/test/test_cgx.py): real multi-process launches, the
+bit-exactness oracle on constant buckets, the analytic ∞-norm error
+envelope on varying data, and the uncompressed fallback — plus what the
+reference lacks: DDP comm-hook coverage and both reduction algorithms.
+
+The reference launches via ``mpirun``; here each test spawns fresh Python
+processes rendezvousing over a file store (no MPI on TPU hosts).
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+import traceback
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bootstrap(rank: int, ws: int, initfile: str, target_name: str, q):
+    """Child entry: pin JAX to CPU before any import, init the group, run."""
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS", None)
+        sys.path.insert(0, _REPO)
+        import torch.distributed as dist
+        import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+
+        dist.init_process_group(
+            "cgx", init_method=f"file://{initfile}", rank=rank, world_size=ws
+        )
+        target = globals()[target_name]
+        target(rank, ws)
+        dist.barrier()
+        dist.destroy_process_group()
+        q.put((rank, None))
+    except Exception:
+        q.put((rank, traceback.format_exc()))
+        raise
+
+
+def _launch(target, ws: int, timeout: float = 240.0) -> None:
+    initfile = tempfile.mktemp(prefix="cgx_test_store_")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_bootstrap, args=(r, ws, initfile, target.__name__, q)
+        )
+        for r in range(ws)
+    ]
+    for p in procs:
+        p.start()
+    errors = []
+    for _ in range(ws):
+        try:
+            rank, err = q.get(timeout=timeout)
+        except Exception:
+            errors.append("timeout waiting for a rank (possible deadlock)")
+            break
+        if err is not None:
+            errors.append(f"rank {rank}:\n{err}")
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    assert not errors, "\n".join(errors)
+
+
+# ---------------------------------------------------------------------------
+# Worker bodies (run inside spawned ranks).
+# ---------------------------------------------------------------------------
+
+
+def _sum_expect(ws: int) -> float:
+    return float(sum(r + 1 for r in range(ws)))
+
+
+def _check_exact(ws: int, rank: int, algo: str) -> None:
+    """Constant buckets quantize exactly at any bits — allreduce must be
+    bit-exact (reference test_compressed_exact, test_cgx.py:69-78)."""
+    import torch
+    import torch.distributed as dist
+
+    os.environ["CGX_INNER_REDUCTION_TYPE"] = algo
+    for bits in (2, 4, 8):
+        os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = str(bits)
+        for n in (1, 17, 500, 1000, 100_000):
+            for dtype in (torch.float32, torch.bfloat16):
+                t = torch.full((n,), float(rank + 1), dtype=dtype)
+                dist.all_reduce(t)
+                want = torch.full((n,), _sum_expect(ws), dtype=dtype)
+                assert torch.equal(t, want), (algo, bits, n, dtype, t[:4])
+    os.environ.pop("CGX_INNER_REDUCTION_TYPE")
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
+
+
+def _check_envelope(ws: int, rank: int, algo: str) -> None:
+    """Varying data honors the analytic error envelope
+    (reference test_compressed_non_exact, test_cgx.py:80-93)."""
+    import torch
+    import torch.distributed as dist
+
+    os.environ["CGX_INNER_REDUCTION_TYPE"] = algo
+    for bits in (2, 4, 8):
+        for bucket in (64, 512, 2048):
+            os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = str(bits)
+            os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = str(bucket)
+            for n in (128, 4096, 100_000):
+                x = torch.arange(n, dtype=torch.float32) / n * (rank + 1)
+                exact = (
+                    torch.arange(n, dtype=torch.float32) / n * _sum_expect(ws)
+                )
+                t = x.clone()
+                dist.all_reduce(t)
+                err = (t - exact).abs().max().item()
+                # Per-rank bucket range <= (rank+1)*min(bucket,n)/n; one
+                # quantization per contribution plus the requant step gives
+                # the reference's ws*(ws+1)-shaped envelope, scaled to this
+                # data's magnitude.
+                bound = (
+                    2 * min(bucket, n) / (2**bits - 1) * ws * (ws + 1) / n
+                )
+                assert err < bound, (algo, bits, bucket, n, err, bound)
+    for k in (
+        "CGX_INNER_REDUCTION_TYPE",
+        "CGX_COMPRESSION_QUANTIZATION_BITS",
+        "CGX_COMPRESSION_BUCKET_SIZE",
+    ):
+        os.environ.pop(k)
+
+
+def _worker_collectives(rank: int, ws: int) -> None:
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+
+    _check_exact(ws, rank, "SRA")
+    _check_exact(ws, rank, "RING")
+    _check_envelope(ws, rank, "SRA")
+    _check_envelope(ws, rank, "RING")
+
+    # Debug all-to-all reduction (CGX_DEBUG_ALL_TO_ALL_REDUCTION analogue).
+    os.environ["CGX_DEBUG_ALL_TO_ALL_REDUCTION"] = "1"
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+    t = torch.full((5000,), float(rank + 1))
+    dist.all_reduce(t)
+    assert torch.equal(t, torch.full((5000,), _sum_expect(ws)))
+    os.environ.pop("CGX_DEBUG_ALL_TO_ALL_REDUCTION")
+
+    # Dummy (pass-through) compression must be exact on any data.
+    os.environ["CGX_DEBUG_DUMMY_COMPRESSION"] = "1"
+    x = torch.linspace(-3, 7, 4096) * (rank + 1)
+    exact = torch.linspace(-3, 7, 4096) * _sum_expect(ws)
+    t = x.clone()
+    dist.all_reduce(t)
+    assert torch.allclose(t, exact, atol=1e-4), (t - exact).abs().max()
+    os.environ.pop("CGX_DEBUG_DUMMY_COMPRESSION")
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
+
+    # Uncompressed fallback: bits=32 (default) floats stay exact, ints sum.
+    x = torch.linspace(-1, 1, 1000) * (rank + 1)
+    t = x.clone()
+    dist.all_reduce(t)
+    assert torch.allclose(t, torch.linspace(-1, 1, 1000) * _sum_expect(ws))
+    ti = torch.full((64,), rank + 1, dtype=torch.int64)
+    dist.all_reduce(ti)
+    assert ti[0].item() == int(_sum_expect(ws))
+
+    # MIN / MAX / PRODUCT ops take the plain path.
+    t = torch.full((10,), float(rank + 1))
+    dist.all_reduce(t, op=dist.ReduceOp.MAX)
+    assert t[0].item() == ws
+    t = torch.full((10,), float(rank + 1))
+    dist.all_reduce(t, op=dist.ReduceOp.MIN)
+    assert t[0].item() == 1.0
+
+    # broadcast / allgather / gather / scatter / alltoall / send-recv.
+    tb = torch.arange(8, dtype=torch.float32) if rank == 0 else torch.zeros(8)
+    dist.broadcast(tb, src=0)
+    assert torch.equal(tb, torch.arange(8, dtype=torch.float32))
+
+    gathered = [torch.zeros(4) for _ in range(ws)]
+    dist.all_gather(gathered, torch.full((4,), float(rank)))
+    for j in range(ws):
+        assert torch.equal(gathered[j], torch.full((4,), float(j)))
+
+    gl = [torch.zeros(3) for _ in range(ws)] if rank == 0 else None
+    dist.gather(torch.full((3,), float(rank + 10)), gl, dst=0)
+    if rank == 0:
+        for j in range(ws):
+            assert torch.equal(gl[j], torch.full((3,), float(j + 10)))
+
+    out = torch.zeros(2)
+    sl = [torch.full((2,), float(j)) for j in range(ws)] if rank == 0 else None
+    dist.scatter(out, sl, src=0)
+    assert torch.equal(out, torch.full((2,), float(rank)))
+
+    outs = [torch.zeros(2) for _ in range(ws)]
+    ins = [torch.full((2,), float(rank * ws + j)) for j in range(ws)]
+    dist.all_to_all(outs, ins)
+    for j in range(ws):
+        assert torch.equal(outs[j], torch.full((2,), float(j * ws + rank)))
+
+    if ws >= 2:
+        if rank == 0:
+            dist.send(torch.arange(5, dtype=torch.float32), dst=1)
+        elif rank == 1:
+            r = torch.zeros(5)
+            dist.recv(r, src=0)
+            assert torch.equal(r, torch.arange(5, dtype=torch.float32))
+    dist.barrier()
+
+    # reduce to root.
+    t = torch.full((6,), float(rank + 1))
+    dist.reduce(t, dst=0)
+    if rank == 0:
+        assert torch.equal(t, torch.full((6,), _sum_expect(ws)))
+
+    # Stochastic rounding stays within the envelope and remains exact on
+    # constants-in-expectation is not testable cheaply; check envelope only.
+    os.environ["CGX_STOCHASTIC_ROUNDING"] = "1"
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+    n, bucket = 4096, 512
+    x = torch.arange(n, dtype=torch.float32) / n * (rank + 1)
+    exact = torch.arange(n, dtype=torch.float32) / n * _sum_expect(ws)
+    t = x.clone()
+    dist.all_reduce(t)
+    err = (t - exact).abs().max().item()
+    bound = 2 * min(bucket, n) / (2**4 - 1) * ws * (ws + 1) / n
+    assert 0 < err < bound, (err, bound)
+    os.environ.pop("CGX_STOCHASTIC_ROUNDING")
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
+
+    # Per-layer registry: one bucket, two layers with different configs —
+    # the framed wire applies each layer's own bits.
+    from torch_cgx_tpu import config as cfg
+
+    cfg.clear_registry()
+    cfg.register_layer(0, 0, 3000, 2, 256)
+    cfg.register_layer(0, 1, 1096, 32, 0)  # uncompressed layer
+    x = torch.cat(
+        [
+            torch.full((3000,), float(rank + 1)),
+            torch.linspace(-1, 1, 1096) * (rank + 1),
+        ]
+    )
+    t = x.clone()
+    dist.all_reduce(t)
+    assert torch.equal(t[:3000], torch.full((3000,), _sum_expect(ws)))
+    assert torch.allclose(
+        t[3000:], torch.linspace(-1, 1, 1096) * _sum_expect(ws), atol=1e-5
+    ), "uncompressed layer must be exact"
+    cfg.clear_registry()
+
+
+def _worker_ddp(rank: int, ws: int) -> None:
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+    import torch_cgx_tpu.torch_backend as tb
+    from torch_cgx_tpu import config as cfg
+
+    torch.manual_seed(1234)
+    model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 10))
+    ddp = nn.parallel.DistributedDataParallel(model)
+    state = tb.CGXState(
+        None,
+        compression_params={"bits": 4, "bucket_size": 512},
+        layer_min_size=64,
+    )
+    ddp.register_comm_hook(state, tb.cgx_hook)
+    opt = torch.optim.SGD(ddp.parameters(), lr=0.05)
+    loss_fn = nn.CrossEntropyLoss()
+    torch.manual_seed(100 + rank)  # rank-local data
+    for _ in range(8):
+        x = torch.randn(16, 32)
+        y = torch.randint(0, 10, (16,))
+        opt.zero_grad()
+        loss_fn(ddp(x), y).backward()
+        opt.step()
+
+    # Registration happened at step 2 with the stabilized bucket layout.
+    assert cfg.registered_buckets(), "no layers registered by cgx_hook"
+    n_layers = sum(
+        len(cfg.registered_layer_sizes(b)) for b in cfg.registered_buckets()
+    )
+    assert n_layers == 4, n_layers  # 2 weights + 2 biases
+    bits = sorted(
+        cfg.get_layer_config((b, i)).bits
+        for b in cfg.registered_buckets()
+        for i in range(len(cfg.registered_layer_sizes(b)))
+    )
+    assert bits[0] == 4 and bits[-1] == 32, bits  # weights 4-bit, biases raw
+
+    # Replicas must stay bit-identical (quantized allreduce is symmetric).
+    for p in ddp.parameters():
+        buf = [torch.zeros_like(p) for _ in range(ws)]
+        dist.all_gather(buf, p.detach())
+        for b in buf[1:]:
+            assert torch.equal(b, buf[0]), "replicas diverged"
+
+
+def _worker_unsupported(rank: int, ws: int) -> None:
+    import torch
+    import torch.distributed as dist
+
+    inp = torch.ones(2 * ws)
+    out = torch.zeros(2)
+    try:
+        dist.reduce_scatter_tensor(out, inp)
+        raise AssertionError("reduce_scatter should be unsupported")
+    except (NotImplementedError, RuntimeError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# Tests.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.torch_bridge
+def test_collectives_ws2():
+    _launch(_worker_collectives, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_collectives_ws4():
+    _launch(_worker_collectives, ws=4, timeout=360.0)
+
+
+@pytest.mark.torch_bridge
+def test_ddp_training_ws2():
+    _launch(_worker_ddp, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_unsupported_ops_ws2():
+    _launch(_worker_unsupported, ws=2)

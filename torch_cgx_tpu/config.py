@@ -124,7 +124,7 @@ def default_compression_config() -> CompressionConfig:
         skip_incomplete_buckets=_env.get_bool_env_or_default(
             COMPRESSION_SKIP_INCOMPLETE_BUCKETS, False
         ),
-        stochastic=_env.get_bool_env_or_default(STOCHASTIC_ROUNDING, False),
+        stochastic=stochastic_rounding(),
     )
 
 
@@ -278,6 +278,17 @@ def get_layer_config(layer_id: LayerId) -> CompressionConfig:
 
 def registered_layer_sizes(bucket_idx: int) -> Optional[list]:
     return _layer_sizes.get(bucket_idx)
+
+
+def registered_buckets() -> list:
+    """Bucket indices with registered layer sizes (torch bridge lookup)."""
+    return list(_layer_sizes.keys())
+
+
+def stochastic_rounding() -> bool:
+    """Env-level QSGD switch (the reference's compile-time
+    ``QSGD_DETERMENISTIC`` inverse, gpu_rand.h:52-58)."""
+    return _env.get_bool_env_or_default(STOCHASTIC_ROUNDING, False)
 
 
 def set_layer_pattern_config(pattern: str, config: CompressionConfig) -> None:

@@ -1,0 +1,34 @@
+"""Torch bridge: ``import torch_cgx_tpu.torch_backend`` registers the
+``"cgx"`` torch.distributed backend (the import-time side effect mirrors the
+reference's static constructor, ProcessGroupCGX.h:258-263), after which
+
+    dist.init_process_group("cgx", ...)
+    model = DistributedDataParallel(model)
+    state = CGXState(None, compression_params={"bits": 4, "bucket_size": 1024})
+    model.register_comm_hook(state, cgx_hook)
+
+works as a drop-in for the reference's ``torch_cgx`` module. The per-layer
+setters are re-exported here for parity with the reference pybind surface
+(ProcessGroupCGX.cc:852-857).
+"""
+
+from ..config import (  # noqa: F401 — parity re-exports
+    register_layer,
+    set_quantization_bits,
+    set_quantization_bucket_size,
+)
+from .backend import BACKEND_NAME, ProcessGroupCGX, register_backend
+from .hooks import CGXState, cgx_hook
+
+register_backend()
+
+__all__ = [
+    "BACKEND_NAME",
+    "ProcessGroupCGX",
+    "register_backend",
+    "CGXState",
+    "cgx_hook",
+    "register_layer",
+    "set_quantization_bits",
+    "set_quantization_bucket_size",
+]

@@ -1,0 +1,810 @@
+"""Pure-Python ``torch.distributed`` backend ``"cgx"``.
+
+Re-expression of the reference's c10d ProcessGroup extension
+(/root/reference/src/ProcessGroupCGX.{h,cc} — SURVEY.md §2.1, §3.2) without
+MPI/CUDA: the *architecture* is preserved —
+
+* a c10d ``ProcessGroup`` registered under the backend name ``"cgx"``
+  (reference registers at import via ``__attribute__((constructor))``,
+  ProcessGroupCGX.h:258-263; here :func:`register_backend` at module import),
+* a single background **worker thread** consuming a FIFO queue of work
+  entries and completing futures (the ``runLoop`` model,
+  ProcessGroupCGX.cc:300-339),
+* ``allreduce`` with a **quantized SRA/Ring path** for eligible float SUM
+  buffers and a plain fallback otherwise (ProcessGroupCGX.cc:369-420),
+* per-layer compression configs resolved from the registry filled by
+  ``register_layer`` (ProcessGroupCGX.cc:837-857), applied with
+  fusion-aware **per-layer framing** of each wire chunk
+  (compressor.cc:62-179),
+* the requantize + self-dequantize **error-symmetry step** on the reduced
+  chunk (scatter_reduce_allgather.cc:157-160) so exactness oracles hold,
+* thin uncompressed wrappers for broadcast / allgather / gather / scatter /
+  alltoall / send / recv / barrier (ProcessGroupCGX.cc:341-833), and
+* NotImplementedError on ``reduce_scatter`` / ``_allgather_base`` /
+  ``_reduce_scatter_base`` exactly like the reference
+  (ProcessGroupCGX.cc:422-428,494-501,631-636,827-833).
+
+What is *not* preserved (deliberately — SURVEY.md §7 stance): the transport.
+MPI point-to-point + SHM/CUDA-IPC (L2/L0) collapse into the c10d **Store**
+the process group is constructed with: puts/gets of compressed byte
+payloads, with refcounted key GC. On a TPU host the heavy compute path is
+the JAX-native front end; this bridge exists for drop-in
+``torch.distributed`` compatibility, so its transport favors portability
+(any Store: TCP, file) over raw bandwidth, while the codec — the actual
+CPU work — runs in the native C++ core when built.
+
+The codec math and wire format are byte-identical to the JAX/Pallas codec
+(``ops/codec_host.py``), so a payload compressed here decodes on the TPU
+path and vice versa.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import queue as _queue
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+import torch
+import torch.distributed as dist
+from torch._C._distributed_c10d import _create_work_from_future
+from torch.futures import Future
+
+from .. import config as cfg
+from ..ops import codec_host as hcodec
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+BACKEND_NAME = "cgx"
+_ALIGN = 8  # element alignment of chunk splits (reference utils.h ALIGNMENT_UNIT)
+
+_TORCH_FLOATS = (torch.float32, torch.float16, torch.bfloat16)
+
+# torch dtype <-> numpy dtype for the uncompressed wire (bf16 goes through
+# its raw uint16 bit pattern; numpy has no native bfloat16).
+_NP_OF_TORCH = {
+    torch.float32: np.float32,
+    torch.float64: np.float64,
+    torch.float16: np.float16,
+    torch.int32: np.int32,
+    torch.int64: np.int64,
+    torch.int16: np.int16,
+    torch.int8: np.int8,
+    torch.uint8: np.uint8,
+    torch.bool: np.bool_,
+}
+
+
+def _to_np(t: torch.Tensor) -> np.ndarray:
+    """Host copy of a tensor as a flat numpy array (bf16 -> f32, exact)."""
+    t = t.detach()
+    if t.dtype == torch.bfloat16:
+        return t.to(torch.float32).numpy().reshape(-1)
+    return t.numpy().reshape(-1).copy()
+
+
+def _from_np(t: torch.Tensor, a: np.ndarray) -> None:
+    """Write a flat numpy array back into tensor t (any float narrowing is
+    done by torch, matching how the reference writes reduced fp16)."""
+    with torch.no_grad():
+        src = torch.from_numpy(np.ascontiguousarray(a))
+        t.detach().reshape(-1).copy_(src.to(t.dtype))
+
+
+# ---------------------------------------------------------------------------
+# Per-layer framed codec: one wire chunk carries multiple independently
+# configured layer segments (reference compressor.cc:62-179).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _Segment:
+    """A [start, start+numel) slice of the fused buffer with its resolved
+    compression config (the reference's per-layer slice of a chunk)."""
+
+    start: int
+    numel: int
+    bits: int
+    bucket_size: int
+
+
+def _segments_in(
+    layers: Sequence[Tuple[int, int, cfg.CompressionConfig]],
+    lo: int,
+    hi: int,
+) -> List[_Segment]:
+    """Intersect fused-coordinate layers with the chunk [lo, hi)."""
+    out = []
+    for start, numel, c in layers:
+        s, e = max(start, lo), min(start + numel, hi)
+        if s < e:
+            out.append(_Segment(s, e - s, c.bits, c.bucket_size))
+    return out
+
+
+def _frames_nbytes(segs: Sequence[_Segment], dummy: bool) -> int:
+    if dummy:
+        return sum(s.numel for s in segs) * 4
+    return sum(
+        hcodec.wire_layout(s.numel, s.bits, s.bucket_size, np.float32)[3]
+        for s in segs
+    )
+
+
+def _compress_frames(
+    fused: np.ndarray, segs: Sequence[_Segment], dummy: bool,
+    rng: Optional[np.random.Generator],
+) -> bytes:
+    """Concatenated per-segment wire frames. Frame sizes are a pure function
+    of (numel, bits, bucket) so the receiver needs no header."""
+    parts: List[np.ndarray] = []
+    for s in segs:
+        x = fused[s.start : s.start + s.numel]
+        if dummy:
+            parts.append(np.ascontiguousarray(x, np.float32).view(np.uint8))
+        else:
+            q = hcodec.quantize(
+                np.ascontiguousarray(x, np.float32), s.bits, s.bucket_size,
+                stochastic=rng is not None, rng=rng,
+            )
+            parts.append(q.to_bytes())
+    if not parts:
+        return b""
+    return np.concatenate(parts).tobytes()
+
+
+def _decompress_frames(
+    buf: np.ndarray, segs: Sequence[_Segment], fused: np.ndarray,
+    dummy: bool, add: bool,
+) -> None:
+    """Decode frames into the fused buffer at their segment positions,
+    accumulating (round 1) or assigning (allgather round)."""
+    off = 0
+    for s in segs:
+        sl = slice(s.start, s.start + s.numel)
+        if dummy:
+            nb = s.numel * 4
+            vals = buf[off : off + nb].view(np.float32)
+            off += nb
+        else:
+            nb = hcodec.wire_layout(s.numel, s.bits, s.bucket_size, np.float32)[3]
+            q = hcodec.from_bytes(
+                buf[off : off + nb], s.numel, s.bits, s.bucket_size, np.float32
+            )
+            vals = hcodec.dequantize(q)
+            off += nb
+        if add:
+            fused[sl] += vals
+        else:
+            fused[sl] = vals
+
+
+def _chunk_split(n: int, ws: int) -> Tuple[List[int], List[int]]:
+    """Aligned greedy split of n elements into ws chunks (the analogue of
+    Quantizer::GetSizesAndOffsets, compressor.cc:265-299): every chunk but
+    the last is a multiple of 8 elements; trailing chunks may be empty."""
+    per = -(-n // ws)
+    per = -(-per // _ALIGN) * _ALIGN
+    sizes, offs, used = [], [], 0
+    for _ in range(ws):
+        offs.append(used)
+        take = min(per, n - used)
+        sizes.append(take)
+        used += take
+    return sizes, offs
+
+
+# ---------------------------------------------------------------------------
+# The process group.
+# ---------------------------------------------------------------------------
+
+
+class ProcessGroupCGX(dist.ProcessGroup):
+    """Store-transport c10d process group with quantized allreduce.
+
+    Single-tensor ops only, like the reference (ProcessGroupCGX.cc:91-97).
+    """
+
+    def __init__(self, store, rank: int, size: int, timeout=None):
+        super().__init__(rank, size)
+        self._store = store
+        self._rank = rank
+        self._size = size
+        self._seq = 0  # collective sequence number (issued on calling thread)
+        self._p2p_send = {}  # (dst, tag) -> count
+        self._p2p_recv = {}  # (src, tag) -> count
+        self._bucket_cursor = 0
+        self._rng: Optional[np.random.Generator] = None
+        # runLoop analogue (ProcessGroupCGX.cc:300-339): one worker thread
+        # drains a FIFO of work entries and completes their futures.
+        self._jobs: _queue.Queue = _queue.Queue()
+        self._shutdown = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run_loop, name="cgx-worker", daemon=True
+        )
+        self._worker.start()
+
+    # -- worker loop ------------------------------------------------------
+
+    def _run_loop(self) -> None:
+        while not self._shutdown.is_set():
+            try:
+                item = self._jobs.get(timeout=0.1)
+            except _queue.Empty:
+                continue
+            fn, fut, result = item
+            try:
+                fn()
+                fut.set_result(result)
+            except Exception as e:  # failed future, like finishWorkMPIError
+                try:
+                    fut.set_exception(e)
+                except Exception:
+                    log.error("work failed after future done: %s", e)
+
+    def _submit(self, fn, result) -> dist.Work:
+        fut = Future()
+        self._jobs.put((fn, fut, result))
+        return _create_work_from_future(fut)
+
+    def _done(self, result) -> dist.Work:
+        fut = Future()
+        fut.set_result(result)
+        return _create_work_from_future(fut)
+
+    # -- store transport --------------------------------------------------
+
+    def _next_seq(self) -> int:
+        self._seq += 1
+        return self._seq
+
+    def _put(self, key: str, data) -> None:
+        self._store.set(key, bytes(data) if not isinstance(data, bytes) else data)
+
+    def _take(self, key: str, readers: int = 1) -> np.ndarray:
+        """Blocking get + refcounted delete once all readers have read."""
+        data = self._store.get(key)
+        try:
+            if readers <= 1:
+                self._store.delete_key(key)
+            elif int(self._store.add(key + "/ack", 1)) >= readers:
+                self._store.delete_key(key + "/ack")
+                self._store.delete_key(key)
+        except Exception:
+            pass  # store without delete support: keys just persist
+        return np.frombuffer(data, np.uint8)
+
+    # -- config -----------------------------------------------------------
+
+    def _stochastic_rng(self) -> Optional[np.random.Generator]:
+        if not cfg.stochastic_rounding():
+            return None
+        if self._rng is None:
+            self._rng = np.random.default_rng(
+                (cfg.global_seed() << 16) ^ (self._rank + 1)
+            )
+        return self._rng
+
+    def _extract_layers(
+        self, numel: int
+    ) -> List[Tuple[int, int, cfg.CompressionConfig]]:
+        """(offset, numel, resolved config) per layer of this bucket.
+
+        The reference tracks a rotating ``bucket_idx_`` and slices the DDP
+        bucket by the registered per-layer sizes
+        (mpi_allreduce_operations.cc:257-285). We match the current buffer
+        against the registry by total element count, starting at the
+        expected cursor position; unregistered buffers are one layer with
+        the env-default config.
+        """
+        buckets = sorted(cfg.registered_buckets())
+        match = None
+        for probe in range(len(buckets)):
+            idx = buckets[(self._bucket_cursor + probe) % len(buckets)]
+            sizes = cfg.registered_layer_sizes(idx)
+            if sizes and sum(sizes) == numel:
+                match = (idx, sizes)
+                self._bucket_cursor = (
+                    (self._bucket_cursor + probe + 1) % len(buckets)
+                )
+                break
+        if match is None:
+            return [(0, numel, cfg.default_compression_config())]
+        idx, sizes = match
+        out, off = [], 0
+        for li, n in enumerate(sizes):
+            out.append((off, n, cfg.get_layer_config((idx, li))))
+            off += n
+        return out
+
+    # -- allreduce --------------------------------------------------------
+
+    def allreduce(self, tensors, opts=None):
+        self._check_single(tensors)
+        t = tensors[0]
+        op = opts.reduceOp if opts is not None else dist.ReduceOp.SUM
+        seq = self._next_seq()
+        do_compress = (
+            t.dtype in _TORCH_FLOATS
+            and op == dist.ReduceOp.SUM
+            and self._size > 1
+        )
+
+        def run():
+            if self._size == 1:
+                return
+            if do_compress:
+                self._allreduce_quantized(t, seq)
+            else:
+                self._allreduce_plain(t, op, seq)
+
+        return self._submit(run, tensors)
+
+    def _allreduce_quantized(self, t: torch.Tensor, seq: int) -> None:
+        # Per-layer partition into compress / no-compress, exactly the
+        # orchestrator's split (mpi_allreduce_operations.cc:240-247):
+        # enabled config AND numel above the minimal size.
+        layers = self._extract_layers(t.numel())
+        minimal = cfg.minimal_size()
+        arr = _to_np(t).astype(np.float32, copy=False)
+        comp = [(o, n, c) for (o, n, c) in layers if c.enabled and n >= minimal]
+        rest = [(o, n, c) for (o, n, c) in layers if not (c.enabled and n >= minimal)]
+
+        if rest:
+            idx = np.concatenate(
+                [np.arange(o, o + n) for (o, n, _) in rest]
+            )
+            part = arr[idx]
+            self._sum_alltoall(part, np.float32, f"cgx{seq}u")
+            arr[idx] = part
+        if comp:
+            idx = np.concatenate(
+                [np.arange(o, o + n) for (o, n, _) in comp]
+            )
+            fused = np.ascontiguousarray(arr[idx])
+            # Re-base layer offsets into fused coordinates.
+            fl, off = [], 0
+            for (_, n, c) in comp:
+                fl.append((off, n, c))
+                off += n
+            # Flat (single-level) bridge: the "inner" reduction choice
+            # applies, like a one-node reference run
+            # (mpi_allreduce_operations.cc:70-94).
+            algo = cfg.topology_from_env().intra_reduction
+            if algo == cfg.REDUCTION_ALLTOALL:
+                self._qreduce_alltoall(fused, fl, f"cgx{seq}q")
+            elif algo == cfg.REDUCTION_RING:
+                self._qreduce_ring(fused, fl, f"cgx{seq}q")
+            else:
+                self._qreduce_sra(fused, fl, f"cgx{seq}q")
+            arr[idx] = fused
+        _from_np(t, arr)
+
+    def _qreduce_sra(self, fused, layers, pfx) -> None:
+        """Quantized Scatter-Reduce-AllGather over the store — the flagship
+        algorithm (scatter_reduce_allgather.cc:94-202). Empty chunks travel
+        as empty payloads, so no rank ever skips a matching put/take."""
+        ws, me = self._size, self._rank
+        dummy = cfg.dummy_compression()
+        rng = self._stochastic_rng()
+        sizes, offs = _chunk_split(fused.shape[0], ws)
+        segs = [
+            _segments_in(layers, offs[r], offs[r] + sizes[r]) for r in range(ws)
+        ]
+        # Round 1: compress each peer's chunk and post it (ISend analogue).
+        for j in range(ws):
+            if j != me:
+                self._put(
+                    f"{pfx}/s{me}>{j}", _compress_frames(fused, segs[j], dummy, rng)
+                )
+        # Accumulate peers into our own chunk (TestRecv + decompress-add).
+        for j in range(ws):
+            if j != me:
+                buf = self._take(f"{pfx}/s{j}>{me}")
+                _decompress_frames(buf, segs[me], fused, dummy, add=True)
+        # Requantize the reduced chunk, then self-dequantize so every replica
+        # carries the identical quantization error
+        # (scatter_reduce_allgather.cc:157-160 — load-bearing for the
+        # bit-exactness oracle).
+        wire = _compress_frames(fused, segs[me], dummy, rng)
+        _decompress_frames(
+            np.frombuffer(wire, np.uint8), segs[me], fused, dummy, add=False
+        )
+        self._put(f"{pfx}/g{me}", wire)
+        # Round 2: gather every reduced chunk (allgather).
+        for j in range(ws):
+            if j != me:
+                buf = self._take(f"{pfx}/g{j}", readers=ws - 1)
+                _decompress_frames(buf, segs[j], fused, dummy, add=False)
+
+    def _qreduce_ring(self, fused, layers, pfx) -> None:
+        """Quantized ring: N-1 scatter-reduce steps then N-1 allgather steps
+        (ring.cc:139-226). Scatter-reduce requantizes each outgoing segment;
+        the allgather circulates reduced wire payloads unchanged (one
+        quantization per reduced chunk, no per-hop drift)."""
+        ws, me = self._size, self._rank
+        dummy = cfg.dummy_compression()
+        rng = self._stochastic_rng()
+        sizes, offs = _chunk_split(fused.shape[0], ws)
+        segs = [
+            _segments_in(layers, offs[r], offs[r] + sizes[r]) for r in range(ws)
+        ]
+        right = (me + 1) % ws
+        for step in range(ws - 1):
+            s_idx = (me - step) % ws  # chunk we send rightward
+            r_idx = (me - step - 1) % ws  # chunk we receive + reduce
+            self._put(
+                f"{pfx}/r{step}>{right}",
+                _compress_frames(fused, segs[s_idx], dummy, rng),
+            )
+            buf = self._take(f"{pfx}/r{step}>{me}")
+            _decompress_frames(buf, segs[r_idx], fused, dummy, add=True)
+        # Our fully-reduced chunk is (me+1) % ws; requantize + self-dequantize
+        # it once (error symmetry, ring.cc:190-199), then circulate.
+        hold = _compress_frames(fused, segs[(me + 1) % ws], dummy, rng)
+        _decompress_frames(
+            np.frombuffer(hold, np.uint8), segs[(me + 1) % ws], fused, dummy,
+            add=False,
+        )
+        for step in range(ws - 1):
+            r_idx = (me - step) % ws  # chunk arriving this step
+            self._put(f"{pfx}/a{step}>{right}", hold)
+            buf = self._take(f"{pfx}/a{step}>{me}")
+            _decompress_frames(buf, segs[r_idx], fused, dummy, add=False)
+            hold = buf.tobytes()  # forward verbatim next step
+
+    def _qreduce_alltoall(self, fused, layers, pfx) -> None:
+        """Debug all-to-all: compress once, everyone sums everything
+        (CGX_DEBUG_ALL_TO_ALL_REDUCTION, scatter_reduce_allgather.cc:269-306)."""
+        ws, me = self._size, self._rank
+        dummy = cfg.dummy_compression()
+        rng = self._stochastic_rng()
+        segs = _segments_in(layers, 0, fused.shape[0])
+        wire = _compress_frames(fused, segs, dummy, rng)
+        self._put(f"{pfx}/x{me}", wire)
+        # Decode own wire too so every rank sums identical quantized terms.
+        _decompress_frames(
+            np.frombuffer(wire, np.uint8), segs, fused, dummy, add=False
+        )
+        for j in range(ws):
+            if j == me:
+                continue
+            buf = self._take(f"{pfx}/x{j}", readers=ws - 1)
+            _decompress_frames(buf, segs, fused, dummy, add=True)
+
+    def _sum_alltoall(self, arr: np.ndarray, np_dtype, pfx: str) -> None:
+        """Uncompressed small-slice reduction: full exchange + local sum
+        (Reducer::AllReduceAlltoAll, reducer.cc:35-94)."""
+        ws, me = self._size, self._rank
+        self._put(f"{pfx}/{me}", arr.astype(np_dtype, copy=False).tobytes())
+        for j in range(ws):
+            if j == me:
+                continue
+            buf = self._take(f"{pfx}/{j}", readers=ws - 1)
+            arr += buf.view(np_dtype)
+
+    def _allreduce_plain(self, t: torch.Tensor, op, seq: int) -> None:
+        """Non-eligible dtypes/ops: exchange raw buffers, reduce locally
+        (the reference's MPI_Allreduce fallback, ProcessGroupCGX.cc:408-413)."""
+        ws, me = self._size, self._rank
+        if t.dtype == torch.bfloat16:
+            self._put(f"cgx{seq}p/{me}", self._bytes_of(t))
+            parts = [t.detach().reshape(-1).clone()]
+            for j in range(ws):
+                if j == me:
+                    continue
+                buf = self._take(f"cgx{seq}p/{j}", readers=ws - 1)
+                parts.append(
+                    torch.from_numpy(buf.copy()).view(torch.bfloat16)
+                )
+            stack = torch.stack([p.to(torch.float32) for p in parts])
+        else:
+            np_dtype = _NP_OF_TORCH[t.dtype]
+            arr = _to_np(t)
+            self._put(f"cgx{seq}p/{me}", arr.tobytes())
+            parts = [torch.from_numpy(arr)]
+            for j in range(ws):
+                if j == me:
+                    continue
+                buf = self._take(f"cgx{seq}p/{j}", readers=ws - 1)
+                parts.append(torch.from_numpy(buf.view(np_dtype).copy()))
+            stack = torch.stack(parts)
+        if op == dist.ReduceOp.SUM:
+            red = stack.sum(dim=0)
+        elif op == dist.ReduceOp.PRODUCT:
+            red = stack.prod(dim=0)
+        elif op == dist.ReduceOp.MIN:
+            red = stack.min(dim=0).values
+        elif op == dist.ReduceOp.MAX:
+            red = stack.max(dim=0).values
+        else:
+            raise NotImplementedError(f"cgx: unsupported reduce op {op}")
+        with torch.no_grad():
+            t.detach().reshape(-1).copy_(red.to(t.dtype))
+
+    # -- plain collectives (thin wrappers, ProcessGroupCGX.cc:341-833) ----
+
+    def _check_single(self, tensors) -> None:
+        if len(tensors) != 1:
+            raise RuntimeError(
+                "cgx backend supports single-tensor operations only "
+                "(reference ProcessGroupCGX.cc:91-97)"
+            )
+
+    def _bytes_of(self, t: torch.Tensor) -> bytes:
+        return t.detach().contiguous().reshape(-1).view(torch.uint8).numpy().tobytes()
+
+    def _tensor_from(self, buf: np.ndarray, like: torch.Tensor) -> torch.Tensor:
+        return torch.from_numpy(buf.copy()).view(like.dtype).reshape(like.shape)
+
+    def broadcast(self, tensors, opts=None):
+        self._check_single(tensors)
+        t = tensors[0]
+        root = int(opts.rootRank) if opts is not None else 0
+        seq = self._next_seq()
+
+        def run():
+            if self._size == 1:
+                return
+            key = f"cgx{seq}b"
+            if self._rank == root:
+                self._put(key, self._bytes_of(t))
+            else:
+                buf = self._take(key, readers=self._size - 1)
+                with torch.no_grad():
+                    t.copy_(self._tensor_from(buf, t))
+
+        return self._submit(run, tensors)
+
+    def allgather(self, output_tensors, input_tensors, opts=None):
+        self._check_single(input_tensors)
+        inp = input_tensors[0]
+        outs = output_tensors[0]
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}ag"
+            self._put(f"{key}/{self._rank}", self._bytes_of(inp))
+            for j in range(self._size):
+                if j == self._rank:
+                    with torch.no_grad():
+                        outs[j].copy_(inp)
+                    continue
+                buf = self._take(f"{key}/{j}", readers=self._size - 1)
+                with torch.no_grad():
+                    outs[j].copy_(self._tensor_from(buf, outs[j]))
+
+        return self._submit(run, output_tensors)
+
+    def allgather_coalesced(self, output_lists, input_tensors, opts=None):
+        # The reference throws here (ProcessGroupCGX.cc:494-501); we loop
+        # instead — DDP's CPU model-verification path needs it.
+        works = [
+            self.allgather([outs], [inp])
+            for outs, inp in zip(output_lists, input_tensors)
+        ]
+        for w in works[:-1]:
+            w.wait()
+        return works[-1]
+
+    def gather(self, output_tensors, input_tensors, opts=None):
+        self._check_single(input_tensors)
+        inp = input_tensors[0]
+        root = int(opts.rootRank) if opts is not None else 0
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}g"
+            if self._rank == root:
+                outs = output_tensors[0]
+                for j in range(self._size):
+                    if j == root:
+                        with torch.no_grad():
+                            outs[j].copy_(inp)
+                    else:
+                        buf = self._take(f"{key}/{j}")
+                        with torch.no_grad():
+                            outs[j].copy_(self._tensor_from(buf, outs[j]))
+            else:
+                self._put(f"{key}/{self._rank}", self._bytes_of(inp))
+
+        return self._submit(run, output_tensors)
+
+    def scatter(self, output_tensors, input_tensors, opts=None):
+        self._check_single(output_tensors)
+        out = output_tensors[0]
+        root = int(opts.rootRank) if opts is not None else 0
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}sc"
+            if self._rank == root:
+                ins = input_tensors[0]
+                for j in range(self._size):
+                    if j == root:
+                        with torch.no_grad():
+                            out.copy_(ins[j])
+                    else:
+                        self._put(f"{key}/{j}", self._bytes_of(ins[j]))
+            else:
+                buf = self._take(f"{key}/{self._rank}")
+                with torch.no_grad():
+                    out.copy_(self._tensor_from(buf, out))
+
+        return self._submit(run, output_tensors)
+
+    def reduce(self, tensors, opts=None):
+        self._check_single(tensors)
+        t = tensors[0]
+        root = int(opts.rootRank) if opts is not None else 0
+        op = opts.reduceOp if opts is not None else dist.ReduceOp.SUM
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}r"
+            if self._rank == root:
+                parts = [t.detach().reshape(-1).to(torch.float64)
+                         if t.dtype in _TORCH_FLOATS
+                         else t.detach().reshape(-1).clone()]
+                for j in range(self._size):
+                    if j == root:
+                        continue
+                    buf = self._take(f"{key}/{j}")
+                    parts.append(
+                        self._tensor_from(buf, t).reshape(-1).to(parts[0].dtype)
+                    )
+                stack = torch.stack(parts)
+                if op == dist.ReduceOp.SUM:
+                    red = stack.sum(dim=0)
+                elif op == dist.ReduceOp.PRODUCT:
+                    red = stack.prod(dim=0)
+                elif op == dist.ReduceOp.MIN:
+                    red = stack.min(dim=0).values
+                elif op == dist.ReduceOp.MAX:
+                    red = stack.max(dim=0).values
+                else:
+                    raise NotImplementedError(f"cgx: unsupported reduce op {op}")
+                with torch.no_grad():
+                    t.detach().reshape(-1).copy_(red.to(t.dtype))
+            else:
+                self._put(f"{key}/{self._rank}", self._bytes_of(t))
+
+        return self._submit(run, tensors)
+
+    def alltoall(self, output_tensors, input_tensors, opts=None):
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}a2a"
+            for j in range(self._size):
+                if j != self._rank:
+                    self._put(f"{key}/{self._rank}>{j}",
+                              self._bytes_of(input_tensors[j]))
+            for j in range(self._size):
+                if j == self._rank:
+                    with torch.no_grad():
+                        output_tensors[j].copy_(input_tensors[j])
+                else:
+                    buf = self._take(f"{key}/{j}>{self._rank}")
+                    with torch.no_grad():
+                        output_tensors[j].copy_(
+                            self._tensor_from(buf, output_tensors[j])
+                        )
+
+        return self._submit(run, output_tensors)
+
+    def barrier(self, opts=None):
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}bar"
+            import time as _time
+
+            self._store.add(key, 1)
+            while int(self._store.add(key, 0)) < self._size:
+                _time.sleep(0.0005)
+
+        return self._submit(run, None)
+
+    # -- point-to-point (synchronous store mailboxes; the reference wraps
+    # MPI_Isend/Irecv in AsyncWork, ProcessGroupCGX.cc:144-226) ------------
+
+    def send(self, tensors, dst_rank, tag=0):
+        self._check_single(tensors)
+        cnt = self._p2p_send.get((dst_rank, tag), 0)
+        self._p2p_send[(dst_rank, tag)] = cnt + 1
+        self._put(
+            f"cgxp2p/{self._rank}>{dst_rank}/t{tag}/{cnt}",
+            self._bytes_of(tensors[0]),
+        )
+        return self._done(tensors)
+
+    def recv(self, tensors, src_rank, tag=0):
+        self._check_single(tensors)
+        t = tensors[0]
+        cnt = self._p2p_recv.get((src_rank, tag), 0)
+        self._p2p_recv[(src_rank, tag)] = cnt + 1
+        buf = self._take(f"cgxp2p/{src_rank}>{self._rank}/t{tag}/{cnt}")
+        with torch.no_grad():
+            t.copy_(self._tensor_from(buf, t))
+        return self._done(tensors)
+
+    def recv_anysource(self, tensors, tag=0):
+        self._check_single(tensors)
+        t = tensors[0]
+        import time as _time
+
+        while True:
+            for src in range(self._size):
+                if src == self._rank:
+                    continue
+                cnt = self._p2p_recv.get((src, tag), 0)
+                key = f"cgxp2p/{src}>{self._rank}/t{tag}/{cnt}"
+                try:
+                    ok = self._store.check([key])
+                except Exception:
+                    ok = True  # store without check: fall back to blocking
+                if ok:
+                    return self.recv(tensors, src, tag)
+            _time.sleep(0.001)
+
+    # -- unsupported, reference parity ------------------------------------
+
+    def reduce_scatter(self, output_tensors, input_tensors, opts=None):
+        raise NotImplementedError(
+            "ProcessGroupCGX does not support reduce_scatter "
+            "(reference ProcessGroupCGX.cc:631-636)"
+        )
+
+    def _allgather_base(self, output, input, opts=None):
+        raise NotImplementedError(
+            "ProcessGroupCGX does not support _allgather_base "
+            "(reference ProcessGroupCGX.cc:827-833)"
+        )
+
+    def allreduce_coalesced(self, tensors, opts=None):
+        raise NotImplementedError(
+            "ProcessGroupCGX does not support allreduce_coalesced "
+            "(reference ProcessGroupCGX.cc:422-428)"
+        )
+
+    # -- identity ---------------------------------------------------------
+
+    def getBackendName(self) -> str:
+        return BACKEND_NAME
+
+    def size(self) -> int:
+        return self._size
+
+    def rank(self) -> int:
+        return self._rank
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+
+    def __repr__(self) -> str:
+        return f"ProcessGroupCGX(rank={self._rank}, size={self._size})"
+
+
+def _create_cgx_pg(store, rank: int, size: int, timeout=None):
+    return ProcessGroupCGX(store, rank, size, timeout)
+
+
+_registered = False
+
+
+def register_backend() -> None:
+    """Register ``"cgx"`` with torch.distributed (idempotent). The reference
+    does this in a static constructor at module load
+    (ProcessGroupCGX.h:258-263); importing :mod:`torch_cgx_tpu.torch_backend`
+    has the same effect."""
+    global _registered
+    if _registered or BACKEND_NAME in dist.Backend.backend_list:
+        _registered = True
+        return
+    dist.Backend.register_backend(
+        BACKEND_NAME, _create_cgx_pg, devices=["cpu"]
+    )
+    _registered = True

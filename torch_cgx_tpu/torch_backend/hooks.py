@@ -1,0 +1,88 @@
+"""DDP communication hook for the ``"cgx"`` backend.
+
+Mirrors the reference's Python integration layer
+(/root/reference/cgx_utils/allreduce_hooks.py — SURVEY.md §2.2, §3.2):
+
+* :class:`CGXState` carries the process group, compression parameters
+  (from ``compression_params`` or the ``CGX_COMPRESSION_*`` env vars), a
+  ``layer_min_size`` floor, and the DDP step counter.
+* ``should_compress_``: tensors with dim <= 1 (biases, norms) or fewer than
+  ``layer_min_size`` elements stay uncompressed (allreduce_hooks.py:42-45).
+* :func:`cgx_hook` registers every bucket's layer layout at **step 2** —
+  DDP rebuilds its buckets after iteration 0, so registration waits until
+  shapes stabilize (allreduce_hooks.py:65-69, SURVEY.md §8.6) — and always
+  returns a gradient-averaging future: divide by world size *first*, then
+  allreduce-SUM, so quantization operates on pre-divided gradients
+  (allreduce_hooks.py:53-54, SURVEY.md §8.12).
+"""
+
+# NOTE: no `from __future__ import annotations` here — DDP's
+# register_comm_hook validates the hook signature by annotation *identity*
+# (bucket must be literally dist.GradBucket, return literally
+# torch.futures.Future[torch.Tensor]); stringified annotations fail it.
+
+from typing import Optional
+
+import torch
+import torch.distributed as dist
+
+from .. import config as cfg
+
+REGISTRATION_STEP = 2
+
+
+class CGXState:
+    """State object passed to :func:`cgx_hook` via
+    ``model.register_comm_hook(state, cgx_hook)``."""
+
+    def __init__(
+        self,
+        process_group: Optional[dist.ProcessGroup] = None,
+        compression_params: Optional[dict] = None,
+        layer_min_size: int = 1024,
+    ):
+        self.process_group = process_group
+        self.step = 0
+        default = cfg.default_compression_config()
+        params = compression_params or {}
+        self.quantization_bits = int(params.get("bits", default.bits))
+        self.quantization_bucket_size = int(
+            params.get("bucket_size", default.bucket_size)
+        )
+        self.layer_min_size = max(int(layer_min_size), cfg.minimal_size())
+
+    def should_compress_(self, tensor: torch.Tensor) -> bool:
+        return tensor.dim() > 1 and tensor.numel() >= self.layer_min_size
+
+
+def _allreduce_fut(
+    process_group: Optional[dist.ProcessGroup], tensor: torch.Tensor
+) -> torch.futures.Future:
+    """Average gradients: divide locally, then allreduce-SUM asynchronously
+    (the backend only ever sums — allreduce_hooks.py:48-59)."""
+    group = process_group if process_group is not None else dist.group.WORLD
+    tensor.div_(dist.get_world_size(group=group))
+    fut = dist.all_reduce(tensor, group=group, async_op=True).get_future()
+    return fut.then(lambda f: f.value()[0])
+
+
+def cgx_hook(
+    state: CGXState, bucket: dist.GradBucket
+) -> torch.futures.Future[torch.Tensor]:
+    if state.step == REGISTRATION_STEP:
+        for layer_idx, grad in enumerate(bucket.gradients()):
+            bits = (
+                state.quantization_bits
+                if state.should_compress_(grad)
+                else 32
+            )
+            cfg.register_layer(
+                bucket.index(),
+                layer_idx,
+                grad.numel(),
+                bits,
+                state.quantization_bucket_size,
+            )
+    if bucket.is_last():
+        state.step += 1
+    return _allreduce_fut(state.process_group, bucket.buffer())
