@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Counterpart of the reference's examples/run_cifar.sh (mpirun -np N ...):
+# on TPU the launch is a single SPMD process over the device mesh.
+# 4-bit gradients, bucket 1024, ResNet-18 — the BASELINE.md north-star run.
+set -e
+cd "$(dirname "$0")/.."
+python examples/cifar_train.py \
+  --epochs 10 \
+  --batch-size 512 \
+  --quantization-bits "${CGX_BITS:-4}" \
+  --quantization-bucket-size 1024 \
+  "$@"

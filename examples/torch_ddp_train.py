@@ -1,0 +1,138 @@
+"""DDP training through the ``"cgx"`` torch.distributed backend — the
+counterpart of the reference's mpirun-launched example
+(/root/reference/examples/cifar_train.py:61-150: init_process_group('cgx'),
+DDP wrap, ``register_comm_hook(CGXState, cgx_hook)``).
+
+The reference bridges OMPI env vars to MASTER_ADDR/RANK; TPU hosts have no
+MPI, so this script self-spawns its ranks (or honors torchrun's RANK /
+WORLD_SIZE env when present) and rendezvouses over a file store.
+
+Run:
+    python examples/torch_ddp_train.py --nproc 2 --quantization-bits 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+# Allow `python examples/torch_ddp_train.py` from a source checkout.
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def parse_args():
+    p = argparse.ArgumentParser(description="CGX torch-bridge DDP example")
+    p.add_argument("--nproc", type=int, default=2)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--batch-size", type=int, default=64, help="per rank")
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--quantization-bits", type=int, default=4)
+    p.add_argument("--quantization-bucket-size", type=int, default=1024)
+    p.add_argument("--seed", type=int, default=0)
+    return p.parse_args()
+
+
+def train(rank: int, ws: int, init_method: str, args) -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")  # codec runs on host
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+
+    import torch_cgx_tpu.torch_backend as tb  # registers backend "cgx"
+
+    dist.init_process_group(
+        "cgx", init_method=init_method, rank=rank, world_size=ws
+    )
+
+    torch.manual_seed(args.seed)
+    model = nn.Sequential(
+        nn.Flatten(),
+        nn.Linear(32 * 32 * 3, 256),
+        nn.ReLU(),
+        nn.Linear(256, 128),
+        nn.ReLU(),
+        nn.Linear(128, 10),
+    )
+    ddp = nn.parallel.DistributedDataParallel(model)
+    state = tb.CGXState(
+        None,
+        compression_params={
+            "bits": args.quantization_bits,
+            "bucket_size": args.quantization_bucket_size,
+        },
+    )
+    ddp.register_comm_hook(state, tb.cgx_hook)
+
+    opt = torch.optim.SGD(ddp.parameters(), lr=args.lr, momentum=0.9)
+    loss_fn = nn.CrossEntropyLoss()
+
+    # Synthetic CIFAR-shaped data with a fixed linear teacher (same trick as
+    # examples/cifar_train.py) — rank-local shards.
+    g = torch.Generator().manual_seed(args.seed)
+    teacher = torch.randn(32 * 32 * 3, 10, generator=g)
+    g_local = torch.Generator().manual_seed(args.seed + 1 + rank)
+
+    first = last = None
+    for step in range(args.steps):
+        x = torch.randn(args.batch_size, 3, 32, 32, generator=g_local)
+        y = (x.reshape(args.batch_size, -1) @ teacher).argmax(dim=1)
+        opt.zero_grad()
+        loss = loss_fn(ddp(x), y)
+        loss.backward()
+        opt.step()
+        if first is None:
+            first = loss.item()
+        last = loss.item()
+        if rank == 0 and (step + 1) % 10 == 0:
+            print(f"step {step + 1}/{args.steps}: loss={last:.4f}", flush=True)
+
+    if rank == 0:
+        print(json.dumps({
+            "example": "torch_ddp_train",
+            "world_size": ws,
+            "bits": args.quantization_bits,
+            "first_loss": first,
+            "final_loss": last,
+        }), flush=True)
+    dist.barrier()
+    dist.destroy_process_group()
+    if last >= first:
+        raise SystemExit("loss did not decrease")
+
+
+def main():
+    args = parse_args()
+    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
+        # torchrun-style external launch.
+        train(
+            int(os.environ["RANK"]),
+            int(os.environ["WORLD_SIZE"]),
+            "env://",
+            args,
+        )
+        return 0
+    import multiprocessing as mp
+
+    initfile = tempfile.mktemp(prefix="cgx_ddp_example_")
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(
+            target=train, args=(r, args.nproc, f"file://{initfile}", args)
+        )
+        for r in range(args.nproc)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    return 0 if all(p.exitcode == 0 for p in procs) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -24,6 +24,13 @@ jax.config.update("jax_threefry_partitionable", True)
 import pytest  # noqa: E402
 
 
+def pytest_runtest_setup(item):
+    """Skip @pytest.mark.tpu tests on the CPU suite (they run on real
+    hardware via `pytest -m tpu` with default platform env)."""
+    if item.get_closest_marker("tpu") and jax.default_backend() != "tpu":
+        pytest.skip("requires a real TPU backend")
+
+
 @pytest.fixture(autouse=True)
 def _clean_cgx_env(monkeypatch):
     """Isolate CGX_* env mutations per test (the config layer re-reads env on

@@ -1,0 +1,108 @@
+"""Checkpoint/resume tests — including the registry-survival property the
+reference lacks (SURVEY.md §5.4: its in-process layer registry vanishes on
+restart; ours rides inside every checkpoint)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import torch_cgx_tpu
+from torch_cgx_tpu import CompressionConfig, checkpoint as ckpt
+from torch_cgx_tpu import config as cfg
+
+
+def _tree():
+    return {
+        "params": {
+            "dense": {"kernel": jnp.arange(12.0).reshape(3, 4),
+                      "bias": jnp.ones((4,))},
+        },
+        "step": jnp.asarray(7),
+    }
+
+
+def test_save_restore_roundtrip(tmp_path):
+    tree = _tree()
+    path = ckpt.save(str(tmp_path), tree, step=7)
+    assert path.endswith("step_7")
+    out = ckpt.restore(str(tmp_path), target=jax.tree.map(jnp.zeros_like, tree))
+    chex_equal = jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        tree, out,
+    )
+    del chex_equal
+
+
+def test_latest_step_discovery(tmp_path):
+    assert ckpt.latest_step(str(tmp_path)) is None
+    for s in (3, 10, 5):
+        ckpt.save(str(tmp_path), {"x": jnp.zeros(2)}, step=s)
+    assert ckpt.all_steps(str(tmp_path)) == [3, 5, 10]
+    assert ckpt.latest_step(str(tmp_path)) == 10
+    out = ckpt.restore(str(tmp_path), target={"x": jnp.zeros(2)})
+    assert out["x"].shape == (2,)
+
+
+def test_registry_survives_restart(tmp_path):
+    cfg.register_layer(0, 0, 3000, 4, 256)
+    cfg.register_layer(0, 1, 96, 32, 0)
+    cfg.register_layer(1, 0, 512, 2, 64)
+    torch_cgx_tpu.set_layer_pattern_config(
+        r"kernel$", CompressionConfig(bits=4, bucket_size=1024)
+    )
+    ckpt.save(str(tmp_path), _tree(), step=1)
+    # Simulated process restart: statics wiped.
+    torch_cgx_tpu.clear_registry()
+    assert cfg.registered_layer_sizes(0) is None
+    ckpt.restore(str(tmp_path), target=jax.tree.map(jnp.zeros_like, _tree()))
+    assert cfg.registered_layer_sizes(0) == [3000, 96]
+    assert cfg.registered_layer_sizes(1) == [512]
+    assert cfg.get_layer_config((0, 0)).bits == 4
+    assert cfg.get_layer_config((0, 0)).bucket_size == 256
+    assert cfg.get_layer_config((0, 1)).bits == 32
+    resolved = cfg.resolve_pattern_config("model/dense/kernel")
+    assert resolved is not None and resolved.bits == 4
+
+
+def test_restore_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore(str(tmp_path / "nope"))
+
+
+def test_training_resume_equivalence(tmp_path):
+    """Train 4 steps, checkpoint at 2, resume, and match the uninterrupted
+    run bit-for-bit (the actual resume contract)."""
+    def loss_fn(p, b):
+        return jnp.mean((b @ p["w"] - 1.0) ** 2)
+
+    opt = optax.adam(1e-2)
+    p0 = {"w": jnp.ones((4, 2))}
+    s0 = opt.init(p0)
+    batch = jnp.arange(8.0).reshape(2, 4)
+
+    @jax.jit
+    def step(p, s, b):
+        g = jax.grad(loss_fn)(p, b)
+        u, s = opt.update(g, s, p)
+        return optax.apply_updates(p, u), s
+
+    # Uninterrupted.
+    p, s = p0, s0
+    for _ in range(4):
+        p, s = step(p, s, batch)
+    want = np.asarray(p["w"])
+
+    # Interrupted + resumed.
+    p, s = p0, s0
+    for _ in range(2):
+        p, s = step(p, s, batch)
+    ckpt.save(str(tmp_path), {"params": p, "opt": s}, step=2)
+    restored = ckpt.restore(
+        str(tmp_path), target={"params": p0, "opt": s0}
+    )
+    p, s = restored["params"], restored["opt"]
+    for _ in range(2):
+        p, s = step(p, s, batch)
+    np.testing.assert_array_equal(np.asarray(p["w"]), want)

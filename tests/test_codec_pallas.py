@@ -121,3 +121,70 @@ def test_dispatch_forced_pallas_on_cpu(monkeypatch):
     monkeypatch.setenv(cgx_config.CODEC_IMPL, "xla")
     q2 = dispatch.quantize_batch(xs, cc)
     np.testing.assert_array_equal(np.asarray(q2.packed), np.asarray(q_ref.packed))
+
+
+# ---------------------------------------------------------------------------
+# v2 "sublane" kernel layout (CGX_PALLAS_KERNEL=sublane).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("bits", [1, 3, 4, 8])
+@pytest.mark.parametrize("bucket_size", [64, 96, 512])
+def test_sublane_layout_wire_matches_xla(monkeypatch, bits, bucket_size):
+    """The v2 layout must produce byte-identical wire to the XLA codec in
+    deterministic mode (stricter than v1's 1-level tolerance: v2 computes
+    meta in XLA itself)."""
+    monkeypatch.setenv("CGX_PALLAS_KERNEL", "sublane")
+    rows, m = 2, 4032
+    xs = jnp.asarray(
+        np.random.default_rng(bits).normal(size=(rows, m)), jnp.float32
+    )
+    q_p = codec_pallas.quantize_batch(xs, bits, bucket_size, interpret=True)
+    q_x = jax.vmap(lambda r: codec.quantize(r, bits, bucket_size))(xs)
+    np.testing.assert_array_equal(np.asarray(q_p.packed), np.asarray(q_x.packed))
+    np.testing.assert_allclose(
+        np.asarray(q_p.meta), np.asarray(q_x.meta), rtol=2e-6, atol=0
+    )
+    y_p = codec_pallas.dequantize_batch(q_p, interpret=True, out_dtype=jnp.float32)
+    y_x = jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=jnp.float32))(q_x)
+    np.testing.assert_allclose(
+        np.asarray(y_p), np.asarray(y_x), rtol=2e-6, atol=5e-7
+    )
+
+
+def test_sublane_layout_constant_exact(monkeypatch):
+    monkeypatch.setenv("CGX_PALLAS_KERNEL", "sublane")
+    xs = jnp.full((1, 2048), 3.25, jnp.float32)
+    q = codec_pallas.quantize_batch(xs, 4, 512, interpret=True)
+    out = codec_pallas.dequantize_batch(q, interpret=True)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(xs))
+
+
+@pytest.mark.tpu  # pltpu.prng_seed has no CPU-interpret lowering
+def test_sublane_layout_stochastic_envelope(monkeypatch):
+    monkeypatch.setenv("CGX_PALLAS_KERNEL", "sublane")
+    xs = jnp.asarray(
+        np.random.default_rng(0).normal(size=(1, 4096)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(
+        xs, 4, 512, stochastic=True, key=jax.random.PRNGKey(7)
+    )
+    out = codec_pallas.dequantize_batch(q)
+    unit = np.asarray(q.meta)[0, 0].max()
+    assert np.abs(np.asarray(out) - np.asarray(xs)).max() <= unit * 1.01
+
+
+def test_kernel_layout_env_validation(monkeypatch):
+    monkeypatch.setenv("CGX_PALLAS_KERNEL", "v2")
+    with pytest.raises(ValueError, match="CGX_PALLAS_KERNEL"):
+        codec_pallas.quantize_batch(
+            jnp.zeros((1, 512), jnp.float32), 4, 512, interpret=True
+        )
+
+
+def test_tile_rows_env_validation(monkeypatch):
+    monkeypatch.setenv("CGX_PALLAS_TILE_ROWS", "0")
+    with pytest.raises(ValueError, match="CGX_PALLAS_TILE_ROWS"):
+        codec_pallas.quantize_batch(
+            jnp.zeros((1, 512), jnp.float32), 4, 512, interpret=True
+        )

@@ -1,0 +1,58 @@
+"""Smoke-run the shipped examples as real subprocesses (user-style drive:
+the reference validated its behavior through examples/run_cifar.sh —
+SURVEY.md §4)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # examples set their own platform
+    proc = subprocess.run(
+        [sys.executable, *args],
+        cwd=_REPO,
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+    )
+    assert proc.returncode == 0, proc.stdout + "\n" + proc.stderr
+    # Last JSON line is the machine-readable result.
+    last = [l for l in proc.stdout.splitlines() if l.startswith("{")][-1]
+    return json.loads(last)
+
+
+@pytest.mark.slow
+def test_cifar_example_virtual_mesh():
+    out = _run(
+        [
+            "examples/cifar_train.py",
+            "--simulate-devices", "4",
+            "--epochs", "2",
+            "--steps-per-epoch", "20",
+            "--batch-size", "32",
+            "--lr", "0.02",
+            "--quantization-bits", "4",
+        ],
+        timeout=420,
+    )
+    assert out["devices"] == 4
+    assert out["final_loss"] < out["first_loss"]
+
+
+@pytest.mark.slow
+@pytest.mark.torch_bridge
+def test_torch_ddp_example():
+    out = _run(
+        ["examples/torch_ddp_train.py", "--nproc", "2", "--steps", "25"],
+        timeout=300,
+    )
+    assert out["world_size"] == 2
+    assert out["final_loss"] < out["first_loss"]

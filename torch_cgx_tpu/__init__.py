@@ -10,7 +10,7 @@ data-parallel front end, and a pure-Python torch.distributed backend.
 
 __version__ = "0.1.0"
 
-from . import config
+from . import checkpoint, config
 from .config import (
     CompressionConfig,
     TopologyConfig,
@@ -23,6 +23,7 @@ from .config import (
 from .ops import QTensor, dequantize, quantize
 
 __all__ = [
+    "checkpoint",
     "config",
     "CompressionConfig",
     "TopologyConfig",

@@ -1,0 +1,211 @@
+"""Checkpoint / resume.
+
+The reference has **no** checkpointing (SURVEY.md §5.4) — it delegates to
+user code, with one sharp edge the survey flags: the per-layer compression
+registry and bucket/step counters live in in-process statics
+(/root/reference/src/mpi_allreduce_operations.cc:35-36,257-285) and silently
+vanish on restart, so a resumed run trains *uncompressed* until layers are
+re-registered. This module closes that gap TPU-natively:
+
+* :func:`save` / :func:`restore` — orbax-backed save of the training pytree
+  (params / opt_state / step / anything jax.tree-shaped), with a pure-numpy
+  fallback writer when orbax is unavailable.
+* The **compression registry snapshot** rides inside every checkpoint: the
+  numeric ``(bucket_idx, layer_idx) -> CompressionConfig`` registry, the
+  per-bucket layer sizes, and the name-pattern registry are captured at save
+  and re-installed at restore, so a resumed job compresses from step one.
+* :func:`latest_step` / :func:`all_steps` for resume discovery.
+
+Layout: ``<dir>/step_<N>/`` orbax (or ``.npz``) tree + ``cgx_registry.json``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from . import config as cfg
+from .utils.logging import get_logger
+
+log = get_logger()
+
+_STEP_RE = re.compile(r"^step_(\d+)$")
+_REGISTRY_FILE = "cgx_registry.json"
+_FALLBACK_FILE = "tree.npz"
+
+
+# ---------------------------------------------------------------------------
+# Registry snapshot (the reference's lost-on-restart statics, §5.4).
+# ---------------------------------------------------------------------------
+
+
+def registry_snapshot() -> Dict[str, Any]:
+    """JSON-able dump of all three per-layer config registries."""
+    numeric = [
+        {
+            "bucket_idx": b,
+            "layer_idx": li,
+            "config": dataclasses.asdict(c),
+        }
+        for (b, li), c in cfg._layer_configs.items()
+    ]
+    sizes = {str(b): s for b, s in cfg._layer_sizes.items()}
+    patterns = [
+        {"pattern": p, "config": dataclasses.asdict(c)}
+        for p, c in cfg._pattern_configs.items()
+    ]
+    return {"numeric": numeric, "sizes": sizes, "patterns": patterns}
+
+
+def restore_registry(snap: Dict[str, Any]) -> None:
+    """Re-install a :func:`registry_snapshot` (clears current registries)."""
+    cfg.clear_registry()
+    for b, s in snap.get("sizes", {}).items():
+        cfg._layer_sizes[int(b)] = list(s)
+    for item in snap.get("numeric", []):
+        cfg._layer_configs[(item["bucket_idx"], item["layer_idx"])] = (
+            cfg.CompressionConfig(**item["config"])
+        )
+    for item in snap.get("patterns", []):
+        cfg.set_layer_pattern_config(
+            item["pattern"], cfg.CompressionConfig(**item["config"])
+        )
+
+
+# ---------------------------------------------------------------------------
+# Tree save/restore.
+# ---------------------------------------------------------------------------
+
+
+def _orbax():
+    try:
+        import orbax.checkpoint as ocp
+
+        return ocp
+    except Exception:
+        return None
+
+
+def _step_dir(directory: str, step: int) -> str:
+    return os.path.join(directory, f"step_{step}")
+
+
+def _registry_path(directory: str, step: int) -> str:
+    """Sibling of the step dir (not inside it: orbax owns that directory and
+    a crash mid-save must not strand a tree-less registry inside it)."""
+    return os.path.join(directory, f"step_{step}.registry.json")
+
+
+def _flatten_for_npz(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        key = jax.tree_util.keystr(path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+def save(
+    directory: str,
+    tree: Any,
+    step: int,
+    *,
+    include_registry: bool = True,
+    force: bool = False,
+) -> str:
+    """Save a pytree checkpoint at ``<directory>/step_<step>``.
+
+    Device arrays are fetched to host; the compression registry snapshot is
+    stored alongside. Returns the checkpoint path.
+    """
+    path = _step_dir(directory, step)
+    os.makedirs(directory, exist_ok=True)
+    host_tree = jax.tree.map(np.asarray, tree)
+    # Registry first, as a sibling file: a crash between the two writes then
+    # leaves a registry without a checkpoint (harmless), never a checkpoint
+    # without a registry (which would silently resume uncompressed — the
+    # reference's §5.4 failure mode this module exists to close).
+    if include_registry:
+        with open(_registry_path(directory, step), "w") as f:
+            json.dump(registry_snapshot(), f, indent=1)
+    ocp = _orbax()
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(os.path.abspath(path), host_tree, force=force)
+    else:  # numpy fallback: flat keypath -> array archive
+        if os.path.exists(path) and not force:
+            raise FileExistsError(path)
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, _FALLBACK_FILE),
+                 **_flatten_for_npz(host_tree))
+    log.info("saved checkpoint %s", path)
+    return path
+
+
+def restore(
+    directory: str,
+    step: Optional[int] = None,
+    *,
+    target: Any = None,
+    with_registry: bool = True,
+) -> Any:
+    """Restore the pytree saved at ``step`` (default: latest). ``target``
+    provides structure/dtypes (required for the numpy fallback; recommended
+    with orbax). Re-installs the registry snapshot when present."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {directory}")
+    path = _step_dir(directory, step)
+    ocp = _orbax()
+    if ocp is not None:
+        ckptr = ocp.PyTreeCheckpointer()
+        if target is not None:
+            host_target = jax.tree.map(np.asarray, target)
+            tree = ckptr.restore(os.path.abspath(path), item=host_target)
+        else:
+            tree = ckptr.restore(os.path.abspath(path))
+    else:
+        if target is None:
+            raise ValueError("numpy-fallback restore requires target=")
+        data = np.load(os.path.join(path, _FALLBACK_FILE))
+        leaves_paths, treedef = jax.tree_util.tree_flatten_with_path(target)
+        leaves = [data[jax.tree_util.keystr(p)] for p, _ in leaves_paths]
+        tree = jax.tree_util.tree_unflatten(treedef, leaves)
+    if with_registry:
+        reg_path = _registry_path(directory, step)
+        legacy = os.path.join(path, _REGISTRY_FILE)  # pre-sibling layout
+        if os.path.exists(reg_path):
+            with open(reg_path) as f:
+                restore_registry(json.load(f))
+        elif os.path.exists(legacy):
+            with open(legacy) as f:
+                restore_registry(json.load(f))
+        else:
+            log.warning(
+                "checkpoint %s has no compression-registry snapshot; "
+                "resumed training will run UNCOMPRESSED until layers are "
+                "re-registered (pass with_registry=False to silence)", path
+            )
+    return tree
+
+
+def all_steps(directory: str) -> List[int]:
+    if not os.path.isdir(directory):
+        return []
+    out = []
+    for name in os.listdir(directory):
+        m = _STEP_RE.match(name)
+        if m:
+            out.append(int(m.group(1)))
+    return sorted(out)
+
+
+def latest_step(directory: str) -> Optional[int]:
+    steps = all_steps(directory)
+    return steps[-1] if steps else None

@@ -57,8 +57,38 @@ def supports(n: int, bits: int, bucket_size: int, skip_incomplete: bool) -> bool
     )
 
 
-def _tile_rows(nb: int) -> int:
-    return 8 if nb < 64 else 32
+def _tile_rows(nb: int, bucket_size: int) -> int:
+    """Bucket rows per grid step. Large tiles amortize per-step overhead
+    (empirically on v5e: 32 -> 256 rows is +25% quantize throughput at
+    512 MB); the cap keeps a block + its outputs well under VMEM
+    (256 rows x 16K bucket x 4 B = 16 MB is the ceiling, hence the
+    bucket-size scaling)."""
+    import os
+
+    forced = os.environ.get("CGX_PALLAS_TILE_ROWS")
+    if forced:
+        rows = int(forced)
+        if rows < 1:
+            raise ValueError(
+                f"CGX_PALLAS_TILE_ROWS must be a positive integer, got {forced!r}"
+            )
+        return rows
+    cap = max(8, min(256, (4096 * 256) // max(bucket_size, 1)))
+    if nb < 64:
+        return 8
+    if nb < 1024:
+        return 32
+    return cap
+
+
+def _stochastic_r(seed_ref, shape):
+    """In-kernel U[0,1) rounding offsets from the hardware PRNG. Routed
+    through int32 because Mosaic lacks uint32->f32 (values stay < 2^24)."""
+    pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    rbits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
+    return (rbits >> np.uint32(8)).astype(jnp.int32).astype(
+        jnp.float32
+    ) * np.float32(2.0**-24)
 
 
 # ---------------------------------------------------------------------------
@@ -75,15 +105,7 @@ def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, stochastic):
     bmin = jnp.min(xb, axis=1, keepdims=True)
     unit = (bmax - bmin) / maxlvl
     safe = jnp.where(unit > 0, unit, np.float32(1.0))
-    if stochastic:
-        pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
-        rbits = pltpu.bitcast(pltpu.prng_random_bits((t, b)), jnp.uint32)
-        # route through int32: Mosaic lacks uint32->f32 (values < 2^24)
-        r = (rbits >> np.uint32(8)).astype(jnp.int32).astype(jnp.float32) * np.float32(
-            2.0**-24
-        )
-    else:
-        r = np.float32(0.5)
+    r = _stochastic_r(seed_ref, (t, b)) if stochastic else np.float32(0.5)
     lvl = jnp.clip(jnp.floor((xb - bmin) / safe + r), 0, maxlvl).astype(jnp.int32)
 
     lane = jax.lax.broadcasted_iota(jnp.int32, (t, b), 1)
@@ -123,7 +145,7 @@ def _quantize_rows_impl(
     nb = rows * nb_r
     g = bucket_size // LANE_GROUP
     xb = xs.reshape(nb, bucket_size)
-    tile = _tile_rows(nb)
+    tile = _tile_rows(nb, bucket_size)
     nb_pad = codec.num_buckets(nb, tile) * tile
     if nb_pad != nb:
         xb = jnp.pad(xb, ((0, nb_pad - nb), (0, 0)), mode="edge")
@@ -198,7 +220,7 @@ def _dequantize_rows_impl(
     nb = rows * nb_r
     w2 = jax.lax.bitcast_convert_type(words, jnp.int32).reshape(nb, g * bits)
     m2 = meta.reshape(nb, 2)
-    tile = _tile_rows(nb)
+    tile = _tile_rows(nb, bucket_size)
     nb_pad = codec.num_buckets(nb, tile) * tile
     if nb_pad != nb:
         w2 = jnp.pad(w2, ((0, nb_pad - nb), (0, 0)))
@@ -218,6 +240,207 @@ def _dequantize_rows_impl(
         interpret=interpret,
     )(w2, m2)
     return out[:nb].reshape(rows, nb_r * bucket_size)
+
+
+# ---------------------------------------------------------------------------
+# v2 "sublane" kernels — faster layout.
+#
+# The v1 kernels above keep the natural (bucket-rows, bucket-values) layout
+# and pay for it: packing needs a 5-step pltpu.roll log-tree per bit plane
+# plus one narrow column write per 32-value group, and unpacking one masked
+# select per group. The v2 layout transposes each 32-value packing group
+# onto the *sublane* axis outside the kernel (one cheap XLA transpose), so
+# inside the kernel
+#
+#   words[w, l] = sum over sublanes s of ((lvl[s, l] >> w) & 1) << s
+#
+# is a plain cross-sublane reduction and
+#
+#   lvl[s, l]  = OR over w of (((words[w, l] >> s) & 1) << w)
+#
+# a plain broadcast — fully lane-vectorized for any group count, no rolls,
+# no strided writes. Per-bucket meta (unit, min) moves out of the kernel
+# into an XLA reduce (it fuses; the kernel receives meta pre-repeated per
+# lane). Under jit the v1 path still wins (XLA fuses its staging; the v2
+# transposes cost more than the kernel savings — measured on v5e), so v1
+# is the default and CGX_PALLAS_KERNEL=sublane opts in to v2.
+# ---------------------------------------------------------------------------
+
+_LANE_TILE = 512  # lanes (= packing groups) per grid step
+
+
+def _quantize_kernel_v2(seed_ref, x_ref, unit_ref, bmin_ref, words_ref, *,
+                        bits, stochastic):
+    maxlvl = np.float32((1 << bits) - 1)
+    x = x_ref[:]  # (32, L) f32 — sublane s = value position in its group
+    unit = unit_ref[:]  # (1, L) broadcasts over sublanes
+    bmin = bmin_ref[:]
+    r = _stochastic_r(seed_ref, x.shape) if stochastic else np.float32(0.5)
+    lvl = jnp.clip(jnp.floor((x - bmin) / unit + r), 0, maxlvl).astype(jnp.int32)
+    sub = jax.lax.broadcasted_iota(jnp.int32, lvl.shape, 0)  # sublane index
+    for w in range(bits):
+        plane = ((lvl >> w) & 1) << sub
+        words_ref[w : w + 1, :] = jnp.sum(plane, axis=0, keepdims=True)
+
+
+def _dequantize_kernel_v2(words_ref, unit_ref, bmin_ref, out_ref, *, bits):
+    w0 = words_ref[0:1, :]
+    t, l = LANE_GROUP, w0.shape[1]
+    sub = jax.lax.broadcasted_iota(jnp.int32, (t, l), 0)
+    lvl = (w0 >> sub) & 1
+    for w in range(1, bits):
+        lvl = lvl | (((words_ref[w : w + 1, :] >> sub) & 1) << w)
+    out_ref[:] = bmin_ref[:] + unit_ref[:] * lvl.astype(jnp.float32)
+
+
+def _bucket_meta_xla(xb: jax.Array, bits: int):
+    """(nb, B) -> per-bucket (unit, bmin) f32, the find_meta analogue."""
+    maxlvl = np.float32((1 << bits) - 1)
+    bmax = jnp.max(xb, axis=1)
+    bmin = jnp.min(xb, axis=1)
+    unit = (bmax - bmin) / maxlvl
+    safe = jnp.where(unit > 0, unit, np.float32(1.0))
+    return unit, safe, bmin
+
+
+def _lane_pad(a: jax.Array, tile: int):
+    l = a.shape[-1]
+    pad = codec.num_buckets(l, tile) * tile - l
+    if pad:
+        a = jnp.pad(a, [(0, 0)] * (a.ndim - 1) + [(0, pad)],
+                    constant_values=1 if a.dtype == jnp.float32 else 0)
+    return a
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bits", "bucket_size", "stochastic", "interpret")
+)
+def _quantize_rows_impl_v2(
+    xs: jax.Array,
+    seed: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    stochastic: bool,
+    interpret: bool = False,
+):
+    rows, m = xs.shape
+    nb_r = m // bucket_size
+    nb = rows * nb_r
+    g = bucket_size // LANE_GROUP
+    xb = xs.reshape(nb, bucket_size)
+    unit, safe, bmin = _bucket_meta_xla(xb, bits)
+    # Sublane-major view: A[s, b*g + gi] = x[b, gi*32 + s].
+    xt = (
+        xb.reshape(nb, g, LANE_GROUP)
+        .transpose(2, 0, 1)
+        .reshape(LANE_GROUP, nb * g)
+    )
+    safe_l = jnp.repeat(safe, g)[None, :]  # (1, nb*g)
+    bmin_l = jnp.repeat(bmin, g)[None, :]
+    lanes = nb * g
+    xt = _lane_pad(xt, _LANE_TILE)
+    safe_l = _lane_pad(safe_l, _LANE_TILE)
+    bmin_l = _lane_pad(bmin_l, _LANE_TILE)
+    lanes_pad = xt.shape[1]
+
+    words = pl.pallas_call(
+        functools.partial(
+            _quantize_kernel_v2, bits=bits, stochastic=stochastic
+        ),
+        grid=(lanes_pad // _LANE_TILE,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec((LANE_GROUP, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((bits, _LANE_TILE), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((bits, lanes_pad), jnp.int32),
+        interpret=interpret,
+    )(seed.reshape(1, 1).astype(jnp.int32), xt, safe_l, bmin_l)
+    # (bits, lanes) -> wire order (lane-major, plane-minor): word (g, w) at
+    # flat g*bits + w, matching pack_levels.
+    words = jax.lax.bitcast_convert_type(
+        words[:, :lanes].T.reshape(rows, nb_r * g * bits), jnp.uint32
+    )
+    meta = jnp.stack([unit, bmin], axis=1).reshape(rows, nb_r, 2)
+    return words, meta
+
+
+@functools.partial(
+    jax.jit, static_argnames=("bits", "bucket_size", "interpret")
+)
+def _dequantize_rows_impl_v2(
+    words: jax.Array,
+    meta: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    interpret: bool = False,
+):
+    rows = words.shape[0]
+    g = bucket_size // LANE_GROUP
+    nb_r = words.shape[1] // (g * bits)
+    nb = rows * nb_r
+    # wire order (N groups, bits planes) -> sublane-major (bits, N)
+    w2 = (
+        jax.lax.bitcast_convert_type(words, jnp.int32)
+        .reshape(nb * g, bits)
+        .T
+    )
+    unit = meta.reshape(nb, 2)[:, 0].astype(jnp.float32)
+    bmin = meta.reshape(nb, 2)[:, 1].astype(jnp.float32)
+    unit_l = jnp.repeat(unit, g)[None, :]
+    bmin_l = jnp.repeat(bmin, g)[None, :]
+    lanes = nb * g
+    w2 = _lane_pad(w2, _LANE_TILE)
+    unit_l = _lane_pad(unit_l, _LANE_TILE)
+    bmin_l = _lane_pad(bmin_l, _LANE_TILE)
+    lanes_pad = w2.shape[1]
+
+    out = pl.pallas_call(
+        functools.partial(_dequantize_kernel_v2, bits=bits),
+        grid=(lanes_pad // _LANE_TILE,),
+        in_specs=[
+            pl.BlockSpec((bits, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, _LANE_TILE), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((LANE_GROUP, _LANE_TILE), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((LANE_GROUP, lanes_pad), jnp.float32),
+        interpret=interpret,
+    )(w2, unit_l, bmin_l)
+    # (32, nb*g) sublane-major -> (nb, bucket_size)
+    vals = (
+        out[:, :lanes]
+        .reshape(LANE_GROUP, nb, g)
+        .transpose(1, 2, 0)
+        .reshape(rows, nb_r * bucket_size)
+    )
+    return vals
+
+
+def _kernel_layout() -> str:
+    """"lane" (default): v1 natural-layout kernels — fastest under jit,
+    where XLA fuses the staging. "sublane": v2 transposed-layout kernels —
+    simpler vector code, faster when called eagerly/unfused."""
+    import os
+
+    layout = os.environ.get("CGX_PALLAS_KERNEL", "lane").lower()
+    if layout not in ("lane", "sublane"):
+        raise ValueError(
+            f"CGX_PALLAS_KERNEL must be 'lane' or 'sublane', got {layout!r}"
+        )
+    return layout
 
 
 # ---------------------------------------------------------------------------
@@ -249,7 +472,11 @@ def quantize_batch(
     m_pad = nb_r * bucket_size
     if m_pad != m:
         xs = jnp.pad(xs, ((0, 0), (0, m_pad - m)), mode="edge")
-    words, meta = _quantize_rows_impl(
+    impl = (
+        _quantize_rows_impl if _kernel_layout() == "lane"
+        else _quantize_rows_impl_v2
+    )
+    words, meta = impl(
         xs.astype(jnp.float32),
         seed_from_key(key),
         bits=bits,
@@ -279,7 +506,11 @@ def dequantize_batch(
     """Decode a batched QTensor -> (rows, numel)."""
     if out_dtype is None:
         out_dtype = add_to.dtype if add_to is not None else q.dtype
-    vals = _dequantize_rows_impl(
+    impl = (
+        _dequantize_rows_impl if _kernel_layout() == "lane"
+        else _dequantize_rows_impl_v2
+    )
+    vals = impl(
         q.packed,
         jnp.swapaxes(q.meta, 1, 2).astype(jnp.float32),
         bits=q.bits,
