@@ -168,7 +168,7 @@ def main():
         acc = (logits.argmax(-1) == batch["label"]).mean()
         return loss, (updated["batch_stats"], acc)
 
-    def _step(params, batch_stats, opt_state, batch, step_idx):
+    def _step(params, batch_stats, opt_state, batch):
         (loss, (batch_stats, acc)), grads = jax.value_and_grad(
             loss_fn, has_aux=True
         )(params, batch_stats, batch)
@@ -190,7 +190,7 @@ def main():
         jax.shard_map(
             _step,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(axes if len(axes) > 1 else axes[0]), P()),
+            in_specs=(P(), P(), P(), P(axes if len(axes) > 1 else axes[0])),
             out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         ),
@@ -212,9 +212,8 @@ def main():
             batch = shard_batch(
                 {"image": x_all[idx], "label": y_all[idx]}, mesh, axes
             )
-            gstep = epoch * args.steps_per_epoch + s
             params, batch_stats, opt_state, loss, acc = step(
-                params, batch_stats, opt_state, batch, gstep
+                params, batch_stats, opt_state, batch
             )
             losses.append(float(loss))
             accs.append(float(acc))

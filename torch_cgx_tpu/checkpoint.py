@@ -36,7 +36,6 @@ from .utils.logging import get_logger
 log = get_logger()
 
 _STEP_RE = re.compile(r"^step_(\d+)$")
-_REGISTRY_FILE = "cgx_registry.json"
 _FALLBACK_FILE = "tree.npz"
 
 
@@ -124,6 +123,10 @@ def save(
     stored alongside. Returns the checkpoint path.
     """
     path = _step_dir(directory, step)
+    if os.path.exists(path) and not force:
+        # Refuse BEFORE touching the registry file: a failed overwrite must
+        # not pair the old tree with a new registry (silent config skew).
+        raise FileExistsError(path)
     os.makedirs(directory, exist_ok=True)
     host_tree = jax.tree.map(np.asarray, tree)
     # Registry first, as a sibling file: a crash between the two writes then
@@ -138,8 +141,6 @@ def save(
         ckptr = ocp.PyTreeCheckpointer()
         ckptr.save(os.path.abspath(path), host_tree, force=force)
     else:  # numpy fallback: flat keypath -> array archive
-        if os.path.exists(path) and not force:
-            raise FileExistsError(path)
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, _FALLBACK_FILE),
                  **_flatten_for_npz(host_tree))
@@ -179,12 +180,8 @@ def restore(
         tree = jax.tree_util.tree_unflatten(treedef, leaves)
     if with_registry:
         reg_path = _registry_path(directory, step)
-        legacy = os.path.join(path, _REGISTRY_FILE)  # pre-sibling layout
         if os.path.exists(reg_path):
             with open(reg_path) as f:
-                restore_registry(json.load(f))
-        elif os.path.exists(legacy):
-            with open(legacy) as f:
                 restore_registry(json.load(f))
         else:
             log.warning(

@@ -33,6 +33,12 @@ class GPT2Config:
     max_seq: int = 1024
     dropout: float = 0.0
     dtype: Any = jnp.bfloat16
+    # Mixture-of-experts (0 = dense MLP). Experts shard over ``ep_axis``
+    # when set (parallel/moe.py).
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    ep_axis: Optional[str] = None
 
     @staticmethod
     def small(**kw):
@@ -64,6 +70,18 @@ class Block(nn.Module):
             attn_fn=self.attn_fn, dropout=cfg.dropout, name="attn",
         )(y, train=train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
+        if cfg.n_experts > 0:
+            from ..parallel.moe import MoEMlp
+
+            return x + MoEMlp(
+                cfg.d_model,
+                n_experts=cfg.n_experts,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dtype=cfg.dtype,
+                ep_axis=cfg.ep_axis,
+                name="moe_mlp",
+            )(y, train=train)
         return x + Mlp(
             cfg.d_model, dtype=cfg.dtype, dropout=cfg.dropout, name="mlp"
         )(y, train=train)

@@ -42,6 +42,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from . import codec
+from ..utils import env as _env
 
 LANE_GROUP = codec.LANE_GROUP  # 32
 MAX_BUCKET_ELEMS = 16384  # VMEM guard: (tile, bucket) block must stay small
@@ -62,12 +63,15 @@ def _tile_rows(nb: int, bucket_size: int) -> int:
     (empirically on v5e: 32 -> 256 rows is +25% quantize throughput at
     512 MB); the cap keeps a block + its outputs well under VMEM
     (256 rows x 16K bucket x 4 B = 16 MB is the ceiling, hence the
-    bucket-size scaling)."""
-    import os
-
-    forced = os.environ.get("CGX_PALLAS_TILE_ROWS")
+    bucket-size scaling). Called from the UNJITTED public wrappers so the
+    env override is honored (and validated) on every call, then passed to
+    the impls as a static argument."""
+    forced = _env.get_optional_str_env("CGX_PALLAS_TILE_ROWS")
     if forced:
-        rows = int(forced)
+        try:
+            rows = int(forced)
+        except ValueError:
+            rows = 0
         if rows < 1:
             raise ValueError(
                 f"CGX_PALLAS_TILE_ROWS must be a positive integer, got {forced!r}"
@@ -127,7 +131,8 @@ def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, stochastic):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bits", "bucket_size", "stochastic", "interpret")
+    jax.jit,
+    static_argnames=("bits", "bucket_size", "stochastic", "interpret", "tile"),
 )
 def _quantize_rows_impl(
     xs: jax.Array,
@@ -137,6 +142,7 @@ def _quantize_rows_impl(
     bucket_size: int,
     stochastic: bool,
     interpret: bool = False,
+    tile: int = 32,
 ):
     """xs: (rows, nb_r * bucket_size) already padded. Returns
     (words (rows, nb_r*G*bits) uint32, meta (rows, nb_r, 2) f32)."""
@@ -145,7 +151,6 @@ def _quantize_rows_impl(
     nb = rows * nb_r
     g = bucket_size // LANE_GROUP
     xb = xs.reshape(nb, bucket_size)
-    tile = _tile_rows(nb, bucket_size)
     nb_pad = codec.num_buckets(nb, tile) * tile
     if nb_pad != nb:
         xb = jnp.pad(xb, ((0, nb_pad - nb), (0, 0)), mode="edge")
@@ -203,7 +208,7 @@ def _dequantize_kernel(words_ref, meta_ref, out_ref, *, bits, g):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bits", "bucket_size", "interpret")
+    jax.jit, static_argnames=("bits", "bucket_size", "interpret", "tile")
 )
 def _dequantize_rows_impl(
     words: jax.Array,
@@ -212,6 +217,7 @@ def _dequantize_rows_impl(
     bits: int,
     bucket_size: int,
     interpret: bool = False,
+    tile: int = 32,
 ):
     """words: (rows, W) uint32, meta: (rows, nb_r, 2) f32 -> (rows, m) f32."""
     rows = words.shape[0]
@@ -220,7 +226,6 @@ def _dequantize_rows_impl(
     nb = rows * nb_r
     w2 = jax.lax.bitcast_convert_type(words, jnp.int32).reshape(nb, g * bits)
     m2 = meta.reshape(nb, 2)
-    tile = _tile_rows(nb, bucket_size)
     nb_pad = codec.num_buckets(nb, tile) * tile
     if nb_pad != nb:
         w2 = jnp.pad(w2, ((0, nb_pad - nb), (0, 0)))
@@ -433,9 +438,7 @@ def _kernel_layout() -> str:
     """"lane" (default): v1 natural-layout kernels — fastest under jit,
     where XLA fuses the staging. "sublane": v2 transposed-layout kernels —
     simpler vector code, faster when called eagerly/unfused."""
-    import os
-
-    layout = os.environ.get("CGX_PALLAS_KERNEL", "lane").lower()
+    layout = _env.get_str_env_or_default("CGX_PALLAS_KERNEL", "lane").lower()
     if layout not in ("lane", "sublane"):
         raise ValueError(
             f"CGX_PALLAS_KERNEL must be 'lane' or 'sublane', got {layout!r}"
@@ -472,18 +475,25 @@ def quantize_batch(
     m_pad = nb_r * bucket_size
     if m_pad != m:
         xs = jnp.pad(xs, ((0, 0), (0, m_pad - m)), mode="edge")
-    impl = (
-        _quantize_rows_impl if _kernel_layout() == "lane"
-        else _quantize_rows_impl_v2
-    )
-    words, meta = impl(
-        xs.astype(jnp.float32),
-        seed_from_key(key),
-        bits=bits,
-        bucket_size=bucket_size,
-        stochastic=stochastic,
-        interpret=interpret,
-    )
+    if _kernel_layout() == "lane":
+        words, meta = _quantize_rows_impl(
+            xs.astype(jnp.float32),
+            seed_from_key(key),
+            bits=bits,
+            bucket_size=bucket_size,
+            stochastic=stochastic,
+            interpret=interpret,
+            tile=_tile_rows(rows * nb_r, bucket_size),
+        )
+    else:
+        words, meta = _quantize_rows_impl_v2(
+            xs.astype(jnp.float32),
+            seed_from_key(key),
+            bits=bits,
+            bucket_size=bucket_size,
+            stochastic=stochastic,
+            interpret=interpret,
+        )
     meta = jnp.swapaxes(meta, 1, 2).astype(dtype)  # (rows, 2, nb_r)
     return codec.QTensor(
         packed=words,
@@ -506,17 +516,25 @@ def dequantize_batch(
     """Decode a batched QTensor -> (rows, numel)."""
     if out_dtype is None:
         out_dtype = add_to.dtype if add_to is not None else q.dtype
-    impl = (
-        _dequantize_rows_impl if _kernel_layout() == "lane"
-        else _dequantize_rows_impl_v2
-    )
-    vals = impl(
-        q.packed,
-        jnp.swapaxes(q.meta, 1, 2).astype(jnp.float32),
-        bits=q.bits,
-        bucket_size=q.bucket_size,
-        interpret=interpret,
-    )[:, : q.numel]
+    if _kernel_layout() == "lane":
+        rows = q.packed.shape[0]
+        nb = rows * codec.num_buckets(q.numel_main, q.bucket_size)
+        vals = _dequantize_rows_impl(
+            q.packed,
+            jnp.swapaxes(q.meta, 1, 2).astype(jnp.float32),
+            bits=q.bits,
+            bucket_size=q.bucket_size,
+            interpret=interpret,
+            tile=_tile_rows(nb, q.bucket_size),
+        )[:, : q.numel]
+    else:
+        vals = _dequantize_rows_impl_v2(
+            q.packed,
+            jnp.swapaxes(q.meta, 1, 2).astype(jnp.float32),
+            bits=q.bits,
+            bucket_size=q.bucket_size,
+            interpret=interpret,
+        )[:, : q.numel]
     if add_to is not None:
         return (add_to.astype(jnp.float32) + vals).astype(out_dtype)
     return vals.astype(out_dtype)

@@ -14,6 +14,14 @@ from .grad_sync import (
     replicate,
     shard_batch,
 )
+from .moe import MoEMlp, aux_loss, moe_param_spec
+from .pipeline import (
+    merge_microbatches,
+    spmd_pipeline,
+    split_microbatches,
+    stack_stage_params,
+    unstack_stage_params,
+)
 from .ring_attention import make_sp_attention, ring_attention, ulysses_attention
 from .reducers import (
     allgather_quantized,
@@ -50,4 +58,12 @@ __all__ = [
     "make_sp_attention",
     "ring_attention",
     "ulysses_attention",
+    "MoEMlp",
+    "aux_loss",
+    "moe_param_spec",
+    "spmd_pipeline",
+    "stack_stage_params",
+    "unstack_stage_params",
+    "split_microbatches",
+    "merge_microbatches",
 ]

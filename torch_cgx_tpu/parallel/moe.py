@@ -1,0 +1,197 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+The reference has no MoE/EP (SURVEY.md §2.3: "TP / PP / EP: absent") — this
+subsystem is designed fresh for TPU rather than ported. GShard-style dense
+dispatch, shaped for the MXU and for GSPMD expert parallelism:
+
+* :class:`MoEMlp` — drop-in replacement for the dense ``Mlp`` block: top-k
+  softmax router, capacity-bounded one-hot dispatch (no dynamic shapes —
+  token->slot assignment is a cumsum over one-hots, overflowing tokens are
+  dropped and ride the residual connection), per-expert FFN as batched
+  einsums over a leading expert dimension.
+* **EP sharding**: every tensor with a leading expert axis gets a
+  ``with_sharding_constraint`` on the ``ep`` mesh axis (when configured);
+  expert weights shard via :func:`moe_param_spec`. XLA/GSPMD then inserts
+  the dispatch/combine ``all_to_all`` pair over ICI — the explicit-MPI
+  equivalent the reference would have needed is exactly what SURVEY.md §7
+  says should collapse into the compiler.
+* **Load-balance auxiliary loss** (Switch-Transformer form) is sown under
+  ``intermediates/moe_aux_loss``; collect with :func:`aux_loss`.
+
+Composes with the quantized gradient allreduce: expert weights are regular
+pytree leaves, so per-layer compression configs apply (pattern
+``.*experts.*`` etc.).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+_warned_constraint = False
+
+
+def _capacity(tokens: int, n_experts: int, top_k: int, factor: float) -> int:
+    return max(1, int(np.ceil(tokens * top_k * factor / n_experts)))
+
+
+class MoEMlp(nn.Module):
+    """Top-k routed expert FFN.
+
+    Shapes: x (B, S, D) -> (B, S, D); experts hold (E, D, F) / (E, F, D)
+    kernels with F = ratio * d_model.
+    """
+
+    d_model: int
+    n_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    ratio: int = 4
+    dtype: Any = jnp.bfloat16
+    ep_axis: Optional[str] = None  # mesh axis to shard the expert dim over
+
+    def _constrain(self, t, spec):
+        if self.ep_axis is None:
+            return t
+        try:
+            return jax.lax.with_sharding_constraint(t, spec)
+        except (ValueError, RuntimeError) as e:
+            # No mesh context (eager / plain jit without set_mesh) or a bad
+            # axis name: EP degrades to replicated experts. Never silent —
+            # on a real pod that is an OOM/perf cliff.
+            global _warned_constraint
+            if not _warned_constraint:
+                _warned_constraint = True
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "MoE EP sharding constraint %s not applied (%s); experts "
+                    "will be REPLICATED. Run under `with jax.set_mesh(mesh):`"
+                    " with an %r mesh axis to shard them.",
+                    spec, e, self.ep_axis,
+                )
+            return t
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        b, s, d = x.shape
+        e, k = self.n_experts, self.top_k
+        if not 1 <= k <= e:
+            raise ValueError(
+                f"top_k={k} must be in [1, n_experts={e}]"
+            )
+        f = self.ratio * self.d_model
+        t = b * s
+        cap = _capacity(t, e, k, self.capacity_factor)
+        ep = self.ep_axis
+
+        xt = x.reshape(t, d)
+        # Router in f32 (tiny matmul; numerics matter more than speed).
+        router = self.param(
+            "router", nn.initializers.lecun_normal(), (d, e), jnp.float32
+        )
+        logits = xt.astype(jnp.float32) @ router  # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+
+        # Top-k gates: iteratively take the argmax, mask, renormalize the
+        # selected gates to sum to 1 per token (GShard convention).
+        masked = probs
+        sel_onehots, sel_gates = [], []
+        for _ in range(k):
+            idx = jnp.argmax(masked, axis=-1)  # (T,)
+            oh = jax.nn.one_hot(idx, e, dtype=jnp.float32)  # (T, E)
+            sel_onehots.append(oh)
+            sel_gates.append(jnp.sum(probs * oh, axis=-1))  # (T,)
+            masked = masked * (1.0 - oh)
+        denom = sum(sel_gates) + 1e-9
+
+        # Load-balance aux loss (Switch form): E * sum_e fraction_e * prob_e,
+        # computed on the top-1 assignment.
+        frac = jnp.mean(sel_onehots[0], axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        self.sow(
+            "intermediates", "moe_aux_loss",
+            jnp.asarray(e, jnp.float32) * jnp.sum(frac * mean_prob),
+        )
+
+        # Capacity-bounded slot assignment: position of each token within
+        # its expert's queue = exclusive cumsum of the choice one-hots (the
+        # k-th choice queues behind all first choices, etc.).
+        dispatch = jnp.zeros((t, e, cap), jnp.float32)
+        combine = jnp.zeros((t, e, cap), jnp.float32)
+        slots_used = jnp.zeros((e,), jnp.float32)
+        for i in range(k):
+            oh = sel_onehots[i]
+            pos = (jnp.cumsum(oh, axis=0) - oh) + slots_used[None, :]  # (T, E)
+            slot = jnp.sum(pos * oh, axis=-1)  # (T,) queue position
+            keep = (slot < cap).astype(jnp.float32)
+            slot_oh = jax.nn.one_hot(
+                jnp.minimum(slot, cap - 1).astype(jnp.int32), cap,
+                dtype=jnp.float32,
+            )  # (T, C)
+            d_i = oh[:, :, None] * slot_oh[:, None, :] * keep[:, None, None]
+            dispatch = dispatch + d_i
+            gate = (sel_gates[i] / denom)[:, None, None]
+            combine = combine + gate * d_i
+            slots_used = slots_used + jnp.sum(oh, axis=0)
+
+        # Dispatch tokens to expert slots: (E, C, D) — the all_to_all
+        # boundary under EP sharding.
+        exp_in = jnp.einsum(
+            "tec,td->ecd", dispatch.astype(self.dtype), xt.astype(self.dtype)
+        )
+        exp_in = self._constrain(exp_in, P(ep, None, None))
+
+        w_in = self.param(
+            "experts_in",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, d, f), jnp.float32,
+        ).astype(self.dtype)
+        b_in = self.param(
+            "experts_in_bias", nn.initializers.zeros, (e, f), jnp.float32
+        ).astype(self.dtype)
+        w_out = self.param(
+            "experts_out",
+            nn.initializers.lecun_normal(batch_axis=(0,)),
+            (e, f, d), jnp.float32,
+        ).astype(self.dtype)
+        b_out = self.param(
+            "experts_out_bias", nn.initializers.zeros, (e, d), jnp.float32
+        ).astype(self.dtype)
+
+        h = jnp.einsum("ecd,edf->ecf", exp_in, w_in) + b_in[:, None, :]
+        h = self._constrain(h, P(ep, None, None))
+        h = nn.gelu(h)
+        exp_out = jnp.einsum("ecf,efd->ecd", h, w_out) + b_out[:, None, :]
+        exp_out = self._constrain(exp_out, P(ep, None, None))
+
+        y = jnp.einsum(
+            "tec,ecd->td", combine.astype(self.dtype), exp_out
+        )
+        return y.reshape(b, s, d)
+
+
+def moe_param_spec(path: str, leaf, axis: str = "ep") -> Optional[P]:
+    """EP PartitionSpec for MoE params: shard the leading expert dim of
+    ``experts_*`` kernels/biases over ``axis``; router replicated. Returns
+    None for non-MoE params (caller falls through to its other rules)."""
+    if "experts" in path:
+        return P(*((axis,) + (None,) * (leaf.ndim - 1)))
+    if path.endswith("router"):
+        return P()
+    return None
+
+
+def aux_loss(intermediates) -> jax.Array:
+    """Sum all sown ``moe_aux_loss`` values (0 when no MoE layers ran)."""
+    total = jnp.asarray(0.0, jnp.float32)
+    for path, leaves in jax.tree_util.tree_flatten_with_path(intermediates)[0]:
+        if "moe_aux_loss" in jax.tree_util.keystr(path):
+            total = total + jnp.sum(leaves)
+    return total
