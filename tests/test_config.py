@@ -93,3 +93,13 @@ def test_negative_bits_rejected():
         cfg.CompressionConfig(bits=-1)
     with pytest.raises(ValueError):
         cfg.CompressionConfig(bucket_size=-5)
+
+
+def test_init_distributed_single_host_noop(monkeypatch):
+    """Without a coordinator, init_distributed is a safe no-op."""
+    from torch_cgx_tpu.parallel.mesh import init_distributed
+
+    for k in ("JAX_COORDINATOR_ADDRESS", "TPU_WORKER_HOSTNAMES",
+              "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(k, raising=False)
+    assert init_distributed() is False

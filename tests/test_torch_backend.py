@@ -344,3 +344,56 @@ def test_ddp_training_ws2():
 @pytest.mark.torch_bridge
 def test_unsupported_ops_ws2():
     _launch(_worker_unsupported, ws=2)
+
+
+def _worker_subgroup(rank: int, ws: int) -> None:
+    import torch
+    import torch.distributed as dist
+
+    # Quantized allreduce on a 2-rank subgroup of the world — the reference
+    # pins everything to MPI_COMM_WORLD and subgroups don't work there
+    # (SURVEY.md §8.11); the store-transport bridge supports them.
+    sub = dist.new_group(ranks=[0, 1])
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+    if rank in (0, 1):
+        t = torch.full((5000,), float(rank + 1))
+        dist.all_reduce(t, group=sub)
+        assert torch.equal(t, torch.full((5000,), 3.0)), t[:4]
+    else:
+        # ranks outside the subgroup must not participate or deadlock
+        pass
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
+
+
+def _worker_failed_future(rank: int, ws: int) -> None:
+    import torch
+    import torch.distributed as dist
+
+    # A worker-thread failure must surface as a failed Work future (the
+    # finishWorkMPIError path, ProcessGroupCGX.cc:312-317), not a hang:
+    # an invalid env config is only discovered inside the worker's run().
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+    os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = "-7"
+    t = torch.full((5000,), float(rank + 1))
+    try:
+        dist.all_reduce(t)
+        raise AssertionError("expected the failed future to raise on wait()")
+    except (RuntimeError, ValueError):
+        pass
+    os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = "512"
+    # The group must still be usable afterwards.
+    ok = torch.full((8,), float(rank + 1))
+    dist.all_reduce(ok)
+    assert ok[0].item() == sum(r + 1 for r in range(ws))
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
+    os.environ.pop("CGX_COMPRESSION_BUCKET_SIZE")
+
+
+@pytest.mark.torch_bridge
+def test_subgroup_ws3():
+    _launch(_worker_subgroup, ws=3)
+
+
+@pytest.mark.torch_bridge
+def test_failed_work_recovers_ws2():
+    _launch(_worker_failed_future, ws=2)

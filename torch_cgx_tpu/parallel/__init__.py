@@ -4,6 +4,7 @@ from .mesh import (
     INTRA_AXIS,
     flat_mesh,
     hierarchical_mesh,
+    init_distributed,
     make_training_mesh,
 )
 from .allreduce import allreduce_flat, allreduce_tree, resolve_leaf_config
@@ -47,6 +48,7 @@ __all__ = [
     "INTRA_AXIS",
     "flat_mesh",
     "hierarchical_mesh",
+    "init_distributed",
     "make_training_mesh",
     "allgather_quantized",
     "alltoall_allreduce",

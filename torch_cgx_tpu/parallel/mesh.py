@@ -26,6 +26,49 @@ CROSS_AXIS = "cross"
 DP_AXIS = "dp"
 
 
+def init_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Multi-host bootstrap — the analogue of the reference's once-only
+    ``MPI_Init_thread`` (ProcessGroupCGX.cc:242-257), built on
+    ``jax.distributed.initialize`` (DCN control plane).
+
+    Call once per process before building meshes. On Cloud TPU pods all
+    arguments are auto-detected; elsewhere pass them explicitly or via
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``.
+    Returns True if the distributed runtime was (or already is) initialized,
+    False when running single-host with no coordinator configured (no-op).
+    """
+    import os
+
+    # NOT jax.process_count(): that initializes the XLA backend, after which
+    # jax.distributed.initialize() unconditionally raises.
+    if jax.distributed.is_initialized():
+        return True
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    env_np = os.environ.get("JAX_NUM_PROCESSES")
+    num_processes = num_processes or (int(env_np) if env_np else None)
+    env_pid = os.environ.get("JAX_PROCESS_ID")
+    process_id = process_id if process_id is not None else (
+        int(env_pid) if env_pid else None
+    )
+    on_pod = any(
+        k in os.environ for k in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if coordinator_address is None and not on_pod:
+        return False  # single host — nothing to bootstrap
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True
+
+
 def flat_mesh(devices: Optional[Sequence] = None, axis: str = DP_AXIS) -> Mesh:
     """Single-axis data-parallel mesh over all (or given) devices."""
     devices = list(devices) if devices is not None else jax.devices()

@@ -48,7 +48,6 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 import torch
 import torch.distributed as dist
-from torch._C._distributed_c10d import _create_work_from_future
 from torch.futures import Future
 
 from .. import config as cfg
@@ -201,6 +200,38 @@ def _chunk_split(n: int, ws: int) -> Tuple[List[int], List[int]]:
 # ---------------------------------------------------------------------------
 
 
+class _CGXWork(dist.Work):
+    """Work future completed by the worker thread.
+
+    NOT ``_create_work_from_future``: that wrapper's ``wait()`` swallows
+    future exceptions (returns success on a failed op — silent corruption);
+    this subclass re-raises them, matching the reference's failed-future
+    semantics (finishWorkMPIError, ProcessGroupCGX.cc:120-123)."""
+
+    def __init__(self, fut: Future):
+        super().__init__()
+        self._fut = fut
+
+    def wait(self, timeout=None):
+        self._fut.wait()  # re-raises the worker's exception
+        return True
+
+    def is_completed(self):
+        return self._fut.done()
+
+    def is_success(self):
+        if not self._fut.done():
+            return False
+        try:
+            self._fut.value()
+            return True
+        except Exception:
+            return False
+
+    def get_future(self):
+        return self._fut
+
+
 class ProcessGroupCGX(dist.ProcessGroup):
     """Store-transport c10d process group with quantized allreduce.
 
@@ -247,12 +278,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def _submit(self, fn, result) -> dist.Work:
         fut = Future()
         self._jobs.put((fn, fut, result))
-        return _create_work_from_future(fut)
+        return _CGXWork(fut)
 
     def _done(self, result) -> dist.Work:
         fut = Future()
         fut.set_result(result)
-        return _create_work_from_future(fut)
+        return _CGXWork(fut)
 
     # -- store transport --------------------------------------------------
 
