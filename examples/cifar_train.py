@@ -100,14 +100,11 @@ def main():
     import optax
 
     from torch_cgx_tpu import CompressionConfig, set_layer_pattern_config
+    from torch_cgx_tpu import data as cgx_data
     from torch_cgx_tpu.config import TopologyConfig
     from torch_cgx_tpu.models import ResNet18
     from torch_cgx_tpu.parallel import mesh as mesh_mod
-    from torch_cgx_tpu.parallel.grad_sync import (
-        gradient_sync,
-        replicate,
-        shard_batch,
-    )
+    from torch_cgx_tpu.parallel.grad_sync import gradient_sync, replicate
     from jax.sharding import PartitionSpec as P
 
     num_classes = 100 if args.dataset == "cifar100" else 10
@@ -190,7 +187,7 @@ def main():
         jax.shard_map(
             _step,
             mesh=mesh,
-            in_specs=(P(), P(), P(), P(axes if len(axes) > 1 else axes[0])),
+            in_specs=(P(), P(), P(), P(axes)),
             out_specs=(P(), P(), P(), P(), P()),
             check_vma=False,
         ),
@@ -203,17 +200,25 @@ def main():
 
     data_rng = np.random.default_rng(args.seed)
     n = x_all.shape[0]
+
+    def sample_batches():
+        while True:
+            idx = data_rng.integers(0, n, size=args.batch_size)
+            yield {"image": x_all[idx], "label": y_all[idx]}
+
+    # Input pipeline: device placement sharded over the dp axes, with
+    # background prefetch overlapping H2D transfer and step compute.
+    batches = cgx_data.prefetch(
+        cgx_data.shard_batches(sample_batches(), mesh, axes)
+    )
+
     first_epoch_loss = last_loss = last_acc = None
     t0 = time.time()
     for epoch in range(args.epochs):
         losses, accs = [], []
         for s in range(args.steps_per_epoch):
-            idx = data_rng.integers(0, n, size=args.batch_size)
-            batch = shard_batch(
-                {"image": x_all[idx], "label": y_all[idx]}, mesh, axes
-            )
             params, batch_stats, opt_state, loss, acc = step(
-                params, batch_stats, opt_state, batch
+                params, batch_stats, opt_state, next(batches)
             )
             losses.append(float(loss))
             accs.append(float(acc))

@@ -1,0 +1,149 @@
+"""Input-pipeline tests (subsystem absent from the reference — see data.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_cgx_tpu import data as cgx_data
+from torch_cgx_tpu.parallel import flat_mesh
+
+
+def _arrays(n=32):
+    return {
+        "x": np.arange(n * 4, dtype=np.float32).reshape(n, 4),
+        "y": np.arange(n, dtype=np.int32),
+    }
+
+
+def test_iterate_batches_epochs_and_shapes():
+    batches = list(cgx_data.iterate_batches(_arrays(32), 8, epochs=2))
+    assert len(batches) == 8  # 4 per epoch x 2
+    assert batches[0]["x"].shape == (8, 4)
+    # without rng, order is deterministic
+    np.testing.assert_array_equal(batches[0]["y"], np.arange(8))
+
+
+def test_iterate_batches_shuffles_and_covers():
+    rng = np.random.default_rng(0)
+    batches = list(cgx_data.iterate_batches(_arrays(32), 8, rng=rng))
+    seen = np.sort(np.concatenate([b["y"] for b in batches]))
+    np.testing.assert_array_equal(seen, np.arange(32))  # a permutation
+    assert any(
+        not np.array_equal(b["y"], np.sort(b["y"])) for b in batches
+    ) or not np.array_equal(batches[0]["y"], np.arange(8))
+
+
+def test_iterate_batches_drop_remainder():
+    batches = list(cgx_data.iterate_batches(_arrays(30), 8))
+    assert len(batches) == 3
+    batches = list(
+        cgx_data.iterate_batches(_arrays(30), 8, drop_remainder=False)
+    )
+    assert len(batches) == 4 and batches[-1]["x"].shape[0] == 6
+
+
+def test_iterate_batches_validation():
+    with pytest.raises(ValueError, match="leading"):
+        next(cgx_data.iterate_batches(
+            {"x": np.zeros((4, 2)), "y": np.zeros(5)}, 2))
+    with pytest.raises(ValueError, match="batch_size"):
+        next(cgx_data.iterate_batches(_arrays(4), 8))
+
+
+def test_shard_batches_places_on_mesh():
+    mesh = flat_mesh()
+    it = cgx_data.shard_batches(
+        cgx_data.iterate_batches(_arrays(32), 16), mesh
+    )
+    b = next(it)
+    assert isinstance(b["x"], jax.Array)
+    assert b["x"].sharding.spec == jax.sharding.PartitionSpec("dp")
+    assert len(b["x"].addressable_shards) == len(jax.devices())
+
+
+def test_prefetch_order_and_exhaustion():
+    out = list(cgx_data.prefetch(iter(range(10)), size=3))
+    assert out == list(range(10))
+
+
+def test_prefetch_propagates_errors():
+    def gen():
+        yield 1
+        raise RuntimeError("boom")
+
+    it = cgx_data.prefetch(gen(), size=2)
+    assert next(it) == 1
+    with pytest.raises(RuntimeError, match="boom"):
+        next(it)
+
+
+def test_end_to_end_training_with_pipeline(monkeypatch):
+    """The docstring's typical loop, on the 8-device mesh with 4-bit grads."""
+    import optax
+
+    from torch_cgx_tpu import config as cgx_config
+    from torch_cgx_tpu.parallel import make_train_step, replicate
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    mesh = flat_mesh()
+    w_true = np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32)
+    xs = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    ys = (xs @ w_true).astype(np.float32)
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ p["w"] - batch["y"]) ** 2)
+
+    params = replicate({"w": jnp.zeros((4, 1))}, mesh)
+    opt = optax.adam(0.1)
+    opt_state = replicate(opt.init(params), mesh)
+    step = make_train_step(loss_fn, opt, mesh, donate=False)
+
+    it = cgx_data.prefetch(
+        cgx_data.shard_batches(
+            cgx_data.iterate_batches(
+                {"x": xs, "y": ys}, 32,
+                rng=np.random.default_rng(1), epochs=20,
+            ),
+            mesh,
+        )
+    )
+    first = last = None
+    for i, batch in enumerate(it):
+        params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
+        first = first if first is not None else float(loss)
+        last = float(loss)
+    assert last < 0.05 * first, (first, last)
+
+
+def test_prefetch_abandoned_consumer_stops_producer():
+    """Breaking out of the loop must unblock and stop the producer thread."""
+    import threading
+    import time
+
+    produced = []
+
+    def gen():
+        for i in range(1000):
+            produced.append(i)
+            yield i
+
+    before = threading.active_count()
+    it = cgx_data.prefetch(gen(), size=2)
+    assert next(it) == 0
+    it.close()  # GeneratorExit -> finally -> stop producer
+    deadline = time.monotonic() + 5
+    while threading.active_count() > before and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert threading.active_count() <= before, "prefetch thread leaked"
+    assert len(produced) < 1000, "producer ran unbounded after abandon"
+
+
+def test_shard_batches_remainder_raises_clearly():
+    mesh = flat_mesh()  # 8 devices
+    it = cgx_data.shard_batches(
+        cgx_data.iterate_batches(_arrays(30), 8, drop_remainder=False), mesh
+    )
+    next(it), next(it), next(it)  # 8, 8, 8
+    with pytest.raises(ValueError, match="not divisible"):
+        next(it)  # remainder of 6

@@ -397,3 +397,75 @@ def test_subgroup_ws3():
 @pytest.mark.torch_bridge
 def test_failed_work_recovers_ws2():
     _launch(_worker_failed_future, ws=2)
+
+
+def _worker_wait_timeout(rank: int, ws: int) -> None:
+    import datetime
+
+    import torch
+    import torch.distributed as dist
+
+    if rank == 0:
+        # Rank 1 never posts its chunk within the window: wait(timeout)
+        # must raise, not hang (c10d timeout contract).
+        t = torch.full((64,), 1.0)
+        work = dist.all_reduce(t, async_op=True)
+        try:
+            work.wait(timeout=datetime.timedelta(seconds=2))
+            raise AssertionError("expected timeout")
+        except RuntimeError as e:
+            assert "timed out" in str(e), e
+    # rank 1 deliberately skips the collective; both just exit (the
+    # _bootstrap barrier is skipped via a store flag below).
+
+
+@pytest.mark.torch_bridge
+def test_wait_timeout_ws2():
+    # A custom launch without the trailing barrier (rank 1 never joins the
+    # collective, so a barrier would deadlock).
+    import multiprocessing as mp
+    import tempfile
+
+    initfile = tempfile.mktemp(prefix="cgx_test_store_")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_bootstrap_no_barrier,
+            args=(r, 2, initfile, "_worker_wait_timeout", q),
+        )
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    errors = []
+    for _ in range(2):
+        rank, err = q.get(timeout=120)
+        if err is not None:
+            errors.append(f"rank {rank}:\n{err}")
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    assert not errors, "\n".join(errors)
+
+
+def _bootstrap_no_barrier(rank, ws, initfile, target_name, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, _REPO)
+        import torch.distributed as dist
+        import torch_cgx_tpu.torch_backend  # noqa: F401
+
+        dist.init_process_group(
+            "cgx", init_method=f"file://{initfile}", rank=rank, world_size=ws
+        )
+        globals()[target_name](rank, ws)
+        q.put((rank, None))
+    except Exception:
+        import traceback
+
+        q.put((rank, traceback.format_exc()))
+        raise

@@ -10,7 +10,7 @@ data-parallel front end, and a pure-Python torch.distributed backend.
 
 __version__ = "0.1.0"
 
-from . import checkpoint, config
+from . import checkpoint, config, data
 from .config import (
     CompressionConfig,
     TopologyConfig,

@@ -1,0 +1,125 @@
+"""Input pipeline: host batching, mesh-sharded placement, prefetch.
+
+The reference ships no data loading (SURVEY.md §0 — its example leans on
+torchvision); a standalone framework needs one. TPU-first design:
+
+* :func:`iterate_batches` — epochs/shuffle/drop-remainder batching over
+  in-memory numpy arrays (the scale of the reference's CIFAR recipe).
+* :func:`shard_batches` — place each host batch on the mesh, leading dim
+  sharded over the data-parallel axes. Under multi-host JAX each process
+  contributes only its local shard
+  (``jax.make_array_from_process_local_data``), so no host ever
+  materializes the global batch.
+* :func:`prefetch` — background-thread double buffering: the next batch's
+  host→device transfer overlaps the current step's compute (the role the
+  reference's side CUDA stream plays for comms, ProcessGroupCGX.cc:378-388,
+  here applied to input).
+
+Typical loop:
+
+    it = prefetch(shard_batches(
+        iterate_batches({"x": xs, "y": ys}, batch, rng=rng), mesh))
+    for batch in it: params, opt_state, loss = step(params, opt_state, batch)
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from .parallel import mesh as mesh_mod
+
+
+def iterate_batches(
+    arrays: Dict[str, np.ndarray],
+    batch_size: int,
+    *,
+    rng: Optional[np.random.Generator] = None,
+    epochs: Optional[int] = 1,
+    drop_remainder: bool = True,
+) -> Iterator[Dict[str, np.ndarray]]:
+    """Yield dict batches from equal-length arrays. ``rng`` shuffles per
+    epoch; ``epochs=None`` repeats forever."""
+    n = len(next(iter(arrays.values())))
+    for a in arrays.values():
+        if len(a) != n:
+            raise ValueError("all arrays must share the leading dimension")
+    if batch_size > n and drop_remainder:
+        raise ValueError(f"batch_size {batch_size} > dataset size {n}")
+    epoch = 0
+    while epochs is None or epoch < epochs:
+        idx = rng.permutation(n) if rng is not None else np.arange(n)
+        stop = n - batch_size + 1 if drop_remainder else n
+        for off in range(0, stop, batch_size):
+            take = idx[off : off + batch_size]
+            yield {k: a[take] for k, a in arrays.items()}
+        epoch += 1
+
+
+def shard_batches(
+    it: Iterator[Dict[str, np.ndarray]],
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+) -> Iterator[Dict[str, jax.Array]]:
+    """Device-place each batch with the leading dim sharded over ``axes``
+    (delegates to :func:`..parallel.grad_sync.shard_batch`, which also
+    handles multi-host assembly). Batch sizes must divide the mesh's
+    data-parallel extent — pair with ``drop_remainder=True``."""
+    from .parallel.grad_sync import shard_batch
+
+    for batch in it:
+        yield shard_batch(batch, mesh, axes)
+
+
+def prefetch(it: Iterator, size: int = 2) -> Iterator:
+    """Run ``it`` in a background thread, keeping ``size`` batches in
+    flight so host→device transfer overlaps step compute.
+
+    Abandoning the iterator (break / GeneratorExit / gc) stops the producer
+    thread and drops its buffered batches — no thread or device-memory leak
+    when a training loop exits before the stream is exhausted."""
+    if size < 1:
+        raise ValueError(f"prefetch size must be >= 1, got {size}")
+    q: queue.Queue = queue.Queue(maxsize=size)
+    stop = threading.Event()
+    _END = object()
+
+    def _put(item) -> bool:
+        while not stop.is_set():
+            try:
+                q.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for item in it:
+                if not _put(item):
+                    return
+            _put(_END)
+        except BaseException as e:  # surfaced on the consumer side
+            _put(e)
+
+    t = threading.Thread(target=producer, name="cgx-prefetch", daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        while True:  # drop buffered refs so the producer unblocks and exits
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break

@@ -110,7 +110,7 @@ def make_train_step(
 
     axes = tuple(axes)
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
-    batch_spec = P(axes if len(axes) > 1 else axes[0])
+    batch_spec = P(axes)
     wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
 
     def _step(params, opt_state, batch, step_idx):
@@ -157,10 +157,29 @@ def replicate(tree, mesh):
 
 
 def shard_batch(batch, mesh, axes: Sequence[str] = (mesh_mod.DP_AXIS,)):
-    """Shard batch leaves along their leading dimension over ``axes``."""
+    """Shard batch leaves along their leading dimension over ``axes``.
+
+    Multi-host: each process passes its *local* slice and JAX assembles the
+    global array (``make_array_from_process_local_data``) — no host ever
+    materializes the global batch.
+    """
     from jax.sharding import NamedSharding
 
     axes = tuple(axes)
-    spec = P(axes if len(axes) > 1 else axes[0])
-    sharding = NamedSharding(mesh, spec)
-    return jax.tree.map(lambda x: jax.device_put(x, sharding), batch)
+    sharding = NamedSharding(mesh, P(axes))
+    ws = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def place(x):
+        if hasattr(x, "shape") and x.shape and x.shape[0] % ws:
+            raise ValueError(
+                f"batch leading dim {x.shape[0]} not divisible by the "
+                f"{ws}-way data-parallel mesh (drop or pad the remainder "
+                "batch; see data.iterate_batches(drop_remainder=True))"
+            )
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(
+                sharding, np.asarray(x)
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree.map(place, batch)

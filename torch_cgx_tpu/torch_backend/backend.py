@@ -213,6 +213,19 @@ class _CGXWork(dist.Work):
         self._fut = fut
 
     def wait(self, timeout=None):
+        # c10d contract: raise on expiry. timeout None/<=0 means block
+        # forever; torch passes a datetime.timedelta.
+        seconds = timeout.total_seconds() if timeout is not None else 0.0
+        if seconds > 0:
+            import time as _time
+
+            deadline = _time.monotonic() + seconds
+            while not self._fut.done():
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"cgx: work timed out after {seconds}s"
+                    )
+                _time.sleep(0.001)
         self._fut.wait()  # re-raises the worker's exception
         return True
 
