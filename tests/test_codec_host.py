@@ -167,7 +167,7 @@ def test_native_executor_async():
             packed, meta = native.quantize_f32(x[:1], 4, 512)  # shape probe
             packed = np.empty(codec.packed_words(-(-20_000 // 512) * 512, 4),
                               np.uint32)
-            meta = np.empty((2, -(-20_000 // 512)), np.float32)
+            meta = np.empty((-(-20_000 // 512), 2), np.float32)
             jobs.append((ex.submit_quantize(x, 4, 512, packed, meta),
                          x, packed, meta))
         for jid, x, packed, meta in jobs:

@@ -245,3 +245,26 @@ def test_bf16_constant_exact():
         lambda x: reducers.sra_allreduce(x, "dp", WS, cc),
     )
     check_exact(out.astype(np.float32), np.full((1024,), EXPECT_CONST, np.float32))
+
+
+def test_fake_ratio_traffic_shaping(monkeypatch):
+    # CGX_COMPRESSION_FAKE_RATIO=0.5: only the leading half of the slice is
+    # reduced; the tail keeps each rank's local (pre-divided) values
+    # (mpi_allreduce_operations.cc:130-144 — debug knob, breaks correctness
+    # by design).
+    from torch_cgx_tpu.parallel.allreduce import allreduce_flat
+
+    monkeypatch.setenv("CGX_COMPRESSION_FAKE_RATIO", "0.5")
+    cc = CompressionConfig(bits=4, bucket_size=512)
+    n = 2048
+    inputs = constant_inputs(n)
+    mesh = _flat_mesh()
+    out = run_flat(
+        inputs,
+        lambda x: allreduce_flat(x, cc, mesh=mesh, axes=("dp",)),
+    )
+    head, tail = out[:, : n // 2], out[:, n // 2 :]
+    assert np.array_equal(
+        head, np.full((WS, n // 2), EXPECT_CONST, np.float32)
+    ), "reduced head must be exact on constants"
+    assert np.array_equal(tail, inputs[:, n // 2 :]), "tail must stay local"

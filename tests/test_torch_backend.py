@@ -399,6 +399,94 @@ def test_failed_work_recovers_ws2():
     _launch(_worker_failed_future, ws=2)
 
 
+def _worker_bucket_disambiguation(rank: int, ws: int) -> None:
+    import torch
+    import torch.distributed as dist
+    from torch_cgx_tpu import config as cfg
+
+    # Two registered buckets share the same TOTAL numel but have different
+    # layer layouts/configs. The hook-style tag must select the right one;
+    # an untagged allreduce of that size is ambiguous and must raise
+    # (reference extractLayers errors on mismatch,
+    # mpi_allreduce_operations.cc:278-284).
+    cfg.clear_registry()
+    cfg.register_layer("bucketA", 0, 4096, 2, 64)    # aggressive 2-bit
+    cfg.register_layer("bucketA", 1, 1000, 2, 64)
+    cfg.register_layer("bucketB", 0, 1000, 32, 0)    # fully raw
+    cfg.register_layer("bucketB", 1, 4096, 32, 0)
+    n = 5096
+    x = torch.linspace(-1, 1, n) * (rank + 1)
+    exact = torch.linspace(-1, 1, n) * _sum_expect(ws)
+
+    # Tagged as the raw bucket: exact result.
+    t = x.clone()
+    cfg.set_current_bucket("bucketB")
+    dist.all_reduce(t)
+    assert torch.allclose(t, exact, atol=1e-5), "bucketB must be exact"
+
+    # Tagged as the 2-bit bucket: quantization error must appear.
+    t = x.clone()
+    cfg.set_current_bucket("bucketA")
+    dist.all_reduce(t)
+    assert not torch.allclose(t, exact, atol=1e-6), "bucketA must quantize"
+
+    # Untagged + ambiguous total: the Work future fails.
+    t = x.clone()
+    try:
+        dist.all_reduce(t)
+        raise AssertionError("ambiguous untagged allreduce should raise")
+    except RuntimeError as e:
+        assert "matches 2 registered buckets" in str(e), e
+
+    # Tagged with a stale/mismatched registration: loud error, not silence.
+    cfg.set_current_bucket("bucketA")
+    t = torch.zeros(77)
+    try:
+        dist.all_reduce(t)
+        raise AssertionError("size-mismatched tag should raise")
+    except RuntimeError as e:
+        assert "registered layer sizes" in str(e), e
+    cfg.clear_registry()
+    dist.barrier()
+
+
+def _worker_async_p2p(rank: int, ws: int) -> None:
+    import time
+
+    import torch
+    import torch.distributed as dist
+
+    # recv must return a live Work immediately (AsyncWork model) and the
+    # collective worker must stay unblocked while the recv is pending.
+    if rank == 1:
+        r = torch.zeros(1000)
+        work = dist.irecv(r, src=0)
+        assert not work.is_completed(), "recv completed before the send"
+        # Collectives progress while the recv is parked.
+        t = torch.full((256,), float(rank + 1))
+        dist.all_reduce(t)
+        assert t[0].item() == _sum_expect(ws)
+        work.wait()
+        assert torch.equal(r, torch.arange(1000, dtype=torch.float32))
+    else:
+        time.sleep(0.5)  # ensure the recv is posted and parked first
+        t = torch.full((256,), float(rank + 1))
+        dist.all_reduce(t)
+        if rank == 0:
+            dist.isend(torch.arange(1000, dtype=torch.float32), dst=1).wait()
+    dist.barrier()
+
+
+@pytest.mark.torch_bridge
+def test_bucket_disambiguation_ws2():
+    _launch(_worker_bucket_disambiguation, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_async_p2p_ws2():
+    _launch(_worker_async_p2p, ws=2)
+
+
 def _worker_wait_timeout(rank: int, ws: int) -> None:
     import datetime
 

@@ -44,6 +44,18 @@ _FALLBACK_FILE = "tree.npz"
 # ---------------------------------------------------------------------------
 
 
+def _bucket_key_str(b) -> str:
+    # Bucket keys are ints (public register_layer API) or hashable tuples
+    # (the DDP hook's (namespace, index) keys); JSON round-trip via dumps.
+    return json.dumps(b)
+
+
+def _bucket_key_from(v):
+    if isinstance(v, str):
+        v = json.loads(v)
+    return tuple(v) if isinstance(v, list) else v
+
+
 def registry_snapshot() -> Dict[str, Any]:
     """JSON-able dump of all three per-layer config registries."""
     numeric = [
@@ -54,7 +66,7 @@ def registry_snapshot() -> Dict[str, Any]:
         }
         for (b, li), c in cfg._layer_configs.items()
     ]
-    sizes = {str(b): s for b, s in cfg._layer_sizes.items()}
+    sizes = {_bucket_key_str(b): s for b, s in cfg._layer_sizes.items()}
     patterns = [
         {"pattern": p, "config": dataclasses.asdict(c)}
         for p, c in cfg._pattern_configs.items()
@@ -66,11 +78,10 @@ def restore_registry(snap: Dict[str, Any]) -> None:
     """Re-install a :func:`registry_snapshot` (clears current registries)."""
     cfg.clear_registry()
     for b, s in snap.get("sizes", {}).items():
-        cfg._layer_sizes[int(b)] = list(s)
+        cfg._layer_sizes[_bucket_key_from(b)] = list(s)
     for item in snap.get("numeric", []):
-        cfg._layer_configs[(item["bucket_idx"], item["layer_idx"])] = (
-            cfg.CompressionConfig(**item["config"])
-        )
+        key = (_bucket_key_from(item["bucket_idx"]), item["layer_idx"])
+        cfg._layer_configs[key] = cfg.CompressionConfig(**item["config"])
     for item in snap.get("patterns", []):
         cfg.set_layer_pattern_config(
             item["pattern"], cfg.CompressionConfig(**item["config"])
@@ -138,8 +149,9 @@ def save(
             json.dump(registry_snapshot(), f, indent=1)
     ocp = _orbax()
     if ocp is not None:
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = ocp.StandardCheckpointer()
         ckptr.save(os.path.abspath(path), host_tree, force=force)
+        ckptr.wait_until_finished()
     else:  # numpy fallback: flat keypath -> array archive
         os.makedirs(path, exist_ok=True)
         np.savez(os.path.join(path, _FALLBACK_FILE),
@@ -165,10 +177,10 @@ def restore(
     path = _step_dir(directory, step)
     ocp = _orbax()
     if ocp is not None:
-        ckptr = ocp.PyTreeCheckpointer()
+        ckptr = ocp.StandardCheckpointer()
         if target is not None:
             host_target = jax.tree.map(np.asarray, target)
-            tree = ckptr.restore(os.path.abspath(path), item=host_target)
+            tree = ckptr.restore(os.path.abspath(path), host_target)
         else:
             tree = ckptr.restore(os.path.abspath(path))
     else:

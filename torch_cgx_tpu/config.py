@@ -22,7 +22,8 @@ from __future__ import annotations
 
 import dataclasses
 import re
-from typing import Dict, Optional, Tuple
+import threading
+from typing import Dict, Hashable, Optional, Tuple
 
 from .utils import env as _env
 
@@ -189,6 +190,18 @@ def topology_from_env() -> TopologyConfig:
     )
 
 
+def fake_ratio() -> Optional[float]:
+    """CGX_COMPRESSION_FAKE_RATIO: debug traffic shaping — reduce only the
+    leading ``ratio`` fraction of each compressed slice so transport cost can
+    be measured at a synthetic compression ratio
+    (mpi_allreduce_operations.cc:130-144). Deliberately breaks correctness
+    (the tail is left un-reduced), exactly like the reference. None = off."""
+    v = _env.get_float_env_or_default(COMPRESSION_FAKE_RATIO, 0.0)
+    if v <= 0.0 or v >= 1.0:
+        return None
+    return v
+
+
 def dummy_compression() -> bool:
     """CGX_DEBUG_DUMMY_COMPRESSION: pass-through codec for debugging
     (mpi_allreduce_operations.cc:46-54)."""
@@ -283,6 +296,25 @@ def registered_layer_sizes(bucket_idx: int) -> Optional[list]:
 def registered_buckets() -> list:
     """Bucket indices with registered layer sizes (torch bridge lookup)."""
     return list(_layer_sizes.keys())
+
+
+# Side channel: the DDP hook tags the bucket it is about to allreduce so the
+# backend can resolve per-layer configs by *identity* instead of guessing from
+# the buffer's element count — the analogue of the reference's explicit
+# ``bucket_idx_`` rotation (mpi_allreduce_operations.cc:257-285). Thread-local
+# because the tag is consumed on the same thread, inside the same
+# ``dist.all_reduce`` call the hook makes.
+_tls = threading.local()
+
+
+def set_current_bucket(bucket_key: Optional[Hashable]) -> None:
+    _tls.current_bucket = bucket_key
+
+
+def take_current_bucket() -> Optional[Hashable]:
+    key = getattr(_tls, "current_bucket", None)
+    _tls.current_bucket = None
+    return key
 
 
 def stochastic_rounding() -> bool:

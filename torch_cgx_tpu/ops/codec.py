@@ -16,10 +16,19 @@ SURVEY.md §2.1). Same math, different packing layout:
   (2 * num_buckets * elem_size wire bytes, compressor.cc:401-419).
 * **Packing**: the reference packs 8-value groups into ``bits`` bytes
   (PACK_SIZE=8, .cu:155-217). TPUs have no byte-addressable scatter, so we
-  pack 32-value groups into ``bits`` uint32 words in a **bit-plane layout**
-  (word ``w`` of a group holds bit ``w`` of each of the 32 values) — the
-  same wire density (n*bits/8 bytes for 32-aligned n), fully vectorizable
-  on the VPU with shifts/ors, uniform for every bits in 1..8.
+  pack 32 values into ``bits`` uint32 words in a **bit-plane layout** (word
+  ``w`` holds bit ``w`` of each of the 32 values) — same wire density
+  (n*bits/8 bytes for 32-aligned n), fully vectorizable with shifts/ors.
+  The 32 values of a word are chosen **sublane-natively**: buckets are
+  grouped into chunks of 32; within a full chunk, word ``(c, w, l)`` (flat
+  index ``c*bits*B + w*B + l``) packs bit ``w`` of the values at position
+  ``l`` of each of the chunk's 32 buckets (bit ``s`` = bucket row ``s``).
+  On a TPU this makes packing a pure cross-sublane reduction of the natural
+  ``(buckets, bucket_size)`` layout — no transposes, rolls, or strided
+  stores anywhere (see codec_pallas.py). The final ``nb % 32`` buckets use
+  the dense fallback (32 *consecutive* values per word, ``bits`` words per
+  group), so total wire size is exactly ``ceil(n*bits/32)`` words — one
+  format, two regions, both implemented by every codec backend.
 * **fp16 → bfloat16**: TPU-native 16-bit float replaces the reference's
   ``__half`` support; fp32 is identical.
 
@@ -71,7 +80,8 @@ class QTensor:
     """Quantized wire tensor: packed bit-plane payload + per-bucket meta.
 
     ``packed``: uint32[packed_words(numel_main, bits)]
-    ``meta``:   dtype[2, num_buckets] — row 0 = unit, row 1 = min
+    ``meta``:   dtype[num_buckets, 2] — per-bucket (unit, min) pairs, the
+    reference's interleaved per-bucket meta layout (compressor.cc:401-419)
     ``residual``: raw tail for skip_incomplete_buckets mode (possibly
     length-0), carried uncompressed like the reference's residual memcpy
     (compressor.cc:315-339).
@@ -115,11 +125,67 @@ class QTensor:
 # ---------------------------------------------------------------------------
 
 
-def pack_levels(levels: jax.Array, bits: int) -> jax.Array:
-    """Pack uint32 levels (< 2^bits) into bit-plane uint32 words.
+CHUNK_BUCKETS = 32  # buckets per sublane-packed chunk
 
-    levels: flat uint32[m] -> uint32[ceil(m/32) * bits], grouped as
-    ``bits`` consecutive words per 32-value group.
+
+def pack_levels_bucketed(lvl: jax.Array, bits: int) -> jax.Array:
+    """Pack per-bucket levels ``uint32[nb, B]`` into the chunked-sublane wire
+    layout: full 32-bucket chunks sublane-packed, dense tail for the rest.
+    Returns flat ``uint32[nb*B*bits/32]`` (B % 32 == 0) /
+    ``ceil(nb*B/32)*bits`` generally."""
+    nb, b = lvl.shape
+    c, r = divmod(nb, CHUNK_BUCKETS)
+    parts = []
+    if c:
+        head = lvl[: c * CHUNK_BUCKETS].reshape(c, CHUNK_BUCKETS, b)
+        sub = jax.lax.broadcasted_iota(jnp.uint32, (1, CHUNK_BUCKETS, 1), 1)
+        planes = [
+            jnp.sum(
+                ((head >> np.uint32(w)) & np.uint32(1)) << sub,
+                axis=1,
+                dtype=jnp.uint32,
+            )
+            for w in range(bits)
+        ]
+        parts.append(jnp.stack(planes, axis=1).reshape(-1))  # (c, bits, b)
+    if r:
+        parts.append(pack_levels(lvl[c * CHUNK_BUCKETS :].reshape(-1), bits))
+    if not parts:
+        return jnp.zeros((0,), jnp.uint32)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def unpack_levels_bucketed(
+    words: jax.Array, bits: int, nb: int, bucket_size: int
+) -> jax.Array:
+    """Inverse of :func:`pack_levels_bucketed` -> uint32[nb, bucket_size]."""
+    b = bucket_size
+    c, r = divmod(nb, CHUNK_BUCKETS)
+    parts = []
+    head_words = c * bits * b
+    if c:
+        w3 = words[:head_words].reshape(c, bits, b)
+        sub = jax.lax.broadcasted_iota(
+            jnp.uint32, (c, CHUNK_BUCKETS, b), 1
+        )
+        lvl = jnp.zeros((c, CHUNK_BUCKETS, b), jnp.uint32)
+        for w in range(bits):
+            plane = (w3[:, w : w + 1, :] >> sub) & np.uint32(1)
+            lvl = lvl | (plane << np.uint32(w))
+        parts.append(lvl.reshape(c * CHUNK_BUCKETS, b))
+    if r:
+        tail = unpack_levels(words[head_words:], bits, r * b)
+        parts.append(tail.reshape(r, b))
+    if not parts:
+        return jnp.zeros((0, b), jnp.uint32)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def pack_levels(levels: jax.Array, bits: int) -> jax.Array:
+    """Dense (tail) packing: uint32 levels (< 2^bits) -> bit-plane words,
+    32 *consecutive* values per group, ``bits`` words per group.
+
+    levels: flat uint32[m] -> uint32[ceil(m/32) * bits].
     """
     m = levels.shape[0]
     groups = -(-m // LANE_GROUP) if m else 0
@@ -168,7 +234,10 @@ def compute_meta(
     """Per-bucket (unit, min) in float32. xb: f32[nb, bucket_size]."""
     bmax = jnp.max(xb, axis=1)
     bmin = jnp.min(xb, axis=1)
-    unit = (bmax - bmin) / np.float32((1 << bits) - 1)
+    # Multiply by the precomputed f32 reciprocal, NOT divide: compilers may
+    # (or may not) strength-reduce division-by-constant per call site, which
+    # would break cross-implementation byte-identity of the meta by 1 ulp.
+    unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
     return unit, bmin
 
 
@@ -209,7 +278,7 @@ def quantize(
     if nb == 0:
         return QTensor(
             packed=jnp.zeros((0,), jnp.uint32),
-            meta=jnp.zeros((2, 0), dtype),
+            meta=jnp.zeros((0, 2), dtype),
             residual=residual,
             numel=n,
             bits=bits,
@@ -232,8 +301,8 @@ def quantize(
         rand = jax.random.uniform(key, xb.shape, dtype=jnp.float32)
     lvl = encode_levels(xb, unit, bmin, bits, rand)
 
-    packed = pack_levels(lvl.reshape(-1), bits)
-    meta = jnp.stack([unit, bmin]).astype(dtype)
+    packed = pack_levels_bucketed(lvl, bits)
+    meta = jnp.stack([unit, bmin], axis=1).astype(dtype)
     return QTensor(
         packed=packed,
         meta=meta,
@@ -271,10 +340,9 @@ def dequantize(
     main_n = q.numel_main
     nb = num_buckets(main_n, q.bucket_size)
     if nb:
-        padded_n = nb * q.bucket_size
-        lvl = unpack_levels(q.packed, q.bits, padded_n).reshape(nb, q.bucket_size)
-        unit = q.meta[0].astype(jnp.float32)
-        bmin = q.meta[1].astype(jnp.float32)
+        lvl = unpack_levels_bucketed(q.packed, q.bits, nb, q.bucket_size)
+        unit = q.meta[:, 0].astype(jnp.float32)
+        bmin = q.meta[:, 1].astype(jnp.float32)
         vals = decode_levels(lvl, unit, bmin).reshape(-1)[:main_n]
     else:
         vals = jnp.zeros((0,), jnp.float32)
@@ -299,7 +367,7 @@ def quantize_dummy(x: jax.Array) -> QTensor:
     packed = jax.lax.bitcast_convert_type(as_f32, jnp.uint32)
     return QTensor(
         packed=packed,
-        meta=jnp.zeros((2, 0), x.dtype),
+        meta=jnp.zeros((0, 2), x.dtype),
         residual=jnp.zeros((0,), x.dtype),
         numel=n,
         bits=0,

@@ -33,7 +33,7 @@ class HostQTensor:
     """Host-side quantized buffer; mirrors :class:`codec.QTensor` fields."""
 
     packed: np.ndarray  # uint32[packed_words(numel_main, bits)]
-    meta: np.ndarray  # dtype[2, num_buckets] — row 0 = unit, row 1 = min
+    meta: np.ndarray  # dtype[num_buckets, 2] — (unit, min) per bucket
     residual: np.ndarray  # dtype[res_n]
     numel: int
     bits: int
@@ -94,7 +94,7 @@ def from_bytes(
     assert buf.nbytes >= total, (buf.nbytes, total)
     buf = np.ascontiguousarray(buf.reshape(-1).view(np.uint8)[:total])
     nb = meta_b // (2 * dtype.itemsize)
-    meta = buf[:meta_b].view(dtype).reshape(2, nb)
+    meta = buf[:meta_b].view(dtype).reshape(nb, 2)
     packed = buf[meta_b : meta_b + packed_b].view(np.uint32)
     residual = buf[meta_b + packed_b :].view(dtype)
     return HostQTensor(
@@ -109,6 +109,7 @@ def from_bytes(
 
 
 def pack_levels(levels: np.ndarray, bits: int) -> np.ndarray:
+    """Dense (tail-region) packing: 32 consecutive values per group."""
     m = levels.shape[0]
     if m == 0:
         return np.zeros((0,), np.uint32)
@@ -125,6 +126,7 @@ def pack_levels(levels: np.ndarray, bits: int) -> np.ndarray:
 
 
 def unpack_levels(words: np.ndarray, bits: int, m: int) -> np.ndarray:
+    """Inverse of dense :func:`pack_levels`."""
     if m == 0:
         return np.zeros((0,), np.uint32)
     groups = -(-m // LANE_GROUP)
@@ -135,6 +137,54 @@ def unpack_levels(words: np.ndarray, bits: int, m: int) -> np.ndarray:
         plane = (w2[:, w : w + 1] >> lane) & np.uint32(1)
         lvl |= plane << np.uint32(w)
     return lvl.reshape(-1)[:m]
+
+
+def pack_levels_bucketed(lvl: np.ndarray, bits: int) -> np.ndarray:
+    """Chunked-sublane wire layout, numpy mirror of
+    ``codec.pack_levels_bucketed``: full 32-bucket chunks pack word
+    ``(c, w, l)`` from bit ``w`` of the chunk's 32 buckets at position ``l``;
+    the final ``nb % 32`` buckets use the dense layout."""
+    nb, b = lvl.shape
+    c, r = divmod(nb, jcodec.CHUNK_BUCKETS)
+    parts = []
+    if c:
+        head = lvl[: c * jcodec.CHUNK_BUCKETS].reshape(
+            c, jcodec.CHUNK_BUCKETS, b
+        )
+        sub = np.arange(jcodec.CHUNK_BUCKETS, dtype=np.uint32)[None, :, None]
+        out = np.empty((c, bits, b), np.uint32)
+        for w in range(bits):
+            plane = (head >> np.uint32(w)) & np.uint32(1)
+            out[:, w, :] = (plane << sub).sum(axis=1, dtype=np.uint32)
+        parts.append(out.reshape(-1))
+    if r:
+        parts.append(pack_levels(lvl[c * jcodec.CHUNK_BUCKETS :].reshape(-1), bits))
+    if not parts:
+        return np.zeros((0,), np.uint32)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+
+def unpack_levels_bucketed(
+    words: np.ndarray, bits: int, nb: int, bucket_size: int
+) -> np.ndarray:
+    """Inverse of :func:`pack_levels_bucketed` -> uint32[nb, bucket_size]."""
+    b = bucket_size
+    c, r = divmod(nb, jcodec.CHUNK_BUCKETS)
+    parts = []
+    head_words = c * bits * b
+    if c:
+        w3 = words[:head_words].reshape(c, bits, b)
+        sub = np.arange(jcodec.CHUNK_BUCKETS, dtype=np.uint32)[None, :, None]
+        lvl = np.zeros((c, jcodec.CHUNK_BUCKETS, b), np.uint32)
+        for w in range(bits):
+            plane = (w3[:, w : w + 1, :] >> sub) & np.uint32(1)
+            lvl |= plane << np.uint32(w)
+        parts.append(lvl.reshape(c * jcodec.CHUNK_BUCKETS, b))
+    if r:
+        parts.append(unpack_levels(words[head_words:], bits, r * b).reshape(r, b))
+    if not parts:
+        return np.zeros((0, b), np.uint32)
+    return parts[0] if len(parts) == 1 else np.concatenate(parts)
 
 
 # ---------------------------------------------------------------------------
@@ -179,7 +229,7 @@ def quantize(
     if nb == 0:
         return HostQTensor(
             packed=np.zeros((0,), np.uint32),
-            meta=np.zeros((2, 0), dtype),
+            meta=np.zeros((0, 2), dtype),
             residual=residual,
             numel=n, bits=bits, bucket_size=bucket_size, dtype=dtype,
         )
@@ -199,7 +249,8 @@ def quantize(
     xb = padded.reshape(nb, bucket_size).astype(np.float32)
     bmax = xb.max(axis=1)
     bmin = xb.min(axis=1)
-    unit = (bmax - bmin) / np.float32((1 << bits) - 1)
+    # Reciprocal-multiply like codec.compute_meta (cross-impl byte-identity).
+    unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
     safe = np.where(unit > 0, unit, np.float32(1.0))
     if stochastic and rng is None:
         raise ValueError("stochastic rounding requires an rng")
@@ -210,8 +261,8 @@ def quantize(
     )
     lvl = np.floor((xb - bmin[:, None]) / safe[:, None] + r)
     lvl = np.clip(lvl, 0, (1 << bits) - 1).astype(np.uint32)
-    packed = pack_levels(lvl.reshape(-1), bits)
-    meta = np.stack([unit, bmin]).astype(dtype)
+    packed = pack_levels_bucketed(lvl, bits)
+    meta = np.stack([unit, bmin], axis=1).astype(dtype)
     return HostQTensor(
         packed=packed, meta=meta, residual=residual,
         numel=n, bits=bits, bucket_size=bucket_size, dtype=dtype,
@@ -238,12 +289,9 @@ def dequantize(
                 q.bucket_size, main_n,
             )
         else:
-            padded_n = nb * q.bucket_size
-            lvl = unpack_levels(q.packed, q.bits, padded_n).reshape(
-                nb, q.bucket_size
-            )
-            unit = q.meta[0].astype(np.float32)[:, None]
-            bmin = q.meta[1].astype(np.float32)[:, None]
+            lvl = unpack_levels_bucketed(q.packed, q.bits, nb, q.bucket_size)
+            unit = q.meta[:, 0].astype(np.float32)[:, None]
+            bmin = q.meta[:, 1].astype(np.float32)[:, None]
             vals = (bmin + unit * lvl.astype(np.float32)).reshape(-1)[:main_n]
     else:
         vals = np.zeros((0,), np.float32)

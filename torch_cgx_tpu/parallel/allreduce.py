@@ -115,6 +115,14 @@ def allreduce_flat(
     buffers are chunked like performOperationSingle (.cc:187-199)."""
     topo = topology or cfg_mod.topology_from_env()
     n = flat.shape[0]
+    ratio = cfg_mod.fake_ratio()
+    tail = None
+    if ratio is not None and cc.enabled and n > 1:
+        # Debug traffic shaping (mpi_allreduce_operations.cc:130-144): only
+        # the leading ratio*n elements travel; the tail stays un-reduced.
+        m = max(1, int(np.ceil(ratio * n)))
+        tail = lax.slice(flat, (m,), (n,))
+        flat, n = lax.slice(flat, (0,), (m,)), m
     pieces = []
     for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
         piece = lax.slice(flat, (off,), (off + ln,))
@@ -143,6 +151,8 @@ def allreduce_flat(
             )
         else:
             raise ValueError(f"axes must have 1 or 2 names, got {axes!r}")
+    if tail is not None:
+        pieces.append(tail)
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 

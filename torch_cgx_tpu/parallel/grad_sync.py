@@ -168,12 +168,18 @@ def shard_batch(batch, mesh, axes: Sequence[str] = (mesh_mod.DP_AXIS,)):
     axes = tuple(axes)
     sharding = NamedSharding(mesh, P(axes))
     ws = int(np.prod([mesh.shape[a] for a in axes]))
+    # Multi-host: each process contributes only its local slice, so the
+    # divisibility requirement is the per-process device count along the dp
+    # axes, not the global extent.
+    procs = jax.process_count()
+    local_ws = ws // procs if procs > 1 and ws % procs == 0 else ws
 
     def place(x):
-        if hasattr(x, "shape") and x.shape and x.shape[0] % ws:
+        if hasattr(x, "shape") and x.shape and x.shape[0] % local_ws:
             raise ValueError(
-                f"batch leading dim {x.shape[0]} not divisible by the "
-                f"{ws}-way data-parallel mesh (drop or pad the remainder "
+                f"local batch leading dim {x.shape[0]} not divisible by the "
+                f"per-process data-parallel extent {local_ws} (global mesh "
+                f"{ws}, {procs} processes; drop or pad the remainder "
                 "batch; see data.iterate_batches(drop_remainder=True))"
             )
         if jax.process_count() > 1:

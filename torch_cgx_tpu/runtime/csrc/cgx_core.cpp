@@ -10,10 +10,15 @@
 //
 // Wire format (identical to torch_cgx_tpu.ops.codec):
 //   * buckets of `bucket_size` values; meta = (unit, min) per bucket,
-//     stored as meta[0][b] = unit, meta[1][b] = min.
-//   * payload = bit-plane packing: values in groups of 32 lanes; a group
-//     occupies `bits` uint32 words; word w holds bit w of all 32 lanes,
-//     lane i at bit position i.
+//     stored as interleaved pairs meta[2*b] = unit, meta[2*b+1] = min
+//     (the reference's per-bucket pair layout, compressor.cc:401-419).
+//   * payload = chunked-sublane bit-plane packing: buckets grouped into
+//     chunks of 32. Within a full chunk c, the word at flat index
+//     c*bits*B + w*B + l holds bit w of the values at position l of each of
+//     the chunk's 32 buckets (bucket row s at bit position s). The final
+//     nb % 32 buckets use the dense fallback: 32 consecutive values per
+//     group, `bits` words per group, value i at bit position i, word w
+//     holding bit-plane w.
 //
 // Exposed via a plain C ABI for ctypes (no pybind11 in this image).
 
@@ -45,9 +50,10 @@ inline int64_t num_groups(int64_t n) {
 // buffer (padded region encoded from the edge value, matching the Python
 // codecs' edge-pad semantics).
 void quantize_range(const float* x, int64_t n, int bits, int64_t bucket,
-                    int64_t b0, int64_t b1, uint32_t* levels, float* meta_unit,
-                    float* meta_min) {
+                    int64_t b0, int64_t b1, uint32_t* levels, float* meta) {
   const float maxlvl = static_cast<float>((1u << bits) - 1u);
+  // Reciprocal-multiply like codec.compute_meta (cross-impl byte-identity).
+  const float inv_maxlvl = 1.0f / maxlvl;
   for (int64_t b = b0; b < b1; ++b) {
     const int64_t lo = b * bucket;
     const int64_t hi_real = std::min(lo + bucket, n);
@@ -57,12 +63,12 @@ void quantize_range(const float* x, int64_t n, int bits, int64_t bucket,
       mn = v < mn ? v : mn;
       mx = v > mx ? v : mx;
     }
-    const float unit = (mx - mn) / maxlvl;
+    const float unit = (mx - mn) * inv_maxlvl;
     // Divide (not multiply-by-reciprocal): keeps levels bit-identical to the
     // JAX/numpy codecs, whose floor((x-min)/unit + r) this mirrors.
     const float safe = unit > 0.f ? unit : 1.f;
-    meta_unit[b] = unit;
-    meta_min[b] = mn;
+    meta[2 * b] = unit;  // interleaved (unit, min) pairs, the wire layout
+    meta[2 * b + 1] = mn;
     const int64_t hi_pad = lo + bucket;
     const float edge = x[hi_real - 1];
     for (int64_t i = lo; i < hi_pad; ++i) {
@@ -74,8 +80,11 @@ void quantize_range(const float* x, int64_t n, int bits, int64_t bucket,
   }
 }
 
-void pack_range(const uint32_t* levels, int64_t padded_n, int bits, int64_t g0,
-                int64_t g1, uint32_t* packed) {
+constexpr int64_t kChunkBuckets = 32;
+
+// Dense (tail-region) packing of contiguous 32-value groups.
+void pack_range_dense(const uint32_t* levels, int bits, int64_t g0, int64_t g1,
+                      uint32_t* packed) {
   for (int64_t g = g0; g < g1; ++g) {
     const uint32_t* lv = levels + g * kLaneGroup;
     uint32_t* out = packed + g * bits;
@@ -87,16 +96,66 @@ void pack_range(const uint32_t* levels, int64_t padded_n, int bits, int64_t g0,
       out[w] = word;
     }
   }
-  (void)padded_n;
 }
 
-void unpack_decode_range(const uint32_t* packed, const float* meta_unit,
-                         const float* meta_min, int bits, int64_t bucket,
-                         int64_t n, int64_t g0, int64_t g1, float* out,
-                         bool add) {
+// Sublane-chunk packing of full 32-bucket chunks [c0, c1).
+void pack_range_chunked(const uint32_t* levels, int bits, int64_t bucket,
+                        int64_t c0, int64_t c1, uint32_t* packed) {
+  for (int64_t c = c0; c < c1; ++c) {
+    const uint32_t* lv = levels + c * kChunkBuckets * bucket;
+    uint32_t* out = packed + c * bits * bucket;
+    for (int w = 0; w < bits; ++w) {
+      uint32_t* word = out + w * bucket;
+      std::memset(word, 0, sizeof(uint32_t) * bucket);
+      for (int64_t s = 0; s < kChunkBuckets; ++s) {
+        const uint32_t* row = lv + s * bucket;
+        for (int64_t l = 0; l < bucket; ++l) {
+          word[l] |= ((row[l] >> w) & 1u) << s;
+        }
+      }
+    }
+  }
+}
+
+// Decode chunks [c0, c1) of the sublane-packed head region.
+void unpack_decode_chunked(const uint32_t* packed, const float* meta,
+                           int bits, int64_t bucket,
+                           int64_t n, int64_t c0, int64_t c1, float* out,
+                           bool add) {
+  for (int64_t c = c0; c < c1; ++c) {
+    const uint32_t* words = packed + c * bits * bucket;
+    for (int64_t s = 0; s < kChunkBuckets; ++s) {
+      const int64_t b = c * kChunkBuckets + s;
+      const int64_t base = b * bucket;
+      const int64_t lim = std::min(bucket, n - base);
+      if (lim <= 0) break;
+      const float unit = meta[2 * b];
+      const float mn = meta[2 * b + 1];
+      for (int64_t l = 0; l < lim; ++l) {
+        uint32_t lvl = 0;
+        for (int w = 0; w < bits; ++w) {
+          lvl |= ((words[w * bucket + l] >> s) & 1u) << w;
+        }
+        const float v = mn + unit * static_cast<float>(lvl);
+        if (add) {
+          out[base + l] += v;
+        } else {
+          out[base + l] = v;
+        }
+      }
+    }
+  }
+}
+
+// Decode dense tail groups [g0, g1) (group indices relative to the tail,
+// which starts at value offset `tail_off` and word offset `word_off`).
+void unpack_decode_dense(const uint32_t* packed, const float* meta,
+                         int bits, int64_t bucket,
+                         int64_t tail_off, int64_t n, int64_t g0, int64_t g1,
+                         float* out, bool add) {
   for (int64_t g = g0; g < g1; ++g) {
     const uint32_t* words = packed + g * bits;
-    const int64_t base = g * kLaneGroup;
+    const int64_t base = tail_off + g * kLaneGroup;
     const int64_t lim = std::min(base + kLaneGroup, n);
     for (int64_t lane = 0; base + lane < lim; ++lane) {
       uint32_t lvl = 0;
@@ -105,7 +164,7 @@ void unpack_decode_range(const uint32_t* packed, const float* meta_unit,
       }
       const int64_t i = base + lane;
       const int64_t b = i / bucket;
-      const float v = meta_min[b] + meta_unit[b] * static_cast<float>(lvl);
+      const float v = meta[2 * b + 1] + meta[2 * b] * static_cast<float>(lvl);
       if (add) {
         out[i] += v;
       } else {
@@ -232,22 +291,31 @@ int64_t cgx_num_buckets(int64_t n, int64_t bucket) {
   return num_buckets(n, bucket);
 }
 
-// x: f32[n] -> packed u32[cgx_packed_words(n, bits)], meta f32[2*nb]
-// (meta[0..nb) = unit, meta[nb..2nb) = min). Deterministic rounding.
+// x: f32[n] -> packed u32[cgx_packed_words(n, bits)], meta f32[nb][2]
+// (interleaved (unit, min) pairs). Deterministic rounding.
 void cgx_quantize_f32(const float* x, int64_t n, int32_t bits,
                       int64_t bucket, uint32_t* packed, float* meta) {
   const int64_t nb = num_buckets(n, bucket);
   const int64_t padded_n = nb * bucket;
   std::vector<uint32_t> levels(static_cast<size_t>(padded_n));
-  float* unit = meta;
-  float* mn = meta + nb;
   Executor* ex = default_pool();
   parallel_for(ex, 0, nb, 64, [&](int64_t b0, int64_t b1) {
-    quantize_range(x, n, bits, bucket, b0, b1, levels.data(), unit, mn);
+    quantize_range(x, n, bits, bucket, b0, b1, levels.data(), meta);
   });
-  parallel_for(ex, 0, num_groups(padded_n), 2048, [&](int64_t g0, int64_t g1) {
-    pack_range(levels.data(), padded_n, bits, g0, g1, packed);
+  const int64_t chunks = nb / kChunkBuckets;
+  const int64_t tail_buckets = nb % kChunkBuckets;
+  parallel_for(ex, 0, chunks, 8, [&](int64_t c0, int64_t c1) {
+    pack_range_chunked(levels.data(), bits, bucket, c0, c1, packed);
   });
+  if (tail_buckets) {
+    const int64_t tail_off = chunks * kChunkBuckets * bucket;
+    uint32_t* tail_packed = packed + chunks * bits * bucket;
+    parallel_for(ex, 0, num_groups(tail_buckets * bucket), 2048,
+                 [&](int64_t g0, int64_t g1) {
+                   pack_range_dense(levels.data() + tail_off, bits, g0, g1,
+                                    tail_packed);
+                 });
+  }
 }
 
 // packed + meta -> out f32[n]; add != 0 accumulates into out.
@@ -255,13 +323,23 @@ void cgx_dequantize_f32(const uint32_t* packed, const float* meta,
                         int32_t bits, int64_t bucket, int64_t n,
                         float* out, int32_t add) {
   const int64_t nb = num_buckets(n, bucket);
-  const float* unit = meta;
-  const float* mn = meta + nb;
-  parallel_for(default_pool(), 0, num_groups(n), 2048,
-               [&](int64_t g0, int64_t g1) {
-                 unpack_decode_range(packed, unit, mn, bits, bucket, n, g0,
-                                     g1, out, add != 0);
-               });
+  Executor* ex = default_pool();
+  const int64_t chunks = nb / kChunkBuckets;
+  const int64_t tail_buckets = nb % kChunkBuckets;
+  parallel_for(ex, 0, chunks, 8, [&](int64_t c0, int64_t c1) {
+    unpack_decode_chunked(packed, meta, bits, bucket, n, c0, c1, out,
+                          add != 0);
+  });
+  if (tail_buckets) {
+    const int64_t tail_off = chunks * kChunkBuckets * bucket;
+    const uint32_t* tail_packed = packed + chunks * bits * bucket;
+    const int64_t tail_n = n - tail_off;  // > 0: nb counts real values
+    parallel_for(ex, 0, num_groups(tail_n), 2048,
+                 [&](int64_t g0, int64_t g1) {
+                   unpack_decode_dense(tail_packed, meta, bits, bucket,
+                                       tail_off, n, g0, g1, out, add != 0);
+                 });
+  }
 }
 
 // b += a, elementwise f32 (the reference's CUDA_add analogue).

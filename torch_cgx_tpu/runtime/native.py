@@ -112,7 +112,7 @@ def _u32p(a: np.ndarray):
 def quantize_f32(
     x: np.ndarray, bits: int, bucket_size: int
 ) -> Tuple[np.ndarray, np.ndarray]:
-    """f32[n] -> (packed u32[words], meta f32[2, nb]); deterministic."""
+    """f32[n] -> (packed u32[words], meta f32[nb, 2] pairs); deterministic."""
     lib = _load()
     assert lib is not None
     x = np.ascontiguousarray(x, dtype=np.float32)
@@ -120,7 +120,7 @@ def quantize_f32(
     nb = int(lib.cgx_num_buckets(n, bucket_size))
     words = int(lib.cgx_packed_words(nb * bucket_size, bits))
     packed = np.empty(words, np.uint32)
-    meta = np.empty((2, nb), np.float32)
+    meta = np.empty((nb, 2), np.float32)
     lib.cgx_quantize_f32(_f32p(x), n, bits, bucket_size, _u32p(packed),
                          _f32p(meta))
     return packed, meta

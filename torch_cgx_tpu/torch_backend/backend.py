@@ -43,6 +43,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import queue as _queue
+from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -216,16 +217,11 @@ class _CGXWork(dist.Work):
         # c10d contract: raise on expiry. timeout None/<=0 means block
         # forever; torch passes a datetime.timedelta.
         seconds = timeout.total_seconds() if timeout is not None else 0.0
-        if seconds > 0:
-            import time as _time
-
-            deadline = _time.monotonic() + seconds
-            while not self._fut.done():
-                if _time.monotonic() > deadline:
-                    raise RuntimeError(
-                        f"cgx: work timed out after {seconds}s"
-                    )
-                _time.sleep(0.001)
+        if seconds > 0 and not self._fut.done():
+            done = threading.Event()
+            self._fut.add_done_callback(lambda _f: done.set())
+            if not done.wait(seconds):
+                raise RuntimeError(f"cgx: work timed out after {seconds}s")
         self._fut.wait()  # re-raises the worker's exception
         return True
 
@@ -259,8 +255,14 @@ class ProcessGroupCGX(dist.ProcessGroup):
         self._seq = 0  # collective sequence number (issued on calling thread)
         self._p2p_send = {}  # (dst, tag) -> count
         self._p2p_recv = {}  # (src, tag) -> count
-        self._bucket_cursor = 0
+        self._p2p_claim = threading.Lock()  # guards the two counter maps
+        # p2p ops run here, independent of the collective worker FIFO, so a
+        # blocked recv never stalls allreduces (AsyncWork analogue).
+        self._p2p_pool = ThreadPoolExecutor(
+            max_workers=4, thread_name_prefix="cgx-p2p"
+        )
         self._rng: Optional[np.random.Generator] = None
+        self._store_can_delete: Optional[bool] = None  # probed on first use
         # runLoop analogue (ProcessGroupCGX.cc:300-339): one worker thread
         # drains a FIFO of work entries and completes their futures.
         self._jobs: _queue.Queue = _queue.Queue()
@@ -307,17 +309,34 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def _put(self, key: str, data) -> None:
         self._store.set(key, bytes(data) if not isinstance(data, bytes) else data)
 
+    def _delete_key(self, key: str) -> None:
+        """Delete with one-time capability probe: stores without delete
+        support are detected once (keys then persist, by design); any other
+        failure is logged instead of silently swallowed."""
+        if self._store_can_delete is False:
+            return
+        try:
+            self._store.delete_key(key)
+            self._store_can_delete = True
+        except (NotImplementedError, AttributeError):
+            self._store_can_delete = False
+            log.debug("store %r has no delete support; keys will persist",
+                      type(self._store).__name__)
+        except Exception as e:
+            if self._store_can_delete is None:
+                self._store_can_delete = False
+                log.debug("store delete probe failed (%s); keys will persist", e)
+            else:
+                log.warning("store delete_key(%r) failed: %s", key, e)
+
     def _take(self, key: str, readers: int = 1) -> np.ndarray:
         """Blocking get + refcounted delete once all readers have read."""
         data = self._store.get(key)
-        try:
-            if readers <= 1:
-                self._store.delete_key(key)
-            elif int(self._store.add(key + "/ack", 1)) >= readers:
-                self._store.delete_key(key + "/ack")
-                self._store.delete_key(key)
-        except Exception:
-            pass  # store without delete support: keys just persist
+        if readers <= 1:
+            self._delete_key(key)
+        elif int(self._store.add(key + "/ack", 1)) >= readers:
+            self._delete_key(key + "/ack")
+            self._delete_key(key)
         return np.frombuffer(data, np.uint8)
 
     # -- config -----------------------------------------------------------
@@ -332,34 +351,54 @@ class ProcessGroupCGX(dist.ProcessGroup):
         return self._rng
 
     def _extract_layers(
-        self, numel: int
+        self, numel: int, bucket_key=None
     ) -> List[Tuple[int, int, cfg.CompressionConfig]]:
         """(offset, numel, resolved config) per layer of this bucket.
 
-        The reference tracks a rotating ``bucket_idx_`` and slices the DDP
-        bucket by the registered per-layer sizes
-        (mpi_allreduce_operations.cc:257-285). We match the current buffer
-        against the registry by total element count, starting at the
-        expected cursor position; unregistered buffers are one layer with
-        the env-default config.
+        The reference slices the DDP bucket by the per-layer sizes registered
+        under an explicit bucket index and errors on mismatch
+        (mpi_allreduce_operations.cc:257-285). Here the DDP hook tags each
+        allreduce with its bucket key (config.set_current_bucket), so
+        resolution is by identity. Untagged calls (plain user allreduces)
+        fall back to matching by total element count — unique match uses that
+        bucket's configs, no match is one env-default layer, and an ambiguous
+        match (several registered buckets share the total) raises, like the
+        reference's extractLayers error.
         """
-        buckets = sorted(cfg.registered_buckets())
-        match = None
-        for probe in range(len(buckets)):
-            idx = buckets[(self._bucket_cursor + probe) % len(buckets)]
-            sizes = cfg.registered_layer_sizes(idx)
-            if sizes and sum(sizes) == numel:
-                match = (idx, sizes)
-                self._bucket_cursor = (
-                    (self._bucket_cursor + probe + 1) % len(buckets)
-                )
-                break
-        if match is None:
+        if bucket_key is not None:
+            sizes = cfg.registered_layer_sizes(bucket_key)
+            if sizes is not None:
+                if sum(sizes) != numel:
+                    raise RuntimeError(
+                        f"bucket {bucket_key!r}: registered layer sizes sum to "
+                        f"{sum(sizes)} but the buffer has {numel} elements "
+                        "(stale registry? call clear_registry() after "
+                        "changing the model)"
+                    )
+                return self._resolve_layers(bucket_key, sizes)
             return [(0, numel, cfg.default_compression_config())]
-        idx, sizes = match
+        matches = [
+            (idx, sizes)
+            for idx in cfg.registered_buckets()
+            if (sizes := cfg.registered_layer_sizes(idx)) and sum(sizes) == numel
+        ]
+        if not matches:
+            return [(0, numel, cfg.default_compression_config())]
+        if len(matches) > 1:
+            raise RuntimeError(
+                f"untagged allreduce of {numel} elements matches "
+                f"{len(matches)} registered buckets "
+                f"({[m[0] for m in matches]!r}) — cannot resolve per-layer "
+                "configs; use the cgx_hook (which tags buckets) or "
+                "clear_registry()"
+            )
+        return self._resolve_layers(*matches[0])
+
+    @staticmethod
+    def _resolve_layers(bucket_key, sizes):
         out, off = [], 0
         for li, n in enumerate(sizes):
-            out.append((off, n, cfg.get_layer_config((idx, li))))
+            out.append((off, n, cfg.get_layer_config((bucket_key, li))))
             off += n
         return out
 
@@ -370,6 +409,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
         t = tensors[0]
         op = opts.reduceOp if opts is not None else dist.ReduceOp.SUM
         seq = self._next_seq()
+        # Consume the hook's bucket tag on the calling thread (the hook sets
+        # it immediately before dist.all_reduce).
+        bucket_key = cfg.take_current_bucket()
         do_compress = (
             t.dtype in _TORCH_FLOATS
             and op == dist.ReduceOp.SUM
@@ -380,17 +422,17 @@ class ProcessGroupCGX(dist.ProcessGroup):
             if self._size == 1:
                 return
             if do_compress:
-                self._allreduce_quantized(t, seq)
+                self._allreduce_quantized(t, seq, bucket_key)
             else:
                 self._allreduce_plain(t, op, seq)
 
         return self._submit(run, tensors)
 
-    def _allreduce_quantized(self, t: torch.Tensor, seq: int) -> None:
+    def _allreduce_quantized(self, t: torch.Tensor, seq: int, bucket_key=None) -> None:
         # Per-layer partition into compress / no-compress, exactly the
         # orchestrator's split (mpi_allreduce_operations.cc:240-247):
         # enabled config AND numel above the minimal size.
-        layers = self._extract_layers(t.numel())
+        layers = self._extract_layers(t.numel(), bucket_key)
         minimal = cfg.minimal_size()
         arr = _to_np(t).astype(np.float32, copy=False)
         comp = [(o, n, c) for (o, n, c) in layers if c.enabled and n >= minimal]
@@ -407,11 +449,20 @@ class ProcessGroupCGX(dist.ProcessGroup):
             idx = np.concatenate(
                 [np.arange(o, o + n) for (o, n, _) in comp]
             )
+            # Debug traffic shaping (mpi_allreduce_operations.cc:130-144):
+            # with CGX_COMPRESSION_FAKE_RATIO set, only the leading fraction
+            # of the compressed slice is reduced; the tail stays stale.
+            ratio = cfg.fake_ratio()
+            if ratio is not None and idx.size > 1:
+                idx = idx[: max(1, int(np.ceil(ratio * idx.size)))]
             fused = np.ascontiguousarray(arr[idx])
-            # Re-base layer offsets into fused coordinates.
+            # Re-base layer offsets into fused coordinates (clipped to the
+            # possibly-shrunk fused length; _segments_in intersects).
             fl, off = [], 0
             for (_, n, c) in comp:
-                fl.append((off, n, c))
+                if off >= fused.shape[0]:
+                    break
+                fl.append((off, min(n, fused.shape[0] - off), c))
                 off += n
             # Flat (single-level) bridge: the "inner" reduction choice
             # applies, like a one-node reference run
@@ -743,56 +794,94 @@ class ProcessGroupCGX(dist.ProcessGroup):
         seq = self._next_seq()
 
         def run():
-            key = f"cgx{seq}bar"
-            import time as _time
-
-            self._store.add(key, 1)
-            while int(self._store.add(key, 0)) < self._size:
-                _time.sleep(0.0005)
+            # Arrival keys + blocking store.wait (no spin); the last rank
+            # through GCs the round's keys via a done-refcount.
+            pfx = f"cgx{seq}bar"
+            self._store.set(f"{pfx}/r{self._rank}", b"1")
+            self._store.wait([f"{pfx}/r{r}" for r in range(self._size)])
+            if int(self._store.add(f"{pfx}/done", 1)) >= self._size:
+                for r in range(self._size):
+                    self._delete_key(f"{pfx}/r{r}")
+                self._delete_key(f"{pfx}/done")
 
         return self._submit(run, None)
 
-    # -- point-to-point (synchronous store mailboxes; the reference wraps
-    # MPI_Isend/Irecv in AsyncWork, ProcessGroupCGX.cc:144-226) ------------
+    # -- point-to-point (store mailboxes executed on a dedicated pool, so a
+    # blocked recv stalls its Work future, not the caller or the collective
+    # worker — the AsyncWork model, ProcessGroupCGX.cc:144-226). (src, tag)
+    # sequence counters are claimed on the calling thread, so message order
+    # is the issue order regardless of pool scheduling. ---------------------
+
+    def _submit_p2p(self, fn, result) -> dist.Work:
+        fut = Future()
+
+        def run():
+            try:
+                fn()
+                fut.set_result(result)
+            except Exception as e:
+                fut.set_exception(e)
+
+        self._p2p_pool.submit(run)
+        return _CGXWork(fut)
 
     def send(self, tensors, dst_rank, tag=0):
         self._check_single(tensors)
-        cnt = self._p2p_send.get((dst_rank, tag), 0)
-        self._p2p_send[(dst_rank, tag)] = cnt + 1
-        self._put(
-            f"cgxp2p/{self._rank}>{dst_rank}/t{tag}/{cnt}",
-            self._bytes_of(tensors[0]),
+        t = tensors[0]
+        with self._p2p_claim:
+            cnt = self._p2p_send.get((dst_rank, tag), 0)
+            self._p2p_send[(dst_rank, tag)] = cnt + 1
+        key = f"cgxp2p/{self._rank}>{dst_rank}/t{tag}/{cnt}"
+        return self._submit_p2p(
+            lambda: self._put(key, self._bytes_of(t)), tensors
         )
-        return self._done(tensors)
 
     def recv(self, tensors, src_rank, tag=0):
         self._check_single(tensors)
         t = tensors[0]
-        cnt = self._p2p_recv.get((src_rank, tag), 0)
-        self._p2p_recv[(src_rank, tag)] = cnt + 1
-        buf = self._take(f"cgxp2p/{src_rank}>{self._rank}/t{tag}/{cnt}")
-        with torch.no_grad():
-            t.copy_(self._tensor_from(buf, t))
-        return self._done(tensors)
+        with self._p2p_claim:
+            cnt = self._p2p_recv.get((src_rank, tag), 0)
+            self._p2p_recv[(src_rank, tag)] = cnt + 1
+        key = f"cgxp2p/{src_rank}>{self._rank}/t{tag}/{cnt}"
+
+        def run():
+            buf = self._take(key)
+            with torch.no_grad():
+                t.copy_(self._tensor_from(buf, t))
+
+        return self._submit_p2p(run, tensors)
 
     def recv_anysource(self, tensors, tag=0):
         self._check_single(tensors)
         t = tensors[0]
-        import time as _time
+        # Claim nothing up front: the source is unknown until a mailbox has
+        # mail. The counter for the matched source is claimed inside the
+        # pool task; concurrent recv_anysource calls serialize through the
+        # single-threaded claim lock.
+        def run():
+            import time as _time
 
-        while True:
-            for src in range(self._size):
-                if src == self._rank:
-                    continue
-                cnt = self._p2p_recv.get((src, tag), 0)
-                key = f"cgxp2p/{src}>{self._rank}/t{tag}/{cnt}"
-                try:
-                    ok = self._store.check([key])
-                except Exception:
-                    ok = True  # store without check: fall back to blocking
-                if ok:
-                    return self.recv(tensors, src, tag)
-            _time.sleep(0.001)
+            while True:
+                for src in range(self._size):
+                    if src == self._rank:
+                        continue
+                    with self._p2p_claim:
+                        cnt = self._p2p_recv.get((src, tag), 0)
+                        key = f"cgxp2p/{src}>{self._rank}/t{tag}/{cnt}"
+                        try:
+                            ok = bool(self._store.check([key]))
+                        except Exception:
+                            ok = True  # no check support: blocking fallback
+                        if ok:
+                            self._p2p_recv[(src, tag)] = cnt + 1
+                    if ok:
+                        buf = self._take(key)
+                        with torch.no_grad():
+                            t.copy_(self._tensor_from(buf, t))
+                        return
+                _time.sleep(0.001)
+
+        return self._submit_p2p(run, tensors)
 
     # -- unsupported, reference parity ------------------------------------
 
@@ -827,6 +916,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
     def shutdown(self) -> None:
         self._shutdown.set()
+        self._p2p_pool.shutdown(wait=False)
 
     def __repr__(self) -> str:
         return f"ProcessGroupCGX(rank={self._rank}, size={self._size})"

@@ -21,6 +21,7 @@ Mirrors the reference's Python integration layer
 # (bucket must be literally dist.GradBucket, return literally
 # torch.futures.Future[torch.Tensor]); stringified annotations fail it.
 
+import itertools
 from typing import Optional
 
 import torch
@@ -29,6 +30,11 @@ import torch.distributed as dist
 from .. import config as cfg
 
 REGISTRATION_STEP = 2
+
+# Each CGXState registers its buckets under its own namespace so two DDP
+# models (or a re-wrapped model) in one process cannot collide on
+# ``bucket.index()`` and silently mix per-layer configs.
+_ns_counter = itertools.count()
 
 
 class CGXState:
@@ -43,6 +49,7 @@ class CGXState:
     ):
         self.process_group = process_group
         self.step = 0
+        self._registry_ns = next(_ns_counter)
         default = cfg.default_compression_config()
         params = compression_params or {}
         self.quantization_bits = int(params.get("bits", default.bits))
@@ -69,6 +76,7 @@ def _allreduce_fut(
 def cgx_hook(
     state: CGXState, bucket: dist.GradBucket
 ) -> torch.futures.Future[torch.Tensor]:
+    bucket_key = (state._registry_ns, bucket.index())
     if state.step == REGISTRATION_STEP:
         for layer_idx, grad in enumerate(bucket.gradients()):
             bits = (
@@ -77,7 +85,7 @@ def cgx_hook(
                 else 32
             )
             cfg.register_layer(
-                bucket.index(),
+                bucket_key,
                 layer_idx,
                 grad.numel(),
                 bits,
@@ -85,4 +93,7 @@ def cgx_hook(
             )
     if bucket.is_last():
         state.step += 1
+    # Tag the allreduce about to happen so the backend resolves this exact
+    # bucket's layer layout (consumed synchronously inside _allreduce_fut).
+    cfg.set_current_bucket(bucket_key)
     return _allreduce_fut(state.process_group, bucket.buffer())
