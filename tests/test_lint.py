@@ -1,0 +1,38 @@
+"""Static undefined-name gate (VERDICT r2 #2).
+
+Round 2 shipped a NameError on the TPU-only fast path because no static
+check ran and the CPU suite routed around the path. This test makes an
+undefined name a test failure: `tools/lint.py` walks every function body of
+every source file and flags bare-name loads with no binding in scope.
+"""
+
+import subprocess
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def test_no_undefined_names():
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "lint.py")],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 0, f"lint findings:\n{proc.stdout}{proc.stderr}"
+
+
+def test_linter_detects_undefined_name(tmp_path):
+    # The gate itself must stay sharp: a file with a renamed-away callee (the
+    # exact round-2 failure shape) must be flagged.
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    return _renamed_away_impl(x)\n")
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "lint.py"), str(bad)],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+    assert proc.returncode == 1
+    assert "_renamed_away_impl" in proc.stdout

@@ -210,19 +210,25 @@ def quantize(
     stochastic: bool = False,
     rng: Optional[np.random.Generator] = None,
     skip_incomplete_buckets: bool = False,
+    meta_dtype=None,
 ) -> HostQTensor:
     """Quantize a flat host buffer. Matches ``codec.quantize`` bit-for-bit in
     deterministic mode (stochastic streams differ: numpy PCG64 vs JAX
-    threefry — both honor the same error envelope)."""
+    threefry — both honor the same error envelope).
+
+    ``meta_dtype`` overrides the wire dtype for meta/residual without
+    touching the data math (the bridge frames bf16 tensors with bf16 meta
+    while its fused accumulator stays float32 — casting the *data* down
+    would lose the f32 partial sums)."""
     if not (1 <= bits <= 8):
         raise ValueError(f"bits must be in 1..8, got {bits}")
-    dtype = np.dtype(x.dtype)
+    dtype = np.dtype(meta_dtype) if meta_dtype is not None else np.dtype(x.dtype)
     flat = np.ascontiguousarray(x.reshape(-1))
     n = flat.shape[0]
     rem = n % bucket_size
     res_n = rem if (skip_incomplete_buckets and rem) else 0
     main_n = n - res_n
-    residual = flat[main_n:].copy()
+    residual = flat[main_n:].astype(dtype)
     main = flat[:main_n]
 
     nb = jcodec.num_buckets(main_n, bucket_size)
@@ -235,7 +241,7 @@ def quantize(
         )
 
     nat = _native()
-    if nat is not None and not stochastic and dtype == np.float32:
+    if nat is not None and not stochastic and x.dtype == np.float32:
         packed, meta32 = nat.quantize_f32(main, bits, bucket_size)
         return HostQTensor(
             packed=packed, meta=meta32.astype(dtype), residual=residual,
@@ -283,10 +289,15 @@ def dequantize(
     nb = jcodec.num_buckets(main_n, q.bucket_size)
     if nb:
         nat = _native()
-        if nat is not None and q.meta.dtype == np.float32:
+        if nat is not None:
             vals = nat.dequantize_f32(
-                q.packed, np.ascontiguousarray(q.meta), q.bits,
-                q.bucket_size, main_n,
+                q.packed,
+                # zero-copy for the dominant already-f32 case; bf16 meta
+                # upcasts here
+                np.ascontiguousarray(q.meta, dtype=np.float32),
+                q.bits,
+                q.bucket_size,
+                main_n,
             )
         else:
             lvl = unpack_levels_bucketed(q.packed, q.bits, nb, q.bucket_size)

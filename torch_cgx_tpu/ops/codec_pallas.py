@@ -35,13 +35,25 @@ implementations and devices. The dense tail region (final ``nb % 32``
 buckets) and sub-bucket tensors are delegated to the XLA codec — the kernel
 covers the full chunks, which is asymptotically all of the data.
 
+Two kernel families implement the same wire bytes:
+
+* **Flat kernels** (``_quantize_flat_impl`` / ``_dequantize_flat_impl``) —
+  the hot path, taken when every row is whole chunks (``nb_r % 32 == 0``)
+  and ``bucket_size % 128 == 0`` (the default 512/1024 buckets qualify).
+  They read/write the natural ``(total/128, 128)`` flat layout directly —
+  zero relayout passes on either side — and split blocks along sublanes
+  only into ``(tc, 32, rb, 128)``; the packed planes flatten into exactly
+  the wire's word order. See the impl docstrings for measured v5e numbers.
+* **Chunk-block kernels** (``_quantize_chunks_impl`` / ``_dequantize_chunks_impl``)
+  — the general path for 32-but-not-128-aligned buckets and rows with a
+  chunk tail; operate on an XLA-relayouted ``(buckets, bucket_size)`` view.
+
 Mosaic constraints (validated empirically on v5e): no uint32 math (bit ops
 in int32, bitcasts at the boundary — two's-complement wrap on the bit-31
-shift is exact), blocks are ``(chunks*32, bucket_size)`` tiles reshaped
-in-kernel to ``(chunks, 32, bucket_size)`` (sublane-dim reshapes are legal;
-lane-dim ones are not), and levels use the same divide (not
-reciprocal-multiply) as the XLA/host codecs so deterministic payloads are
-byte-identical across all four implementations.
+shift is exact); reductions over two trailing dims must be stepwise;
+reshapes in-kernel touch leading (sublane-group) dims only; and levels use
+the same divide (not reciprocal-multiply) as the XLA/host codecs so
+deterministic payloads are byte-identical across all four implementations.
 
 Constraints for the kernel path (callers fall back to the XLA codec
 otherwise — see ``dispatch.py``): bucket_size % 32 == 0, no residual mode.
@@ -153,12 +165,13 @@ def _dequantize_kernel(words_ref, meta_ref, out_ref, *, bits, tc):
     )
 
 
-def _pipe_tc(n_chunks_per_row: int, bucket_size: int) -> int:
-    """Chunks per block for the flat fast path: the largest candidate that
-    divides the per-row chunk count (blocks must tile rows exactly)."""
-    cap = _tile_chunks(n_chunks_per_row, bucket_size, 8)
-    for tc in range(cap, 0, -1):
-        if n_chunks_per_row % tc == 0:
+def _pipe_tc(n_chunks: int, bucket_size: int) -> int:
+    """Chunks per block for the flat fast path: the largest candidate within
+    the VMEM cap that divides the total chunk count (the flat grid tiles all
+    rows' chunks as one contiguous sequence)."""
+    cap = _tile_chunks(n_chunks, bucket_size, 8)
+    for tc in range(min(cap, n_chunks), 0, -1):
+        if n_chunks % tc == 0:
             return tc
     return 1
 
@@ -177,86 +190,83 @@ def _quantize_flat_impl(
     interpret: bool = False,
     tc: int = 8,
 ):
-    """Zero-relayout quantize over rows of full chunks (t_r == 0).
+    """Zero-relayout quantize over rows of full chunks (t_r == 0,
+    bucket_size % 128 == 0).
 
-    All operands keep their natural flat-rows shape; blocks are (1, L) lane
-    runs reshaped inside the kernel, so XLA never materializes the
-    (rows, m) -> (buckets, bucket) tiled-layout conversion (a full extra
-    memory pass), and the meta store is a wide (1, 2*tc*32) lane run instead
-    of a 2-lane column (which Mosaic handles pathologically).
+    The input is viewed as ``(total/128, 128)`` natural flat rows — a
+    layout-preserving reshape, so XLA never materializes a
+    (rows, m) -> (buckets, bucket) relayout pass (measured free on v5e).
+    In-kernel, a block of ``tc`` chunks is split along *sublanes only* into
+    ``(tc, 32, rb, 128)`` (rb = bucket_size/128): bucket (c, s) owns sublane
+    rows ``c*32*rb + s*rb + j``; per-bucket max/min reduce over axes (2, 3)
+    stepwise, and the bit-plane pack is the same pure cross-sublane
+    reduction over axis 1 as the chunk kernels. The packed planes land in
+    ``(tc, bits, rb, 128)`` order, which flattens to exactly the wire's
+    word order — output needs no relayout either. Measured on v5e at
+    512 MB/4-bit: ~2.9 ms (~180 GB/s of input) vs ~0.7 ms HBM floor.
 
-    Returns (words (rows, c_r*bits*B) int32, meta (rows, nb_r*2) f32 with
-    interleaved (unit, min) pairs along lanes).
+    Returns (words (C*bits*rb, 128) int32, meta (C*32, 2) f32) where C is
+    the total chunk count across rows.
     """
     rows, m_pad = xs.shape
     b = bucket_size
-    nb_r = m_pad // b
-    c_r = nb_r // CHUNK_BUCKETS
-    l_x = tc * CHUNK_BUCKETS * b
+    rb = b // 128
+    n_chunks = rows * m_pad // (CHUNK_BUCKETS * b)
+    maxlvl = np.float32((1 << bits) - 1)
 
     def kernel(seed_ref, x_ref, words_ref, meta_ref):
-        maxlvl = np.float32((1 << bits) - 1)
-        x = x_ref[:].reshape(tc * CHUNK_BUCKETS, b).astype(jnp.float32)
-        bmax = jnp.max(x, axis=1, keepdims=True)
-        bmin = jnp.min(x, axis=1, keepdims=True)
+        x4 = x_ref[:].astype(jnp.float32).reshape(tc, CHUNK_BUCKETS, rb, 128)
+        bmax = jnp.max(
+            jnp.max(x4, axis=3, keepdims=True), axis=2, keepdims=True
+        )
+        bmin = jnp.min(
+            jnp.min(x4, axis=3, keepdims=True), axis=2, keepdims=True
+        )
         # Reciprocal-multiply like codec.compute_meta (byte-identity).
         unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
         safe = jnp.where(unit > 0, unit, np.float32(1.0))
-        if stochastic:
-            pltpu.prng_seed(
-                seed_ref[0, 0]
-                + pl.program_id(0) * pl.num_programs(1)
-                + pl.program_id(1)
-            )
-            rbits = pltpu.bitcast(
-                pltpu.prng_random_bits(x.shape), jnp.uint32
-            )
-            r = (rbits >> np.uint32(8)).astype(jnp.int32).astype(
-                jnp.float32
-            ) * np.float32(2.0**-24)
-        else:
-            r = np.float32(0.5)
+        r = _stochastic_r(seed_ref, x4.shape) if stochastic else np.float32(0.5)
         # Divide, not reciprocal-multiply: byte-identity with the other
         # codec implementations.
-        lvl = jnp.clip(jnp.floor((x - bmin) / safe + r), 0, maxlvl).astype(
+        lvl = jnp.clip(jnp.floor((x4 - bmin) / safe + r), 0, maxlvl).astype(
             jnp.int32
         )
-        lv3 = lvl.reshape(tc, CHUNK_BUCKETS, b)
         sub = jax.lax.broadcasted_iota(
-            jnp.int32, (tc, CHUNK_BUCKETS, b), 1
+            jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
         )
         planes = [
-            jnp.sum(((lv3 >> w) & 1) << sub, axis=1) for w in range(bits)
+            jnp.sum(((lvl >> w) & 1) << sub, axis=1) for w in range(bits)
         ]  # disjoint bits -> int32 wrap on the s=31 term is exact
-        words_ref[:] = (
-            jnp.stack(planes, axis=1).reshape(1, tc * bits * b)
+        words_ref[:] = jnp.stack(planes, axis=1).reshape(
+            tc * bits * rb, 128
         )
-        # (tc*32, 2) pairs flattened row-major = interleaved (unit, min) —
-        # stored as one wide lane run.
-        meta_ref[:] = jnp.concatenate([unit, bmin], axis=1).reshape(
-            1, tc * CHUNK_BUCKETS * 2
+        meta_ref[:] = jnp.concatenate(
+            [unit.reshape(tc * CHUNK_BUCKETS, 1),
+             bmin.reshape(tc * CHUNK_BUCKETS, 1)],
+            axis=1,
         )
 
+    xv = xs.reshape(rows * m_pad // 128, 128)
     words, meta = pl.pallas_call(
-        functools.partial(kernel),
-        grid=(rows, c_r // tc),
+        kernel,
+        grid=(n_chunks // tc,),
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, l_x), lambda r, j: (r, j),
+            pl.BlockSpec((tc * CHUNK_BUCKETS * rb, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=[
-            pl.BlockSpec((1, tc * bits * b), lambda r, j: (r, j),
+            pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tc * CHUNK_BUCKETS * 2), lambda r, j: (r, j),
+            pl.BlockSpec((tc * CHUNK_BUCKETS, 2), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((rows, c_r * bits * b), jnp.int32),
-            jax.ShapeDtypeStruct((rows, nb_r * 2), jnp.float32),
+            jax.ShapeDtypeStruct((n_chunks * bits * rb, 128), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks * CHUNK_BUCKETS, 2), jnp.float32),
         ],
         interpret=interpret,
-    )(seed.reshape(1, 1).astype(jnp.int32), xs)
+    )(seed.reshape(1, 1).astype(jnp.int32), xv)
     return words, meta
 
 
@@ -272,47 +282,52 @@ def _dequantize_flat_impl(
     interpret: bool = False,
     tc: int = 8,
 ):
-    """Zero-relayout dequantize: words (rows, W) int32 + meta (rows, nb_r*2)
-    interleaved pairs -> (rows, nb_r*B) f32. Same (1, L) lane-block scheme
-    as :func:`_quantize_flat_impl`."""
+    """Zero-relayout dequantize: words (rows, W) int32 + meta (rows, nb_r, 2)
+    -> (rows, nb_r*B) f32. Word blocks are natural (., 128) flat rows like
+    :func:`_quantize_flat_impl`'s output; the decoded values are computed on
+    a full-vreg 2-D ``(tc*32*rb, 128)`` shape (measured ~1.4 ms for 512 MB
+    at 4-bit on v5e — near the HBM write floor)."""
     rows, w_row = words.shape
     b = bucket_size
+    rb = b // 128
     nb_r = w_row * LANE_GROUP // (b * bits)
-    c_r = nb_r // CHUNK_BUCKETS
+    n_chunks = rows * nb_r // CHUNK_BUCKETS
+    s_rows = tc * CHUNK_BUCKETS * rb
 
     def kernel(w_ref, m_ref, out_ref):
-        w3 = w_ref[:].reshape(tc, bits, b)
-        m2 = m_ref[:].reshape(tc * CHUNK_BUCKETS, 2)
+        w4 = w_ref[:].reshape(tc, bits, rb, 128)
         sub = jax.lax.broadcasted_iota(
-            jnp.int32, (tc, CHUNK_BUCKETS, b), 1
+            jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
         )
-        lvl = jnp.zeros((tc, CHUNK_BUCKETS, b), jnp.int32)
+        lvl = jnp.zeros((tc, CHUNK_BUCKETS, rb, 128), jnp.int32)
         for w in range(bits):
-            lvl = lvl | (((w3[:, w : w + 1, :] >> sub) & 1) << w)
-        unit = m2[:, 0:1]
-        bmin = m2[:, 1:2]
-        y = bmin + unit * lvl.reshape(tc * CHUNK_BUCKETS, b).astype(
-            jnp.float32
+            lvl = lvl | (((w4[:, w : w + 1, :, :] >> sub) & 1) << w)
+        m2 = m_ref[:]
+        unit = m2[:, 0:1].reshape(tc, CHUNK_BUCKETS, 1, 1)
+        bmin = m2[:, 1:2].reshape(tc, CHUNK_BUCKETS, 1, 1)
+        out_ref[:] = (bmin + unit * lvl.astype(jnp.float32)).reshape(
+            s_rows, 128
         )
-        out_ref[:] = y.reshape(1, tc * CHUNK_BUCKETS * b)
 
+    wv = words.reshape(rows * w_row // 128, 128)
+    mv = meta.reshape(rows * nb_r, 2)
     out = pl.pallas_call(
         kernel,
-        grid=(rows, c_r // tc),
+        grid=(n_chunks // tc,),
         in_specs=[
-            pl.BlockSpec((1, tc * bits * b), lambda r, j: (r, j),
+            pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, tc * CHUNK_BUCKETS * 2), lambda r, j: (r, j),
+            pl.BlockSpec((tc * CHUNK_BUCKETS, 2), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, tc * CHUNK_BUCKETS * b), lambda r, j: (r, j),
-            memory_space=pltpu.VMEM,
+        out_specs=pl.BlockSpec((s_rows, 128), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_chunks * CHUNK_BUCKETS * rb, 128), jnp.float32
         ),
-        out_shape=jax.ShapeDtypeStruct((rows, nb_r * b), jnp.float32),
         interpret=interpret,
-    )(words, meta)
-    return out
+    )(wv, mv)
+    return out.reshape(rows, nb_r * b)
 
 
 @functools.partial(
@@ -440,23 +455,26 @@ def quantize_batch(
     if m_pad != m:
         xs = jnp.pad(xs, ((0, 0), (0, m_pad - m)), mode="edge")
     c_r, t_r = _row_split(nb_r)
-    if t_r == 0 and not interpret:
-        # Fast path: whole rows are full chunks — the pipelined kernel takes
-        # (rows, m_pad) directly from HBM, zero XLA relayout. (emit_pipeline
-        # has no CPU-interpret lowering; interpret mode uses the block path,
-        # which produces identical bytes.)
-        words, meta = _quantize_pipe_impl(
-            xs.astype(jnp.float32) if xs.dtype != jnp.float32 else xs,
+    if t_r == 0 and b % 128 == 0:
+        # Fast path: whole rows are full chunks and buckets are whole
+        # 128-lane rows — the flat kernel reads the natural flat layout
+        # straight from HBM, zero XLA relayout on either side. A plain
+        # pallas_call, so it runs under CPU interpret mode too and the
+        # normal suite asserts its bytes against the XLA oracle.
+        words, meta = _quantize_flat_impl(
+            xs,
             seed_from_key(key),
             bits=bits,
             bucket_size=b,
             stochastic=stochastic,
             interpret=interpret,
-            tc=_pipe_tc(rows * nb_r // CHUNK_BUCKETS, b),
+            tc=_pipe_tc(rows * c_r, b),
         )
         return codec.QTensor(
-            packed=jax.lax.bitcast_convert_type(words, jnp.uint32),
-            meta=meta.astype(dtype),
+            packed=jax.lax.bitcast_convert_type(words, jnp.uint32).reshape(
+                rows, c_r * bits * b
+            ),
+            meta=meta.reshape(rows, nb_r, 2).astype(dtype),
             residual=jnp.zeros((rows, 0), dtype),
             numel=m,
             bits=bits,
@@ -535,14 +553,14 @@ def dequantize_batch(
     c_r, t_r = _row_split(nb_r)
     meta = q.meta.astype(jnp.float32)  # (rows, nb_r, 2) pair layout
 
-    if t_r == 0 and not interpret:
-        vals = _dequantize_pipe_impl(
+    if t_r == 0 and b % 128 == 0:
+        vals = _dequantize_flat_impl(
             jax.lax.bitcast_convert_type(q.packed, jnp.int32),
             meta,
             bits=q.bits,
             bucket_size=b,
             interpret=interpret,
-            tc=_pipe_tc(rows * nb_r // CHUNK_BUCKETS, b),
+            tc=_pipe_tc(rows * c_r, b),
         )[:, : q.numel]
         if add_to is not None:
             return (add_to.astype(jnp.float32) + vals).astype(out_dtype)

@@ -77,6 +77,24 @@ _NP_OF_TORCH = {
 }
 
 
+def _wire_dtype(torch_dtype) -> np.dtype:
+    """Wire dtype for meta/residual framing of a compressed tensor.
+
+    bf16 tensors frame with bf16 meta — half the meta bytes on the wire,
+    the reference's store-meta-in-input-dtype economics
+    (compressor.cc:401-419); bf16 via ml_dtypes (numpy has none). fp16
+    deliberately stays f32-framed: the fused accumulator holds f32 partial
+    sums whose magnitude (and thus bucket unit/min) can exceed the fp16
+    range mid-reduction, so fp16 meta would go inf; bf16 shares the f32
+    exponent range and cannot overflow.
+    """
+    if torch_dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    return np.dtype(np.float32)
+
+
 def _to_np(t: torch.Tensor) -> np.ndarray:
     """Host copy of a tensor as a flat numpy array (bf16 -> f32, exact)."""
     t = t.detach()
@@ -124,21 +142,17 @@ def _segments_in(
     return out
 
 
-def _frames_nbytes(segs: Sequence[_Segment], dummy: bool) -> int:
-    if dummy:
-        return sum(s.numel for s in segs) * 4
-    return sum(
-        hcodec.wire_layout(s.numel, s.bits, s.bucket_size, np.float32)[3]
-        for s in segs
-    )
-
-
 def _compress_frames(
     fused: np.ndarray, segs: Sequence[_Segment], dummy: bool,
-    rng: Optional[np.random.Generator],
+    rng: Optional[np.random.Generator], wire_dtype=np.float32,
 ) -> bytes:
     """Concatenated per-segment wire frames. Frame sizes are a pure function
-    of (numel, bits, bucket) so the receiver needs no header."""
+    of (numel, bits, bucket, wire dtype) so the receiver needs no header.
+
+    ``wire_dtype`` is the tensor's own dtype for 16-bit floats: meta (and any
+    residual) travel at half the bytes, matching the reference's
+    store-meta-in-input-dtype wire economics (compressor.cc:401-419).
+    Quantization math stays float32 regardless (the host codec upcasts)."""
     parts: List[np.ndarray] = []
     for s in segs:
         x = fused[s.start : s.start + s.numel]
@@ -146,8 +160,12 @@ def _compress_frames(
             parts.append(np.ascontiguousarray(x, np.float32).view(np.uint8))
         else:
             q = hcodec.quantize(
-                np.ascontiguousarray(x, np.float32), s.bits, s.bucket_size,
-                stochastic=rng is not None, rng=rng,
+                np.ascontiguousarray(x, np.float32),
+                s.bits,
+                s.bucket_size,
+                stochastic=rng is not None,
+                rng=rng,
+                meta_dtype=wire_dtype,
             )
             parts.append(q.to_bytes())
     if not parts:
@@ -157,7 +175,7 @@ def _compress_frames(
 
 def _decompress_frames(
     buf: np.ndarray, segs: Sequence[_Segment], fused: np.ndarray,
-    dummy: bool, add: bool,
+    dummy: bool, add: bool, wire_dtype=np.float32,
 ) -> None:
     """Decode frames into the fused buffer at their segment positions,
     accumulating (round 1) or assigning (allgather round)."""
@@ -169,11 +187,11 @@ def _decompress_frames(
             vals = buf[off : off + nb].view(np.float32)
             off += nb
         else:
-            nb = hcodec.wire_layout(s.numel, s.bits, s.bucket_size, np.float32)[3]
+            nb = hcodec.wire_layout(s.numel, s.bits, s.bucket_size, wire_dtype)[3]
             q = hcodec.from_bytes(
-                buf[off : off + nb], s.numel, s.bits, s.bucket_size, np.float32
+                buf[off : off + nb], s.numel, s.bits, s.bucket_size, wire_dtype
             )
-            vals = hcodec.dequantize(q)
+            vals = hcodec.dequantize(q, out_dtype=np.float32)
             off += nb
         if add:
             fused[sl] += vals
@@ -464,20 +482,21 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     break
                 fl.append((off, min(n, fused.shape[0] - off), c))
                 off += n
+            wdt = _wire_dtype(t.dtype)
             # Flat (single-level) bridge: the "inner" reduction choice
             # applies, like a one-node reference run
             # (mpi_allreduce_operations.cc:70-94).
             algo = cfg.topology_from_env().intra_reduction
             if algo == cfg.REDUCTION_ALLTOALL:
-                self._qreduce_alltoall(fused, fl, f"cgx{seq}q")
+                self._qreduce_alltoall(fused, fl, f"cgx{seq}q", wdt)
             elif algo == cfg.REDUCTION_RING:
-                self._qreduce_ring(fused, fl, f"cgx{seq}q")
+                self._qreduce_ring(fused, fl, f"cgx{seq}q", wdt)
             else:
-                self._qreduce_sra(fused, fl, f"cgx{seq}q")
+                self._qreduce_sra(fused, fl, f"cgx{seq}q", wdt)
             arr[idx] = fused
         _from_np(t, arr)
 
-    def _qreduce_sra(self, fused, layers, pfx) -> None:
+    def _qreduce_sra(self, fused, layers, pfx, wdt=np.float32) -> None:
         """Quantized Scatter-Reduce-AllGather over the store — the flagship
         algorithm (scatter_reduce_allgather.cc:94-202). Empty chunks travel
         as empty payloads, so no rank ever skips a matching put/take."""
@@ -492,29 +511,30 @@ class ProcessGroupCGX(dist.ProcessGroup):
         for j in range(ws):
             if j != me:
                 self._put(
-                    f"{pfx}/s{me}>{j}", _compress_frames(fused, segs[j], dummy, rng)
+                    f"{pfx}/s{me}>{j}", _compress_frames(fused, segs[j], dummy, rng, wdt)
                 )
         # Accumulate peers into our own chunk (TestRecv + decompress-add).
         for j in range(ws):
             if j != me:
                 buf = self._take(f"{pfx}/s{j}>{me}")
-                _decompress_frames(buf, segs[me], fused, dummy, add=True)
+                _decompress_frames(buf, segs[me], fused, dummy, add=True, wire_dtype=wdt)
         # Requantize the reduced chunk, then self-dequantize so every replica
         # carries the identical quantization error
         # (scatter_reduce_allgather.cc:157-160 — load-bearing for the
         # bit-exactness oracle).
-        wire = _compress_frames(fused, segs[me], dummy, rng)
+        wire = _compress_frames(fused, segs[me], dummy, rng, wdt)
         _decompress_frames(
-            np.frombuffer(wire, np.uint8), segs[me], fused, dummy, add=False
+            np.frombuffer(wire, np.uint8), segs[me], fused, dummy, add=False,
+            wire_dtype=wdt,
         )
         self._put(f"{pfx}/g{me}", wire)
         # Round 2: gather every reduced chunk (allgather).
         for j in range(ws):
             if j != me:
                 buf = self._take(f"{pfx}/g{j}", readers=ws - 1)
-                _decompress_frames(buf, segs[j], fused, dummy, add=False)
+                _decompress_frames(buf, segs[j], fused, dummy, add=False, wire_dtype=wdt)
 
-    def _qreduce_ring(self, fused, layers, pfx) -> None:
+    def _qreduce_ring(self, fused, layers, pfx, wdt=np.float32) -> None:
         """Quantized ring: N-1 scatter-reduce steps then N-1 allgather steps
         (ring.cc:139-226). Scatter-reduce requantizes each outgoing segment;
         the allgather circulates reduced wire payloads unchanged (one
@@ -532,42 +552,43 @@ class ProcessGroupCGX(dist.ProcessGroup):
             r_idx = (me - step - 1) % ws  # chunk we receive + reduce
             self._put(
                 f"{pfx}/r{step}>{right}",
-                _compress_frames(fused, segs[s_idx], dummy, rng),
+                _compress_frames(fused, segs[s_idx], dummy, rng, wdt),
             )
             buf = self._take(f"{pfx}/r{step}>{me}")
-            _decompress_frames(buf, segs[r_idx], fused, dummy, add=True)
+            _decompress_frames(buf, segs[r_idx], fused, dummy, add=True, wire_dtype=wdt)
         # Our fully-reduced chunk is (me+1) % ws; requantize + self-dequantize
         # it once (error symmetry, ring.cc:190-199), then circulate.
-        hold = _compress_frames(fused, segs[(me + 1) % ws], dummy, rng)
+        hold = _compress_frames(fused, segs[(me + 1) % ws], dummy, rng, wdt)
         _decompress_frames(
             np.frombuffer(hold, np.uint8), segs[(me + 1) % ws], fused, dummy,
-            add=False,
+            add=False, wire_dtype=wdt,
         )
         for step in range(ws - 1):
             r_idx = (me - step) % ws  # chunk arriving this step
             self._put(f"{pfx}/a{step}>{right}", hold)
             buf = self._take(f"{pfx}/a{step}>{me}")
-            _decompress_frames(buf, segs[r_idx], fused, dummy, add=False)
+            _decompress_frames(buf, segs[r_idx], fused, dummy, add=False, wire_dtype=wdt)
             hold = buf.tobytes()  # forward verbatim next step
 
-    def _qreduce_alltoall(self, fused, layers, pfx) -> None:
+    def _qreduce_alltoall(self, fused, layers, pfx, wdt=np.float32) -> None:
         """Debug all-to-all: compress once, everyone sums everything
         (CGX_DEBUG_ALL_TO_ALL_REDUCTION, scatter_reduce_allgather.cc:269-306)."""
         ws, me = self._size, self._rank
         dummy = cfg.dummy_compression()
         rng = self._stochastic_rng()
         segs = _segments_in(layers, 0, fused.shape[0])
-        wire = _compress_frames(fused, segs, dummy, rng)
+        wire = _compress_frames(fused, segs, dummy, rng, wdt)
         self._put(f"{pfx}/x{me}", wire)
         # Decode own wire too so every rank sums identical quantized terms.
         _decompress_frames(
-            np.frombuffer(wire, np.uint8), segs, fused, dummy, add=False
+            np.frombuffer(wire, np.uint8), segs, fused, dummy, add=False,
+            wire_dtype=wdt,
         )
         for j in range(ws):
             if j == me:
                 continue
             buf = self._take(f"{pfx}/x{j}", readers=ws - 1)
-            _decompress_frames(buf, segs, fused, dummy, add=True)
+            _decompress_frames(buf, segs, fused, dummy, add=True, wire_dtype=wdt)
 
     def _sum_alltoall(self, arr: np.ndarray, np_dtype, pfx: str) -> None:
         """Uncompressed small-slice reduction: full exchange + local sum
