@@ -1,0 +1,104 @@
+"""Bridge <-> accelerator codec interop: frames encoded on either side must
+be byte-identical (deterministic mode) and decode on the other (VERDICT r2
+#5: the reference runs its codec on the device holding the gradients,
+ProcessGroupCGX.cc:374-407; this is the TPU-host analogue via DLPack
+staging into the jitted JAX codec)."""
+
+import numpy as np
+import pytest
+
+from torch_cgx_tpu import config as cgx_config
+from torch_cgx_tpu.ops import codec_host as hcodec
+from torch_cgx_tpu.torch_backend import device_codec
+
+
+@pytest.fixture(autouse=True)
+def _force_on(monkeypatch):
+    # CPU suite: force the device path (auto only engages on real TPU).
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_CODEC, "on")
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_MIN_NUMEL, "1")
+
+
+@pytest.mark.parametrize("bits,bucket,n", [(4, 512, 4096), (2, 128, 50_000), (8, 512, 512)])
+def test_device_encode_matches_host_bytes(bits, bucket, n):
+    x = np.random.default_rng(bits).normal(size=n).astype(np.float32)
+    wire_dev = device_codec.quantize(x, bits, bucket)
+    q_host = hcodec.quantize(x, bits, bucket)
+    wire_host = q_host.to_bytes().tobytes()
+    assert wire_dev == wire_host
+
+
+def test_host_encode_device_decode_roundtrip():
+    n, bits, bucket = 20_000, 4, 512
+    x = np.random.default_rng(1).normal(size=n).astype(np.float32)
+    wire = hcodec.quantize(x, bits, bucket).to_bytes()
+    y_dev = device_codec.dequantize(wire, n, bits, bucket)
+    y_host = hcodec.dequantize(
+        hcodec.from_bytes(wire, n, bits, bucket, np.float32),
+        out_dtype=np.float32,
+    )
+    # device decode is XLA (FMA) vs host mul+add: 1 ulp
+    np.testing.assert_allclose(y_dev, y_host, rtol=2e-6, atol=5e-7)
+
+
+def test_device_encode_host_decode_bf16_meta():
+    import ml_dtypes
+
+    bf16 = np.dtype(ml_dtypes.bfloat16)
+    n, bits, bucket = 8192, 4, 512
+    x = np.random.default_rng(2).normal(size=n).astype(np.float32)
+    wire = device_codec.quantize(x, bits, bucket, meta_dtype=bf16)
+    assert len(wire) == hcodec.wire_layout(n, bits, bucket, bf16)[3]
+    q = hcodec.from_bytes(
+        np.frombuffer(wire, np.uint8), n, bits, bucket, bf16
+    )
+    y = hcodec.dequantize(q, out_dtype=np.float32)
+    xb = x.reshape(-1, bucket)
+    unit = (xb.max(1) - xb.min(1)) / ((1 << bits) - 1)
+    err = np.abs(y - x).reshape(-1, bucket).max(1)
+    assert (err <= unit * 1.01 + 1e-6).all()
+
+
+def test_compress_frames_routes_through_device(monkeypatch):
+    """The bridge's framing must actually take the device path when enabled
+    (poisoned host codec proves routing), and its bytes must equal the host
+    path's."""
+    from torch_cgx_tpu.torch_backend.backend import _Segment, _compress_frames
+
+    n, bits, bucket = 4096, 4, 512
+    fused = np.random.default_rng(3).normal(size=n).astype(np.float32)
+    segs = [_Segment(0, n, bits, bucket)]
+    want = _compress_frames(fused, segs, False, None)  # device path (forced on)
+
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_CODEC, "off")
+    host = _compress_frames(fused, segs, False, None)
+    assert want == host
+
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_CODEC, "on")
+
+    def _boom(*a, **k):
+        raise AssertionError("expected the device codec, got the host codec")
+
+    monkeypatch.setattr(
+        "torch_cgx_tpu.torch_backend.backend.hcodec.quantize", _boom
+    )
+    again = _compress_frames(fused, segs, False, None)
+    assert again == want
+
+
+def test_small_segments_stay_on_host(monkeypatch):
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_MIN_NUMEL, "1000000")
+    assert not device_codec.enabled(4096)
+    monkeypatch.setenv(cgx_config.BRIDGE_DEVICE_MIN_NUMEL, "1")
+    assert device_codec.enabled(4096)
+
+
+def test_stochastic_device_encode_envelope():
+    n, bits, bucket = 16384, 4, 512
+    x = np.random.default_rng(5).normal(size=n).astype(np.float32)
+    wire = device_codec.quantize(x, bits, bucket, stochastic_seed=42)
+    y = device_codec.dequantize(wire, n, bits, bucket)
+    xb = x.reshape(-1, bucket)
+    unit = (xb.max(1) - xb.min(1)) / ((1 << bits) - 1)
+    err = np.abs(y - x).reshape(-1, bucket).max(1)
+    assert (err <= unit * 1.01 + 1e-6).all()

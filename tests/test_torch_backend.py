@@ -482,6 +482,41 @@ def test_bucket_disambiguation_ws2():
     _launch(_worker_bucket_disambiguation, ws=2)
 
 
+def _worker_fake_ratio(rank: int, ws: int) -> None:
+    import os
+
+    import numpy as np
+    import torch
+    import torch.distributed as dist
+
+    # CGX_COMPRESSION_FAKE_RATIO: only the leading fraction of the
+    # compressed slice travels; the tail stays stale (debug traffic
+    # shaping, mpi_allreduce_operations.cc:130-144). The bridge's
+    # span-based implementation must reduce exactly the leading budget
+    # and leave the rest untouched.
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "8"
+    os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = "64"
+    os.environ["CGX_COMPRESSION_FAKE_RATIO"] = "0.5"
+    n = 4096
+    t = torch.full((n,), float(rank + 1)).reshape(64, 64)
+    dist.all_reduce(t)
+    flat = t.reshape(-1)
+    total = float(sum(range(1, ws + 1)))
+    lead = np.asarray(flat[: n // 2])
+    tail = np.asarray(flat[n // 2 :])
+    # constant buckets quantize exactly: leading half allreduced...
+    np.testing.assert_allclose(lead, total, rtol=1e-6)
+    # ...tail untouched (still this rank's own values)
+    np.testing.assert_allclose(tail, float(rank + 1), rtol=1e-6)
+    del os.environ["CGX_COMPRESSION_FAKE_RATIO"]
+    dist.barrier()
+
+
+@pytest.mark.torch_bridge
+def test_fake_ratio_bridge_ws2():
+    _launch(_worker_fake_ratio, ws=2)
+
+
 @pytest.mark.torch_bridge
 def test_async_p2p_ws2():
     _launch(_worker_async_p2p, ws=2)

@@ -49,6 +49,8 @@ DEBUG_ALL_TO_ALL_REDUCTION = "CGX_DEBUG_ALL_TO_ALL_REDUCTION"
 # TPU-only additions (no reference analogue):
 STOCHASTIC_ROUNDING = "CGX_STOCHASTIC_ROUNDING"  # QSGD_DETERMENISTIC inverse
 CODEC_IMPL = "CGX_CODEC_IMPL"  # "xla" | "pallas" | "auto"
+BRIDGE_DEVICE_CODEC = "CGX_BRIDGE_DEVICE_CODEC"  # "auto" | "on" | "off"
+BRIDGE_DEVICE_MIN_NUMEL = "CGX_BRIDGE_DEVICE_MIN_NUMEL"
 SEED = "CGX_SEED"
 LOG_LEVEL = "CGX_LOG_LEVEL"
 
@@ -215,6 +217,26 @@ def codec_impl() -> str:
     if impl not in ("xla", "pallas", "auto"):
         raise ValueError(f"{CODEC_IMPL} must be xla|pallas|auto, got {impl!r}")
     return impl
+
+
+def bridge_device_codec() -> str:
+    """Whether the torch bridge stages segments through the accelerator for
+    codec work (DLPack -> jitted JAX codec -> one copy back): "on", "off",
+    or "auto" (on only when JAX's default backend is a TPU). The reference
+    runs its codec on the device holding the gradients
+    (ProcessGroupCGX.cc:374-407); this is the TPU-host analogue."""
+    mode = _env.get_str_env_or_default(BRIDGE_DEVICE_CODEC, "auto").lower()
+    if mode not in ("on", "off", "auto"):
+        raise ValueError(
+            f"{BRIDGE_DEVICE_CODEC} must be on|off|auto, got {mode!r}"
+        )
+    return mode
+
+
+def bridge_device_min_numel() -> int:
+    """Segments below this element count stay on the host codec (the
+    host<->device hop has fixed latency; tiny segments lose)."""
+    return _env.get_int_env_or_default(BRIDGE_DEVICE_MIN_NUMEL, 65536)
 
 
 def global_seed() -> int:

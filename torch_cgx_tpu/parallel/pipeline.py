@@ -26,6 +26,15 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def _squeeze_stage_axis(local_params):
+    """Drop the leading stage axis shard_map leaves on each device's slice
+    of the stacked per-stage params (size 1 after sharding over pp)."""
+    return jax.tree.map(
+        lambda x: jnp.squeeze(x, 0) if x.ndim and x.shape[0] == 1 else x,
+        local_params,
+    )
+
+
 def stack_stage_params(stage_params: Sequence):
     """Stack per-stage parameter pytrees along a new leading stage axis
     (shard it over the 'pp' mesh axis with ``PartitionSpec('pp', ...)``)."""
@@ -62,10 +71,7 @@ def spmd_pipeline(
     """
     m = microbatches.shape[0]
     stage = lax.axis_index(axis_name)
-    params = jax.tree.map(
-        lambda x: jnp.squeeze(x, 0) if x.ndim and x.shape[0] == 1 else x,
-        local_params,
-    )
+    params = _squeeze_stage_axis(local_params)
     ticks = m + n_stages - 1
     zero = jnp.zeros_like(microbatches[0])
     right = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -102,6 +108,152 @@ def spmd_pipeline(
         axis_name,
     )
     return outputs
+
+
+def live_stash_microbatches(n_stages: int) -> int:
+    """Per-stage activation-stash bound of the 1F1B schedule: microbatch k's
+    input is stashed at its forward tick (k + s) and freed at its backward
+    tick (k + 2(S-1) - s), a lifetime of 2(S-1-s) ticks — so a ring of
+    2(S-1)+1 slots suffices on every stage. GPipe differentiated through the
+    scan instead checkpoints every tick's carry: O(M + S) microbatches. The
+    1F1B bound is independent of the microbatch count M — the entire point
+    of the schedule (Narayanan et al., PipeDream-Flush)."""
+    return 2 * (n_stages - 1) + 1
+
+
+def pipeline_1f1b(
+    stage_fn: Callable,
+    loss_fn: Callable,
+    local_params,
+    local_microbatches: jax.Array,
+    targets: jax.Array,
+    *,
+    axis_name: str = "pp",
+    n_stages: int,
+):
+    """One-forward-one-backward (1F1B / PipeDream-flush) pipelined training
+    step **inside shard_map** — forward AND backward are scheduled
+    explicitly, so live activation memory is O(S) microbatches per stage
+    instead of the GPipe-through-AD O(M) (:func:`live_stash_microbatches`).
+
+    Schedule (uniform SPMD program; S = n_stages, M = microbatches): at tick
+    ``t`` stage ``s`` runs the forward of microbatch ``f = t - s`` and the
+    backward of ``b = t - (2(S-1) - s)`` when those indices are in range; in
+    steady state every tick is one fwd + one bwd — the 1F1B interleave. The
+    backward *recomputes* the stage forward from the stashed input
+    (rematerialization), seeds from the local loss gradient on the last
+    stage, and flows cotangents leftward with ``lax.ppermute``; total ticks
+    = M + 2(S-1).
+
+    Memory-scalable feed: ``local_microbatches`` is this device's
+    ``(M/S, ...)`` shard of the stream (shard the leading microbatch dim
+    over the pp axis — ``in_specs=P(axis_name)``). Each tick the owning
+    stage contributes microbatch ``f`` through a single-microbatch ``psum``,
+    so no device ever holds the full stream — fixing the GPipe helper's
+    O(global batch) per-stage feed. ``targets`` stays replicated (labels are
+    small).
+
+    ``stage_fn(params, x) -> y`` with ``y.shape == x.shape`` (the SPMD
+    carrier; for stages with differing natural shapes, pad into a common
+    carrier — embeddings/logits never travel: stage 0 consumes raw
+    microbatches and the last stage feeds ``loss_fn(y, tgt) -> scalar``
+    locally).
+
+    Returns ``(loss, grads)``: the mean per-microbatch loss (replicated) and
+    this stage's parameter cotangents of that mean (leading stage axis of
+    size 1 — ``out_specs=P(axis_name)`` reassembles the stacked layout).
+    """
+    s_count = n_stages
+    m_local = local_microbatches.shape[0]
+    m = m_local * s_count
+    stage = lax.axis_index(axis_name)
+    params = _squeeze_stage_axis(local_params)
+    k_slots = live_stash_microbatches(s_count)
+    zero = jnp.zeros_like(local_microbatches[0])
+    right = [(i, (i + 1) % s_count) for i in range(s_count)]
+    left = [(i, (i - 1) % s_count) for i in range(s_count)]
+    ticks = m + 2 * (s_count - 1)
+
+    g_zero = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+    def tick(carry, t):
+        recv_x, recv_cot, stash, gacc, lacc = carry
+        f = t - stage
+        b = t - (2 * (s_count - 1) - stage)
+        do_f = jnp.logical_and(f >= 0, f < m)
+        do_b = jnp.logical_and(b >= 0, b < m)
+        fc = jnp.clip(f, 0, m - 1)
+        bc = jnp.clip(b, 0, m - 1)
+
+        # Feed: the owner of the microbatch STAGE 0 consumes this tick
+        # (f at stage 0 = t — a mesh-uniform index; using the local f here
+        # would make devices disagree about the owner and psum to zero)
+        # contributes it; one microbatch-sized psum delivers it. shard_map's
+        # P(axis) sharding is contiguous: device d holds microbatches
+        # [d*m_local, (d+1)*m_local).
+        feed_idx = jnp.clip(t, 0, m - 1)
+        own = lax.dynamic_index_in_dim(
+            local_microbatches, feed_idx % m_local, 0, keepdims=False
+        )
+        feed = lax.psum(
+            jnp.where(stage == feed_idx // m_local, own, jnp.zeros_like(own)),
+            axis_name,
+        )
+        x_in = jnp.where(stage == 0, feed, recv_x)
+
+        # Forward; stash the input for the rematerialized backward.
+        y = stage_fn(params, x_in)
+        stash = jnp.where(
+            do_f,
+            lax.dynamic_update_index_in_dim(
+                stash, x_in, fc % k_slots, 0
+            ),
+            stash,
+        )
+
+        # Backward of microbatch b: recompute from the stash. (When f == b —
+        # last stage, same tick — the slot was just written above, so the
+        # recompute sees this tick's input.)
+        x_b = lax.dynamic_index_in_dim(stash, bc % k_slots, 0, keepdims=False)
+        tgt_b = lax.dynamic_index_in_dim(targets, bc, 0, keepdims=False)
+
+        def fwd_and_loss(p, x):
+            y2 = stage_fn(p, x)
+            return y2, loss_fn(y2, tgt_b)
+
+        (_, l_b), vjp_fn = jax.vjp(fwd_and_loss, params, x_b)
+        is_last = stage == s_count - 1
+        seed_y = jnp.where(is_last, jnp.zeros_like(recv_cot), recv_cot)
+        seed_l = jnp.where(is_last, jnp.float32(1), jnp.float32(0))
+        cot_p, cot_x = vjp_fn((seed_y, seed_l))
+        gacc = jax.tree.map(
+            lambda g, c: g + jnp.where(do_b, c.astype(jnp.float32), 0),
+            gacc,
+            cot_p,
+        )
+        # Loss of microbatch b, observed on the last stage during backward.
+        lacc = lacc + jnp.where(
+            jnp.logical_and(do_b, is_last), l_b.astype(jnp.float32), 0.0
+        )
+
+        recv_x = lax.ppermute(y, axis_name, right)
+        recv_cot = lax.ppermute(cot_x, axis_name, left)
+        return (recv_x, recv_cot, stash, gacc, lacc), None
+
+    stash0 = jnp.zeros((k_slots,) + zero.shape, zero.dtype)
+    (_, _, _, gacc, lacc), _ = lax.scan(
+        tick,
+        (zero, jnp.zeros_like(zero), stash0, g_zero, jnp.float32(0)),
+        jnp.arange(ticks),
+    )
+    loss = (
+        lax.psum(
+            jnp.where(stage == s_count - 1, lacc, jnp.float32(0)), axis_name
+        )
+        / m
+    )
+    grads = jax.tree.map(lambda g: (g / m)[None], gacc)
+    return loss, grads
 
 
 def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:

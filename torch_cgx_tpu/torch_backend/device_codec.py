@@ -1,0 +1,166 @@
+"""Accelerator-resident codec for the torch bridge.
+
+The reference runs its compression on the device that holds the gradients,
+fenced by events on a side stream (/root/reference/src/ProcessGroupCGX.cc:
+374-407). The TPU-host analogue: stage a bucket segment into a JAX array
+(zero-copy from the torch CPU tensor via DLPack where possible), run the
+jitted codec — the fused Pallas kernels on a TPU — and copy the compressed
+wire bytes (8x smaller at 4 bits) back once. The Store remains the
+transport; only the codec math moves off the host CPU.
+
+Wire bytes are identical to the host codec's (``ops/codec_host.py``): the
+same chunked-sublane format is implemented by all codec backends and
+asserted byte-equal in tests, so a frame encoded on-device decodes on the
+host path and vice versa — receivers never need to know which side encoded.
+
+Enabled per CGX_BRIDGE_DEVICE_CODEC ("auto": only when JAX's default
+backend is a TPU; "on" forces it — useful for CPU-jax tests; "off" keeps
+everything on the host codec). Segments below CGX_BRIDGE_DEVICE_MIN_NUMEL
+elements always stay on the host (the device hop has fixed latency).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .. import config as cfg
+
+_state: Optional[dict] = None
+
+
+def _jax_state() -> Optional[dict]:
+    """Lazy jax import + capability probe (None = unavailable)."""
+    global _state
+    if _state is not None:
+        return _state or None
+    try:
+        import jax
+
+        from ..config import CompressionConfig  # noqa: F401
+        from ..ops import dispatch  # noqa: F401
+
+        _state = {"jax": jax, "backend": jax.default_backend()}
+    except Exception:  # pragma: no cover - jax always present in-tree
+        _state = {}
+        return None
+    return _state
+
+
+def enabled(numel: int) -> bool:
+    mode = cfg.bridge_device_codec()
+    if mode == "off" or numel < cfg.bridge_device_min_numel():
+        return False
+    if mode == "auto" and not _jax_already_initialized():
+        # Auto mode must never be the thing that *initializes* the
+        # accelerator runtime: a pure-torch DDP user whose process never
+        # touched JAX would otherwise pay (or hang on) device bring-up from
+        # inside an allreduce. Auto engages only when JAX is already live
+        # in this process; force with CGX_BRIDGE_DEVICE_CODEC=on otherwise.
+        return False
+    st = _jax_state()
+    if st is None:
+        return False
+    if mode == "on":
+        return True
+    return st["backend"] == "tpu"
+
+
+def _jax_already_initialized() -> bool:
+    import sys
+
+    j = sys.modules.get("jax")
+    if j is None:
+        return False
+    try:
+        from jax._src import xla_bridge as xb
+
+        return bool(getattr(xb, "_backends", None))
+    except Exception:
+        return True  # unknown jax internals: assume live, let _jax_state try
+
+
+def _to_device(x: np.ndarray):
+    """Host float32 segment -> JAX array, zero-copy where DLPack allows."""
+    import jax
+
+    try:
+        import torch
+        import torch.utils.dlpack as tdlp
+
+        # torch wraps the numpy buffer without a copy; jax imports the
+        # DLPack capsule zero-copy on CPU, then XLA moves it to the
+        # accelerator as one transfer.
+        return jax.dlpack.from_dlpack(
+            tdlp.to_dlpack(torch.from_numpy(np.ascontiguousarray(x)))
+        )
+    except Exception:
+        import jax.numpy as jnp
+
+        return jnp.asarray(x)
+
+
+def quantize(
+    x: np.ndarray,
+    bits: int,
+    bucket_size: int,
+    *,
+    stochastic_seed: Optional[int] = None,
+    meta_dtype=np.float32,
+) -> bytes:
+    """Encode a float32 segment on the accelerator; returns host wire bytes
+    (meta | packed) in the host codec's layout."""
+    import jax
+
+    from ..config import CompressionConfig
+    from ..ops import dispatch
+
+    cc = CompressionConfig(
+        bits=bits, bucket_size=bucket_size, stochastic=stochastic_seed is not None
+    )
+    key = (
+        jax.random.PRNGKey(stochastic_seed)
+        if stochastic_seed is not None
+        else None
+    )
+    q = dispatch.quantize_batch(_to_device(x)[None], cc, key=key)
+    meta = np.asarray(q.meta[0]).astype(meta_dtype)
+    packed = np.asarray(q.packed[0])
+    return meta.tobytes() + packed.tobytes()
+
+
+def dequantize(
+    buf: np.ndarray,
+    numel: int,
+    bits: int,
+    bucket_size: int,
+    *,
+    meta_dtype=np.float32,
+) -> np.ndarray:
+    """Decode host wire bytes on the accelerator -> float32[numel]."""
+    import jax.numpy as jnp
+
+    from ..ops import codec, codec_host as hcodec, dispatch
+
+    meta_b, packed_b, _, total = hcodec.wire_layout(
+        numel, bits, bucket_size, meta_dtype
+    )
+    if isinstance(buf, (bytes, bytearray)):
+        buf = np.frombuffer(buf, np.uint8)
+    raw = np.ascontiguousarray(buf.reshape(-1).view(np.uint8)[:total])
+    nb = meta_b // (2 * np.dtype(meta_dtype).itemsize)
+    meta = raw[:meta_b].view(meta_dtype).reshape(nb, 2)
+    packed = raw[meta_b : meta_b + packed_b].view(np.uint32)
+    q = codec.QTensor(
+        packed=_to_device(packed.view(np.int32)).view(jnp.uint32)[None],
+        meta=jnp.asarray(np.asarray(meta, dtype=np.float32))[None],
+        residual=jnp.zeros((1, 0), jnp.float32),
+        numel=numel,
+        bits=bits,
+        bucket_size=bucket_size,
+        dtype=np.dtype(np.float32),
+    )
+    return np.asarray(
+        dispatch.dequantize_batch(q, out_dtype=jnp.float32)[0]
+    )
