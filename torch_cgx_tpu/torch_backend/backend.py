@@ -19,10 +19,14 @@ MPI/CUDA: the *architecture* is preserved —
 * the requantize + self-dequantize **error-symmetry step** on the reduced
   chunk (scatter_reduce_allgather.cc:157-160) so exactness oracles hold,
 * thin uncompressed wrappers for broadcast / allgather / gather / scatter /
-  alltoall / send / recv / barrier (ProcessGroupCGX.cc:341-833), and
-* NotImplementedError on ``reduce_scatter`` / ``_allgather_base`` /
-  ``_reduce_scatter_base`` exactly like the reference
-  (ProcessGroupCGX.cc:422-428,494-501,631-636,827-833).
+  alltoall / send / recv / barrier (ProcessGroupCGX.cc:341-833),
+* ``all_gather_into_tensor`` / ``reduce_scatter_tensor`` — the collectives
+  FSDP/ZeRO sharding is built from; the reference throws on both
+  (ProcessGroupCGX.cc:631-636,827-833), which is why FSDP can never run on
+  it. ``reduce_scatter_tensor`` compresses eligible float chunks (it is the
+  scatter-reduce half of SRA), and
+* NotImplementedError on ``allreduce_coalesced`` like the reference
+  (ProcessGroupCGX.cc:422-428).
 
 What is *not* preserved (deliberately — SURVEY.md §7 stance): the transport.
 MPI point-to-point + SHM/CUDA-IPC (L2/L0) collapse into the c10d **Store**
@@ -105,10 +109,13 @@ def _to_np(t: torch.Tensor) -> np.ndarray:
 
 def _from_np(t: torch.Tensor, a: np.ndarray) -> None:
     """Write a flat numpy array back into tensor t (any float narrowing is
-    done by torch, matching how the reference writes reduced fp16)."""
+    done by torch, matching how the reference writes reduced fp16).
+    ``copy_`` on the original tensor is stride-aware, so non-contiguous
+    targets receive the data too (a reshape(-1) view would silently write
+    into a detached copy)."""
     with torch.no_grad():
         src = torch.from_numpy(np.ascontiguousarray(a))
-        t.detach().reshape(-1).copy_(src.to(t.dtype))
+        t.detach().copy_(src.to(t.dtype).reshape(t.shape))
 
 
 # ---------------------------------------------------------------------------
@@ -976,17 +983,146 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
     # -- unsupported, reference parity ------------------------------------
 
-    def reduce_scatter(self, output_tensors, input_tensors, opts=None):
-        raise NotImplementedError(
-            "ProcessGroupCGX does not support reduce_scatter "
-            "(reference ProcessGroupCGX.cc:631-636)"
-        )
+    # -- sharded-parameter collectives (beyond reference: it throws on all
+    # three, ProcessGroupCGX.cc:422-428,631-636,827-833 — which is exactly
+    # why FSDP cannot run on it. FSDP's hot collectives are
+    # all_gather_into_tensor and reduce_scatter_tensor; the latter is the
+    # first half of the SRA algorithm, so eligible tensors get the same
+    # quantized treatment as allreduce.) -----------------------------------
 
     def _allgather_base(self, output, input, opts=None):
-        raise NotImplementedError(
-            "ProcessGroupCGX does not support _allgather_base "
-            "(reference ProcessGroupCGX.cc:827-833)"
+        seq = self._next_seq()
+
+        def run():
+            key = f"cgx{seq}agb"
+            n = input.numel()
+            # reshape(-1) of a non-contiguous output is a detached copy —
+            # stage there and copy back stride-aware at the end.
+            contig = output.is_contiguous()
+            flat = output.reshape(-1) if contig else torch.empty(
+                output.numel(), dtype=output.dtype
+            )
+            self._put(f"{key}/{self._rank}", self._bytes_of(input))
+            for j in range(self._size):
+                dst = flat[j * n : (j + 1) * n]
+                if j == self._rank:
+                    with torch.no_grad():
+                        dst.copy_(input.reshape(-1))
+                    continue
+                buf = self._take(f"{key}/{j}", readers=self._size - 1)
+                with torch.no_grad():
+                    dst.copy_(self._tensor_from(buf, dst))
+            if not contig:
+                with torch.no_grad():
+                    output.copy_(flat.reshape(output.shape))
+
+        return self._submit(run, [output])
+
+    def _reduce_scatter_base(self, output, input, opts=None):
+        """reduce_scatter_tensor: rank r receives the reduction of every
+        rank's r-th chunk. Float SUM/AVG inputs are compressed per chunk
+        (the scatter-reduce half of SRA, scatter_reduce_allgather.cc:
+        116-155); other dtypes/ops exchange raw chunks."""
+        op = opts.reduceOp if opts is not None else dist.ReduceOp.SUM
+        seq = self._next_seq()
+        ws, me = self._size, self._rank
+        n = output.numel()
+        cc = cfg.default_compression_config()
+        do_compress = (
+            input.dtype in _TORCH_FLOATS
+            and op in (dist.ReduceOp.SUM, dist.ReduceOp.AVG)
+            and ws > 1
+            and cc.enabled
+            and n >= cfg.minimal_size()
         )
+
+        if op == dist.ReduceOp.AVG and not input.is_floating_point():
+            raise ValueError(
+                "reduce_scatter_tensor: ReduceOp.AVG requires a floating "
+                f"dtype, got {input.dtype}"
+            )
+
+        def run():
+            if ws == 1:
+                with torch.no_grad():
+                    output.copy_(
+                        input.reshape(-1)[:n].reshape(output.shape)
+                    )
+                return
+            key = f"cgx{seq}rsb"
+            arr = _to_np(input)  # natural dtype (bf16 upcast to f32)
+            if do_compress:
+                arr = arr.astype(np.float32, copy=False)
+                rng = self._stochastic_rng()
+                wdt = _wire_dtype(input.dtype)
+                seg = [_Segment(0, n, cc.bits, cc.bucket_size)]
+                for j in range(ws):
+                    if j != me:
+                        chunk = np.ascontiguousarray(
+                            arr[j * n : (j + 1) * n]
+                        )
+                        self._put(
+                            f"{key}/{me}>{j}",
+                            _compress_frames(chunk, seg, False, rng, wdt),
+                        )
+                own = np.ascontiguousarray(arr[me * n : (me + 1) * n])
+                for j in range(ws):
+                    if j != me:
+                        buf = self._take(f"{key}/{j}>{me}")
+                        _decompress_frames(
+                            buf, seg, own, False, add=True, wire_dtype=wdt
+                        )
+            else:
+                np_dtype = _NP_OF_TORCH.get(input.dtype, np.float32)
+                for j in range(ws):
+                    if j != me:
+                        self._put(
+                            f"{key}/{me}>{j}",
+                            np.ascontiguousarray(
+                                arr[j * n : (j + 1) * n]
+                            ).astype(np_dtype, copy=False).tobytes(),
+                        )
+                own = np.ascontiguousarray(arr[me * n : (me + 1) * n])
+                for j in range(ws):
+                    if j != me:
+                        peer = self._take(f"{key}/{j}>{me}").view(np_dtype)
+                        if op == dist.ReduceOp.MAX:
+                            np.maximum(own, peer, out=own)
+                        elif op == dist.ReduceOp.MIN:
+                            np.minimum(own, peer, out=own)
+                        elif op == dist.ReduceOp.PRODUCT:
+                            own *= peer
+                        else:
+                            own += peer
+            if op == dist.ReduceOp.AVG and np.issubdtype(
+                own.dtype, np.floating
+            ):
+                own /= ws
+            _from_np(output, own)
+
+        return self._submit(run, [output])
+
+    def reduce_scatter(self, output_tensors, input_tensors, opts=None):
+        # List form: flatten the per-rank input list into one contiguous
+        # buffer and reuse the tensor form.
+        self._check_single(output_tensors)
+        if len(input_tensors) != 1:
+            raise RuntimeError(
+                "ProcessGroupCGX supports single-tensor operations only"
+            )
+        ins = input_tensors[0]
+        out = output_tensors[0]
+        flat = torch.cat([t.reshape(-1) for t in ins])
+        return self._reduce_scatter_base(out, flat, opts)
+
+    # Current torch dispatches all_gather_into_tensor /
+    # reduce_scatter_tensor through these names; the _-prefixed ones above
+    # are the legacy hooks. Keep both.
+    def all_gather_single(self, output, input, opts=None):
+        return self._allgather_base(output, input, opts)
+
+    def reduce_scatter_single(self, output, input, opts=None):
+        return self._reduce_scatter_base(output, input, opts)
 
     def allreduce_coalesced(self, tensors, opts=None):
         raise NotImplementedError(
