@@ -27,6 +27,7 @@ present — a broken hot path must fail loudly here, not measure garbage
 from __future__ import annotations
 
 import json
+import os
 import subprocess
 import sys
 import time
@@ -197,8 +198,16 @@ def bench_train_step(on_tpu: bool) -> dict:
     import optax
 
     from torch_cgx_tpu.models import GPT2, GPT2Config, lm_loss
-    from torch_cgx_tpu.ops import codec_pallas
-    from torch_cgx_tpu.utils.tree import round_up
+    from torch_cgx_tpu.parallel import gradient_sync
+
+    _bench_env = {
+        "CGX_DEBUG_FORCE_CODEC": "1",
+        "CGX_COMPRESSION_QUANTIZATION_BITS": str(BITS),
+        "CGX_COMPRESSION_BUCKET_SIZE": str(BUCKET),
+    }
+    _saved_env = {k: os.environ.get(k) for k in _bench_env}
+    os.environ.update(_bench_env)
+    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
 
     cfg = (
         GPT2Config(n_layer=12, n_head=12, d_model=768, vocab_size=50257,
@@ -227,26 +236,20 @@ def bench_train_step(on_tpu: bool) -> dict:
         return (optax.apply_updates(p, updates), s), loss
 
     def codec_step(carry):
+        # The PRODUCTION gradient-sync path on a 1-device mesh with
+        # CGX_DEBUG_FORCE_CODEC: allreduce_tree's grouping (large leaves
+        # standalone — zero-copy flat views; small leaves fused) + the
+        # per-rank codec round trip of SRA. This measures what a real rank
+        # pays, including the framework's own glue.
         p, s = carry
         loss, grads = jax.value_and_grad(loss_fn)(p)
-        leaves, treedef = jax.tree.flatten(grads)
-        flat = jnp.concatenate([l.reshape(-1).astype(jnp.float32)
-                                for l in leaves])
-        m = round_up(flat.shape[0], 32 * BUCKET)
-        q = codec_pallas.quantize_batch(
-            jnp.pad(flat, (0, m - flat.shape[0]))[None], BITS, BUCKET,
-            interpret=not on_tpu,
-        )
-        dec = codec_pallas.dequantize_batch(
-            q, out_dtype=jnp.float32, interpret=not on_tpu
-        )[0, : flat.shape[0]]
-        out, off = [], 0
-        for leaf in leaves:
-            out.append(
-                dec[off : off + leaf.size].reshape(leaf.shape).astype(leaf.dtype)
-            )
-            off += leaf.size
-        grads = jax.tree.unflatten(treedef, out)
+        grads = jax.shard_map(
+            lambda g: gradient_sync(g, mesh=mesh1, average=False),
+            mesh=mesh1,
+            in_specs=P(),
+            out_specs=P(),
+            check_vma=False,
+        )(grads)
         updates, s = opt.update(grads, s, p)
         return (optax.apply_updates(p, updates), s), loss
 
@@ -271,9 +274,20 @@ def bench_train_step(on_tpu: bool) -> dict:
 
         return timed()
 
-    k = 6 if on_tpu else 3
-    t_plain = (steps_time(plain_step, k) - steps_time(plain_step, 1)) / (k - 1)
-    t_codec = (steps_time(codec_step, k) - steps_time(codec_step, 1)) / (k - 1)
+    try:
+        k = 6 if on_tpu else 3
+        t_plain = (
+            steps_time(plain_step, k) - steps_time(plain_step, 1)
+        ) / (k - 1)
+        t_codec = (
+            steps_time(codec_step, k) - steps_time(codec_step, 1)
+        ) / (k - 1)
+    finally:
+        for key, prior in _saved_env.items():
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
     overhead = (t_codec - t_plain) / t_plain * 100
     return {
         "model": "gpt2-small" if on_tpu else "gpt2-tiny",

@@ -204,3 +204,64 @@ def test_gradient_sync_replicated_outputs():
     w = np.asarray(out["w"])  # (ws, 128, 8) — every row identical
     for r in range(1, WS):
         np.testing.assert_array_equal(w[0], w[r])
+
+
+def test_large_leaves_form_standalone_groups(monkeypatch):
+    """Leaves >= CGX_STANDALONE_LAYER_ELEMS skip the fuse-concat: their
+    group is a singleton, so allreduce_tree takes the zero-copy reshape
+    path (the dominant codec-adjacent cost in the single-chip proxy)."""
+    from torch_cgx_tpu import config as cgx_config
+    from torch_cgx_tpu.parallel.allreduce import _group_leaves
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cgx_config.STANDALONE_LAYER_ELEMS, "1000")
+    big1 = jnp.zeros((64, 32))   # 2048 elems -> standalone
+    big2 = jnp.zeros((2000,), jnp.float32)  # 1-D but big: still own group
+    small = [jnp.zeros((10, 10)) for _ in range(3)]  # fuse together
+    leaves = [("a/big1", big1), ("b/big2", big2)] + [
+        (f"c/s{i}", s) for i, s in enumerate(small)
+    ]
+    groups = _group_leaves(leaves, compress_small=False)
+    singleton = [g for g in groups if len(g.indices) == 1]
+    fused = [g for g in groups if len(g.indices) > 1]
+    assert {g.indices[0] for g in singleton} == {0, 1}
+    assert len(fused) == 1 and set(fused[0].indices) == {2, 3, 4}
+
+
+def test_force_codec_ws1(monkeypatch):
+    """CGX_DEBUG_FORCE_CODEC on a 1-device axis runs the quantize +
+    self-dequantize round trip (the per-rank SRA work), so results carry
+    quantization error but stay within the envelope."""
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from torch_cgx_tpu import config as cgx_config
+    from torch_cgx_tpu.parallel import gradient_sync
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cgx_config.COMPRESSION_BUCKET_SIZE, "64")
+    mesh = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(64, 64)), jnp.float32)
+
+    def sync(g):
+        return gradient_sync(g, mesh=mesh, average=False)
+
+    run = jax.jit(
+        jax.shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+    )
+    # Without the flag: ws==1 is the identity.
+    y = run({"w": x})["w"]
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(x))
+    # With it: codec round trip — not identical, within per-bucket envelope.
+    # (config is read at trace time, so build a fresh jit for the new env)
+    monkeypatch.setenv(cgx_config.DEBUG_FORCE_CODEC, "1")
+    run2 = jax.jit(
+        jax.shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+    )
+    y = run2({"w": x})["w"]
+    err = np.abs(np.asarray(y) - np.asarray(x))
+    assert err.max() > 0
+    xb = np.asarray(x).reshape(-1, 64)
+    unit = (xb.max(1) - xb.min(1)) / 15
+    assert (err.reshape(-1, 64).max(1) <= unit * 0.51).all()

@@ -46,6 +46,8 @@ INTRA_COMPRESS = "CGX_INTRA_COMPRESS"
 REMOTE_BUF_COMPRESSION = "CGX_REMOTE_BUF_COMPRESSION"
 DEBUG_DUMMY_COMPRESSION = "CGX_DEBUG_DUMMY_COMPRESSION"
 DEBUG_ALL_TO_ALL_REDUCTION = "CGX_DEBUG_ALL_TO_ALL_REDUCTION"
+DEBUG_FORCE_CODEC = "CGX_DEBUG_FORCE_CODEC"
+STANDALONE_LAYER_ELEMS = "CGX_STANDALONE_LAYER_ELEMS"
 # TPU-only additions (no reference analogue):
 STOCHASTIC_ROUNDING = "CGX_STOCHASTIC_ROUNDING"  # QSGD_DETERMENISTIC inverse
 CODEC_IMPL = "CGX_CODEC_IMPL"  # "xla" | "pallas" | "auto"
@@ -208,6 +210,24 @@ def dummy_compression() -> bool:
     """CGX_DEBUG_DUMMY_COMPRESSION: pass-through codec for debugging
     (mpi_allreduce_operations.cc:46-54)."""
     return _env.get_bool_env_or_default(DEBUG_DUMMY_COMPRESSION, False)
+
+
+def force_codec() -> bool:
+    """CGX_DEBUG_FORCE_CODEC: run the quantize + self-dequantize round trip
+    even on a 1-device axis (where the allreduce is the identity). Lets a
+    single chip measure the codec work each rank performs inside SRA — the
+    bench harness's north-star proxy uses it."""
+    return _env.get_bool_env_or_default(DEBUG_FORCE_CODEC, False)
+
+
+def standalone_layer_elems() -> int:
+    """Leaves at least this large form their own fusion group: their flat
+    view is free (reshape), so they skip the gather-concat/scatter-back
+    copies entirely. Small leaves still fuse (the reference's motivation
+    for fusion is amortizing per-message latency of SMALL layers,
+    mpi_allreduce_operations.cc:201-227; a multi-megabyte tensor needs no
+    amortizing)."""
+    return _env.get_int_env_or_default(STANDALONE_LAYER_ELEMS, 1 << 20)
 
 
 def codec_impl() -> str:

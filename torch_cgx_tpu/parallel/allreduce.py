@@ -73,18 +73,31 @@ class _Group:
 
 
 def _group_leaves(paths_leaves, compress_small: bool) -> List[_Group]:
+    """Group leaves by (config, dtype) for fusion — except large leaves,
+    which become standalone groups: their flat view needs no gather-concat
+    or scatter-back pass (measured as the dominant codec-adjacent cost in
+    the single-chip proxy, BASELINE.md). The fusion threshold inside
+    allreduce_flat still chunks any oversized buffer."""
+    standalone = cfg_mod.standalone_layer_elems()
     groups: Dict[Tuple, List[int]] = {}
     order: List[Tuple] = []
+    out: List[_Group] = []
     for i, (path, leaf) in enumerate(paths_leaves):
         cc = resolve_leaf_config(path, leaf, compress_small=compress_small)
         if not cc.enabled:
             cc = CompressionConfig(bits=32)
+        if leaf.size >= standalone:
+            out.append(_Group(cc=cc, dtype=np.dtype(leaf.dtype), indices=(i,)))
+            continue
         k = (cc, np.dtype(leaf.dtype))
         if k not in groups:
             groups[k] = []
             order.append(k)
         groups[k].append(i)
-    return [_Group(cc=k[0], dtype=k[1], indices=tuple(groups[k])) for k in order]
+    out.extend(
+        _Group(cc=k[0], dtype=k[1], indices=tuple(groups[k])) for k in order
+    )
+    return out
 
 
 def _fusion_slices(n: int, elem_size: int) -> List[Tuple[int, int]]:

@@ -262,6 +262,20 @@ def quantized_allreduce(
     mpi_allreduce_operations.cc:70-115). Flat (non-hierarchical) allreduce
     of a 1-D buffer inside shard_map."""
     if ws == 1:
+        if cc.enabled and cfg_mod.force_codec():
+            # CGX_DEBUG_FORCE_CODEC: emulate the per-rank codec work of a
+            # real SRA step so one chip can measure codec overhead in a
+            # real train step. Per rank at world size ws, SRA quantizes
+            # ~n*(1+1/ws) values (peer chunks + requantized own chunk) and
+            # dequantizes ~n*(2-1/ws) (decompress-add in reduce-scatter,
+            # decode in allgather) — so the proxy runs ONE quantize and
+            # TWO decodes (one through the add_to accumulate path, like
+            # phase 1). Averaging the two identical decodes keeps both
+            # live without changing the value beyond float round-off.
+            q = _quantize_1d(x, cc, key)
+            dec_assign = _dequantize_1d(q)
+            dec_acc = _dequantize_1d(q, add_to=x) - x.astype(jnp.float32)
+            return ((dec_assign + dec_acc) * 0.5).astype(x.dtype)
         return x
     if cfg_mod.dummy_compression():
         # Debug pass-through codec: correctness of the transport alone.
