@@ -195,11 +195,6 @@ def bench_train_step(on_tpu: bool) -> dict:
     DP sync) vs the plain step. Bounds the achievable multi-chip speedup:
     codec overhead must stay a small fraction of step time for the wire
     savings to win (BASELINE.md north star)."""
-    import optax
-
-    from torch_cgx_tpu.models import GPT2, GPT2Config, lm_loss
-    from torch_cgx_tpu.parallel import gradient_sync
-
     _bench_env = {
         "CGX_DEBUG_FORCE_CODEC": "1",
         "CGX_COMPRESSION_QUANTIZATION_BITS": str(BITS),
@@ -207,7 +202,23 @@ def bench_train_step(on_tpu: bool) -> dict:
     }
     _saved_env = {k: os.environ.get(k) for k in _bench_env}
     os.environ.update(_bench_env)
-    mesh1 = Mesh(np.asarray(jax.devices()[:1]), ("dp",))
+    try:
+        return _bench_train_step_inner(on_tpu, mesh1=Mesh(
+            np.asarray(jax.devices()[:1]), ("dp",)
+        ))
+    finally:
+        for key, prior in _saved_env.items():
+            if prior is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = prior
+
+
+def _bench_train_step_inner(on_tpu: bool, mesh1) -> dict:
+    import optax
+
+    from torch_cgx_tpu.models import GPT2, GPT2Config, lm_loss
+    from torch_cgx_tpu.parallel import gradient_sync
 
     cfg = (
         GPT2Config(n_layer=12, n_head=12, d_model=768, vocab_size=50257,
@@ -274,20 +285,9 @@ def bench_train_step(on_tpu: bool) -> dict:
 
         return timed()
 
-    try:
-        k = 6 if on_tpu else 3
-        t_plain = (
-            steps_time(plain_step, k) - steps_time(plain_step, 1)
-        ) / (k - 1)
-        t_codec = (
-            steps_time(codec_step, k) - steps_time(codec_step, 1)
-        ) / (k - 1)
-    finally:
-        for key, prior in _saved_env.items():
-            if prior is None:
-                os.environ.pop(key, None)
-            else:
-                os.environ[key] = prior
+    k = 6 if on_tpu else 3
+    t_plain = (steps_time(plain_step, k) - steps_time(plain_step, 1)) / (k - 1)
+    t_codec = (steps_time(codec_step, k) - steps_time(codec_step, 1)) / (k - 1)
     overhead = (t_codec - t_plain) / t_plain * 100
     return {
         "model": "gpt2-small" if on_tpu else "gpt2-tiny",
