@@ -243,3 +243,68 @@ def test_1f1b_stash_bound():
         assert re.search(rf"\b{k}x2x{D}\b|\({k}, 2, {D}\)", jaxpr) or (
             f"{k},2,{D}" in jaxpr.replace(" ", "")
         )
+
+
+def test_1f1b_composes_with_quantized_dp(monkeypatch):
+    """PP x DP composition: 1F1B inside each dp replica, then the 4-bit
+    quantized gradient allreduce over the dp axis — the full-matrix story
+    on one mesh. Grads must equal the sequential reference averaged over
+    replicas (within the quantization envelope), bit-identical across
+    replicas (error symmetry)."""
+    from torch_cgx_tpu import config as cgx_config
+    from torch_cgx_tpu.parallel import gradient_sync
+    from torch_cgx_tpu.parallel.pipeline import pipeline_1f1b
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cgx_config.COMPRESSION_BUCKET_SIZE, "64")
+    n_stages, n_micro, dp = 4, 4, 2
+    mesh = Mesh(
+        np.asarray(jax.devices()[: n_stages * dp]).reshape(dp, n_stages),
+        ("dp", "pp"),
+    )
+    stages = _stages(n_stages, seed=21)
+    stacked = stack_stage_params(stages)
+    rng = np.random.default_rng(23)
+    # Per-replica batches differ; the dp-allreduce averages them.
+    x = jnp.asarray(rng.normal(size=(dp, n_micro, 2, D)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(dp, n_micro, 2, D)), jnp.float32)
+
+    def run(sp, mi, tg):
+        # shard_map gives (1, micro/pp, ...) per device on the dp-sharded
+        # stream; drop the dp-local leading axis.
+        loss, grads = pipeline_1f1b(
+            _stage_fn, _loss_fn, sp,
+            jnp.squeeze(mi, 0), jnp.squeeze(tg, 0),
+            axis_name="pp", n_stages=n_stages,
+        )
+        grads = gradient_sync(grads, mesh=mesh, axes=("dp",), average=True)
+        return loss, grads
+
+    loss, grads = jax.jit(
+        jax.shard_map(
+            run, mesh=mesh,
+            in_specs=(P("pp"), P("dp", "pp"), P("dp")),
+            out_specs=(P(), P("pp")),
+            check_vma=False,
+        )
+    )(stacked, x, tgt)
+
+    def seq_loss(sp, r):
+        per = []
+        for k in range(n_micro):
+            y = x[r, k]
+            for p in unstack_stage_params(sp, n_stages):
+                y = _stage_fn(p, y)
+            per.append(_loss_fn(y, tgt[r, k]))
+        return jnp.mean(jnp.stack(per))
+
+    want = jax.tree.map(
+        lambda *gs: sum(gs) / dp,
+        *[jax.grad(seq_loss)(stacked, r) for r in range(dp)],
+    )
+    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(want)):
+        a, b = np.asarray(a), np.asarray(b)
+        # 4-bit quantization envelope: a couple of quantization steps of
+        # the leaf's value range (bucket range <= leaf range).
+        unit = (b.max() - b.min() + 1e-6) / 15
+        assert np.abs(a - b).max() < 4 * unit, (np.abs(a - b).max(), unit)

@@ -123,6 +123,32 @@ def lm_loss(logits, tokens):
     return -jnp.mean(ll)
 
 
+def sp_lm_loss(logits, tokens, axis_name: str):
+    """Next-token cross entropy when the SEQUENCE dim is sharded over
+    ``axis_name`` (ring-attention training). The target of a local block's
+    last token is the *next shard's first token* — fetched with one
+    single-column ``ppermute`` — and only the global final position has no
+    target. Returns the global mean (identical to :func:`lm_loss` on the
+    unsharded sequence), replicated across the axis."""
+    ws = jax.lax.psum(1, axis_name)
+    idx = jax.lax.axis_index(axis_name)
+    # dst i receives from src i+1: the right neighbor's first column.
+    perm = [((i + 1) % ws, i) for i in range(ws)]
+    first_right = jax.lax.ppermute(tokens[:, :1], axis_name, perm)
+    tgt = jnp.concatenate([tokens[:, 1:], first_right], axis=1)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    # Mask the global last position (its "target" wrapped around the ring).
+    s_local = tokens.shape[1]
+    col = jax.lax.broadcasted_iota(jnp.int32, ll.shape, 1)
+    mask = jnp.where(
+        jnp.logical_and(idx == ws - 1, col == s_local - 1), 0.0, 1.0
+    )
+    total = jax.lax.psum(jnp.sum(ll * mask), axis_name)
+    count = jax.lax.psum(jnp.sum(mask), axis_name)
+    return -total / count
+
+
 def tp_param_spec(path: str, leaf) -> P:
     """Tensor-parallel PartitionSpec for a GPT-2 param by tree path.
 

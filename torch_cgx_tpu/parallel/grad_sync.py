@@ -88,6 +88,7 @@ def make_train_step(
     mesh,
     *,
     axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    sp_axis: Optional[str] = None,
     topology: Optional[TopologyConfig] = None,
     stochastic_seed: Optional[int] = None,
     donate: bool = True,
@@ -105,12 +106,27 @@ def make_train_step(
     Returns ``step(params, opt_state, batch, step_idx) -> (params, opt_state,
     loss)`` where ``batch`` leaves are sharded on their leading dim over
     ``axes`` and params/opt_state are replicated.
+
+    ``sp_axis``: sequence parallelism — batch leaves are additionally
+    sharded on their SECOND dim (sequence) over this axis, the per-shard
+    loss is averaged over it (use a boundary-correct loss such as
+    :func:`torch_cgx_tpu.models.gpt2.sp_lm_loss`), and gradients — partial
+    sums over sequence shards — join the quantized allreduce over
+    ``axes + (sp_axis,)``. Only a single dp axis composes with sp (the
+    reducers support at most two allreduce axes).
     """
     import inspect
 
     axes = tuple(axes)
-    ws_total = int(np.prod([mesh.shape[a] for a in axes]))
-    batch_spec = P(axes)
+    sync_axes = axes if sp_axis is None else axes + (sp_axis,)
+    if len(sync_axes) > 2:
+        raise ValueError(
+            "make_train_step: at most two gradient-sync axes (got "
+            f"{sync_axes!r}); hierarchical dp (cross x intra) cannot also "
+            "compose with sp_axis"
+        )
+    ws_total = int(np.prod([mesh.shape[a] for a in sync_axes]))
+    batch_spec = P(axes) if sp_axis is None else P(axes, sp_axis)
     wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
 
     def _step(params, opt_state, batch, step_idx):
@@ -119,7 +135,7 @@ def make_train_step(
                 jax.random.PRNGKey(stochastic_seed or 0), step_idx
             )
             # decorrelate dropout masks across data-parallel devices
-            for a in axes:
+            for a in sync_axes:
                 r = jax.random.fold_in(r, jax.lax.axis_index(a))
             loss, grads = jax.value_and_grad(loss_fn)(params, batch, r)
         else:
@@ -128,11 +144,12 @@ def make_train_step(
         if stochastic_seed is not None:
             key = jax.random.fold_in(jax.random.PRNGKey(stochastic_seed), step_idx)
         grads = gradient_sync(
-            grads, mesh=mesh, axes=axes, topology=topology, key=key, average=True
+            grads, mesh=mesh, axes=sync_axes, topology=topology, key=key,
+            average=True,
         )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        loss = jax.lax.psum(loss, axes) / ws_total
+        loss = jax.lax.psum(loss, sync_axes) / ws_total
         return params, opt_state, loss
 
     sharded = jax.shard_map(
@@ -140,6 +157,11 @@ def make_train_step(
         mesh=mesh,
         in_specs=(P(), P(), batch_spec, P()),
         out_specs=(P(), P(), P()),
+        # Only the gradient-sync (and sp) axes are manual; any other mesh
+        # axis — tp, ep — stays under GSPMD control, so tensor-parallel
+        # parameter shardings survive the step instead of being gathered
+        # to replicated by in_specs=P() (which speaks only of manual axes).
+        axis_names=set(sync_axes),
         # Replication of params is guaranteed by construction (all devices
         # decode identical reduced bytes); the static varying-axis analysis
         # cannot see through the quantized collective composition.
@@ -156,8 +178,14 @@ def replicate(tree, mesh):
     return jax.device_put(tree, sharding)
 
 
-def shard_batch(batch, mesh, axes: Sequence[str] = (mesh_mod.DP_AXIS,)):
-    """Shard batch leaves along their leading dimension over ``axes``.
+def shard_batch(
+    batch,
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    sp_axis: Optional[str] = None,
+):
+    """Shard batch leaves along their leading dimension over ``axes`` (and,
+    with ``sp_axis``, their second — sequence — dimension over that axis).
 
     Multi-host: each process passes its *local* slice and JAX assembles the
     global array (``make_array_from_process_local_data``) — no host ever
@@ -166,7 +194,8 @@ def shard_batch(batch, mesh, axes: Sequence[str] = (mesh_mod.DP_AXIS,)):
     from jax.sharding import NamedSharding
 
     axes = tuple(axes)
-    sharding = NamedSharding(mesh, P(axes))
+    spec = P(axes) if sp_axis is None else P(axes, sp_axis)
+    sharding = NamedSharding(mesh, spec)
     ws = int(np.prod([mesh.shape[a] for a in axes]))
     # Multi-host: each process contributes only its local slice, so the
     # divisibility requirement is the per-process device count along the dp
