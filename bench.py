@@ -117,8 +117,13 @@ def bench_codec(on_tpu: bool) -> dict:
     # mode (CPU fallback) where the Pallas path runs in pure Python.
     n = 128 * 1024 * 1024 if on_tpu else 1024 * 1024
     k = 4 if on_tpu else 2
-    rng = np.random.default_rng(1)
-    stack = jnp.asarray(rng.normal(size=(k, 1, n)), jnp.float32)
+    # Generate operands on-device: shipping 2 GB of host-generated data
+    # through the device transport is slow and has wedged the tunnel under
+    # load; a device-side PRNG draw moves no bytes.
+    stack = jax.jit(
+        lambda key: jax.random.normal(key, (k, 1, n), jnp.float32)
+    )(jax.random.PRNGKey(1))
+    stack.block_until_ready()
 
     def q_pallas(x):
         q = codec_pallas.quantize_batch(

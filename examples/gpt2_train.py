@@ -110,7 +110,11 @@ def main():
     init_model = GPT2(cfg)  # init outside shard_map: plain attention
 
     # Synthetic learnable stream: shifted token patterns.
-    data = (np.arange(args.seq)[None, :] + np.arange(2048)[:, None]) % args.vocab
+    # Size the synthetic corpus off the batch so any --batch works: the
+    # window below needs len(data) > batch, and len(data) - batch must not
+    # divide batch or the rotation collapses to one repeated window.
+    n_rows = args.batch + 2048
+    data = (np.arange(args.seq)[None, :] + np.arange(n_rows)[:, None]) % args.vocab
     data = data.astype(np.int32)
 
     tokens0 = jnp.asarray(data[: max(2, args.batch)])

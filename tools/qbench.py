@@ -1,0 +1,234 @@
+"""Quantize-kernel experiment harness (single chip).
+
+Measures one variant per invocation (keeps device-service load small and
+output incremental):
+
+    python tools/qbench.py current        # public quantize_batch fast path
+    python tools/qbench.py current --tc 32
+    python tools/qbench.py butterfly      # log-tree OR pack experiment
+    python tools/qbench.py mul            # reciprocal-multiply encode
+    python tools/qbench.py nometa         # payload-only store (bound)
+    python tools/qbench.py read           # HBM read floor (max-reduce only)
+    python tools/qbench.py dequant        # public dequantize_batch
+
+All operands are generated on-device (host->device transfer of benchmark
+payloads has wedged the device transport under load before) and sized to
+128 MB by default. Timing is the same scan-slope method as bench.py.
+Experimental kernels are byte-checked against the XLA codec oracle on a
+small slice before timing — a variant that changes the wire is reported,
+not silently timed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
+
+CB = 32  # chunk buckets (codec.CHUNK_BUCKETS)
+
+
+def scan_time(fn, stack, iters: int = 6) -> float:
+    def runner(s):
+        def body(c, x):
+            out = fn(x)
+            leaf = jax.tree.leaves(out)[0]
+            return c + leaf.ravel()[0].astype(jnp.float32), 0
+
+        return lax.scan(body, jnp.float32(0), s)[0]
+
+    jr = jax.jit(runner)
+
+    def timed(s):
+        np.asarray(jr(s))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            o = jr(s)
+        np.asarray(o)
+        return (time.perf_counter() - t0) / iters
+
+    k = jax.tree.leaves(stack)[0].shape[0]
+    t_k = timed(stack)
+    t_1 = timed(jax.tree.map(lambda a: a[:1], stack))
+    return max((t_k - t_1) / (k - 1), 1e-9)
+
+
+def make_variant_kernel(name: str, bits: int, b: int, tc: int):
+    """Experimental flat-quantize kernels. Same wire contract as
+    codec_pallas._quantize_flat_impl (words (C*bits*rb, 128) i32,
+    meta (C*32, 2) f32)."""
+    rb = b // 128
+    maxlvl = np.float32((1 << bits) - 1)
+
+    def meta_of(x4):
+        bmax = jnp.max(jnp.max(x4, axis=3, keepdims=True), axis=2, keepdims=True)
+        bmin = jnp.min(jnp.min(x4, axis=3, keepdims=True), axis=2, keepdims=True)
+        unit = (bmax - bmin) * np.float32(1.0 / maxlvl)
+        safe = jnp.where(unit > 0, unit, np.float32(1.0))
+        return unit, bmin, safe
+
+    def pack_sum(lvl):
+        sub = lax.broadcasted_iota(jnp.int32, (tc, CB, rb, 128), 1)
+        planes = [jnp.sum(((lvl >> w) & 1) << sub, axis=1) for w in range(bits)]
+        return jnp.stack(planes, axis=1).reshape(tc * bits * rb, 128)
+
+    def pack_butterfly(lvl):
+        planes = []
+        for w in range(bits):
+            a = (lvl >> w) & 1  # (tc, 32, rb, 128)
+            sh = 16
+            while sh >= 1:
+                a = a[:, :sh] | (a[:, sh : 2 * sh] << sh)
+                sh //= 2
+            planes.append(a.reshape(tc, rb, 128))
+        return jnp.stack(planes, axis=1).reshape(tc * bits * rb, 128)
+
+    def kernel(x_ref, w_ref, m_ref):
+        x4 = x_ref[:].astype(jnp.float32).reshape(tc, CB, rb, 128)
+        unit, bmin, safe = meta_of(x4)
+        if name == "read":
+            w_ref[:] = jnp.broadcast_to(
+                unit.astype(jnp.int32).reshape(tc, 1, 1, 1),
+                (tc, bits, rb, 128),
+            ).reshape(tc * bits * rb, 128)
+            m_ref[:] = jnp.concatenate(
+                [unit.reshape(tc * CB, 1), bmin.reshape(tc * CB, 1)], axis=1
+            )
+            return
+        if name == "mul":
+            lvl = jnp.clip(
+                jnp.floor((x4 - bmin) * (np.float32(1.0) / safe) + np.float32(0.5)),
+                0,
+                maxlvl,
+            ).astype(jnp.int32)
+        else:
+            lvl = jnp.clip(
+                jnp.floor((x4 - bmin) / safe + np.float32(0.5)), 0, maxlvl
+            ).astype(jnp.int32)
+        packed = pack_butterfly(lvl) if name == "butterfly" else pack_sum(lvl)
+        w_ref[:] = packed
+        if name != "nometa":
+            m_ref[:] = jnp.concatenate(
+                [unit.reshape(tc * CB, 1), bmin.reshape(tc * CB, 1)], axis=1
+            )
+        else:
+            m_ref[:] = jnp.zeros((tc * CB, 2), jnp.float32)
+
+    return kernel
+
+
+def run_variant_kernel(name, xs, bits, b, tc):
+    rows, m = xs.shape
+    rb = b // 128
+    n_chunks = rows * m // (CB * b)
+    kernel = make_variant_kernel(name, bits, b, tc)
+    f = pl.pallas_call(
+        kernel,
+        grid=(n_chunks // tc,),
+        in_specs=[
+            pl.BlockSpec((tc * CB * rb, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        ],
+        out_specs=[
+            pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((tc * CB, 2), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_chunks * bits * rb, 128), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks * CB, 2), jnp.float32),
+        ],
+    )
+    return jax.jit(lambda x: f(x.reshape(-1, 128)))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("variant", choices=[
+        "current", "butterfly", "mul", "nometa", "read", "dequant",
+    ])
+    ap.add_argument("--tc", type=int, default=0, help="tile chunks override")
+    ap.add_argument("--mb", type=int, default=128, help="payload MB (fp32)")
+    ap.add_argument("--bits", type=int, default=4)
+    ap.add_argument("--bucket", type=int, default=512)
+    ap.add_argument("--k", type=int, default=3, help="scan slots")
+    args = ap.parse_args()
+
+    import os
+
+    if args.tc:
+        os.environ["CGX_PALLAS_TILE_CHUNKS"] = str(args.tc)
+
+    from torch_cgx_tpu.ops import codec, codec_pallas
+
+    n = args.mb * 1024 * 1024 // 4
+    bits, b = args.bits, args.bucket
+    k = args.k
+    stack = jax.jit(
+        lambda key: jax.random.normal(key, (k, 1, n), jnp.float32)
+    )(jax.random.PRNGKey(1))
+    stack.block_until_ready()
+    gb = n * 4 / 1e9
+    tc = args.tc or codec_pallas._pipe_tc(n // (CB * b), b)
+
+    if args.variant in ("current", "dequant"):
+        if args.variant == "current":
+            fn = lambda x: (  # noqa: E731
+                lambda q: (q.packed, q.meta)
+            )(codec_pallas.quantize_batch(x, bits, b))
+            t = scan_time(fn, stack)
+        else:
+            qts = [codec_pallas.quantize_batch(stack[i], bits, b) for i in range(k)]
+            q_stack = jax.tree.map(
+                lambda *xs: jnp.stack(xs) if isinstance(xs[0], jax.Array) else xs[0],
+                *qts,
+            )
+            t = scan_time(
+                lambda q: codec_pallas.dequantize_batch(q, out_dtype=jnp.float32),
+                q_stack,
+            )
+    else:
+        # byte-identity check on a small slice (except bound variants)
+        if args.variant in ("butterfly", "mul"):
+            ns = CB * b * 2 * tc
+            xsmall = stack[0][:, :ns]
+            f_small = run_variant_kernel(args.variant, xsmall, bits, b, tc)
+            words, meta = f_small(xsmall)
+            ref = codec_pallas.quantize_batch(xsmall, bits, b)
+            ref_words = jax.lax.bitcast_convert_type(
+                ref.packed.reshape(-1, 128), jnp.int32
+            )
+            w_ok = bool(jnp.array_equal(words, ref_words))
+            m_ok = bool(
+                jnp.allclose(meta.reshape(ref.meta.shape), ref.meta.astype(jnp.float32))
+            )
+            if args.variant == "mul":
+                # reciprocal-multiply may legitimately differ in the last ulp;
+                # report mismatch rate instead of failing
+                mism = float(jnp.mean((words != ref_words).astype(jnp.float32)))
+                print(f"byte_check: words_equal={w_ok} mismatch_frac={mism:.2e} meta={m_ok}")
+            else:
+                assert w_ok and m_ok, f"wire mismatch: words={w_ok} meta={m_ok}"
+                print("byte_check: ok")
+        f = run_variant_kernel(args.variant, stack[0], bits, b, tc)
+        t = scan_time(f, stack)
+
+    print(
+        f"variant={args.variant} tc={tc} mb={args.mb} bits={bits} bucket={b} "
+        f"t={t * 1e3:.3f} ms  {gb / t:.1f} GB/s(in)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -107,8 +107,10 @@ def make_train_step(
     loss)`` where ``batch`` leaves are sharded on their leading dim over
     ``axes`` and params/opt_state are replicated.
 
-    ``sp_axis``: sequence parallelism — batch leaves are additionally
-    sharded on their SECOND dim (sequence) over this axis, the per-shard
+    ``sp_axis``: sequence parallelism — batch leaves of rank >= 2 are
+    additionally sharded on their SECOND dim (sequence) over this axis
+    (rank-1 leaves such as sample weights have no sequence dim and stay
+    replicated over sp), the per-shard
     loss is averaged over it (use a boundary-correct loss such as
     :func:`torch_cgx_tpu.models.gpt2.sp_lm_loss`), and gradients — partial
     sums over sequence shards — join the quantized allreduce over
@@ -126,8 +128,15 @@ def make_train_step(
             "compose with sp_axis"
         )
     ws_total = int(np.prod([mesh.shape[a] for a in sync_axes]))
-    batch_spec = P(axes) if sp_axis is None else P(axes, sp_axis)
     wants_rng = len(inspect.signature(loss_fn).parameters) >= 3
+
+    def _batch_leaf_spec(leaf) -> P:
+        # sp shards the SECOND (sequence) dim, which rank-1 leaves (sample
+        # weights, per-sequence labels) don't have — they stay replicated
+        # over sp and shard only over the dp axes.
+        if sp_axis is not None and getattr(leaf, "ndim", 0) >= 2:
+            return P(axes, sp_axis)
+        return P(axes)
 
     def _step(params, opt_state, batch, step_idx):
         if wants_rng:
@@ -152,22 +161,44 @@ def make_train_step(
         loss = jax.lax.psum(loss, sync_axes) / ws_total
         return params, opt_state, loss
 
-    sharded = jax.shard_map(
-        _step,
-        mesh=mesh,
-        in_specs=(P(), P(), batch_spec, P()),
-        out_specs=(P(), P(), P()),
-        # Only the gradient-sync (and sp) axes are manual; any other mesh
-        # axis — tp, ep — stays under GSPMD control, so tensor-parallel
-        # parameter shardings survive the step instead of being gathered
-        # to replicated by in_specs=P() (which speaks only of manual axes).
-        axis_names=set(sync_axes),
-        # Replication of params is guaranteed by construction (all devices
-        # decode identical reduced bytes); the static varying-axis analysis
-        # cannot see through the quantized collective composition.
-        check_vma=False,
-    )
-    return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    # The batch in_specs depend on per-leaf rank (rank-1 leaves can't carry
+    # the sp dim), so the shard_map is built per batch tree-structure and
+    # cached — jit retraces on structure change anyway.
+    built = {}
+
+    def _build(batch):
+        leaves, treedef = jax.tree_util.tree_flatten(batch)
+        cache_key = (treedef, tuple(getattr(l, "ndim", 0) for l in leaves))
+        fn = built.get(cache_key)
+        if fn is None:
+            batch_spec = jax.tree_util.tree_unflatten(
+                treedef, [_batch_leaf_spec(l) for l in leaves]
+            )
+            sharded = jax.shard_map(
+                _step,
+                mesh=mesh,
+                in_specs=(P(), P(), batch_spec, P()),
+                out_specs=(P(), P(), P()),
+                # Only the gradient-sync (and sp) axes are manual; any other
+                # mesh axis — tp, ep — stays under GSPMD control, so
+                # tensor-parallel parameter shardings survive the step
+                # instead of being gathered to replicated by in_specs=P()
+                # (which speaks only of manual axes).
+                axis_names=set(sync_axes),
+                # Replication of params is guaranteed by construction (all
+                # devices decode identical reduced bytes); the static
+                # varying-axis analysis cannot see through the quantized
+                # collective composition.
+                check_vma=False,
+            )
+            fn = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+            built[cache_key] = fn
+        return fn
+
+    def step(params, opt_state, batch, step_idx):
+        return _build(batch)(params, opt_state, batch, step_idx)
+
+    return step
 
 
 def replicate(tree, mesh):
@@ -185,7 +216,9 @@ def shard_batch(
     sp_axis: Optional[str] = None,
 ):
     """Shard batch leaves along their leading dimension over ``axes`` (and,
-    with ``sp_axis``, their second — sequence — dimension over that axis).
+    with ``sp_axis``, the second — sequence — dimension of rank >= 2 leaves
+    over that axis; rank-1 leaves have no sequence dim and replicate over
+    sp).
 
     Multi-host: each process passes its *local* slice and JAX assembles the
     global array (``make_array_from_process_local_data``) — no host ever
@@ -194,8 +227,6 @@ def shard_batch(
     from jax.sharding import NamedSharding
 
     axes = tuple(axes)
-    spec = P(axes) if sp_axis is None else P(axes, sp_axis)
-    sharding = NamedSharding(mesh, spec)
     ws = int(np.prod([mesh.shape[a] for a in axes]))
     # Multi-host: each process contributes only its local slice, so the
     # divisibility requirement is the per-process device count along the dp
@@ -211,6 +242,10 @@ def shard_batch(
                 f"{ws}, {procs} processes; drop or pad the remainder "
                 "batch; see data.iterate_batches(drop_remainder=True))"
             )
+        # Rank-1 leaves (sample weights, per-sequence labels) have no
+        # sequence dim — they shard over dp only and replicate over sp.
+        sp = sp_axis if getattr(x, "ndim", 0) >= 2 else None
+        sharding = NamedSharding(mesh, P(axes) if sp is None else P(axes, sp))
         if jax.process_count() > 1:
             return jax.make_array_from_process_local_data(
                 sharding, np.asarray(x)
