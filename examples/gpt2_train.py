@@ -112,8 +112,10 @@ def main():
     # Synthetic learnable stream: shifted token patterns.
     # Size the synthetic corpus off the batch so any --batch works: the
     # window below needs len(data) > batch, and len(data) - batch must not
-    # divide batch or the rotation collapses to one repeated window.
-    n_rows = args.batch + 2048
+    # divide batch or the rotation collapses to one repeated window
+    # (2048 and 2049 are coprime, so one of them never divides batch).
+    window = 2048 if args.batch % 2048 else 2049
+    n_rows = args.batch + window
     data = (np.arange(args.seq)[None, :] + np.arange(n_rows)[:, None]) % args.vocab
     data = data.astype(np.int32)
 

@@ -308,3 +308,108 @@ def test_1f1b_composes_with_quantized_dp(monkeypatch):
         # the leaf's value range (bucket range <= leaf range).
         unit = (b.max() - b.min() + 1e-6) / 15
         assert np.abs(a - b).max() < 4 * unit, (np.abs(a - b).max(), unit)
+
+
+# ---------------------------------------------------------------------------
+# Interleaved virtual-stage schedule (bubble / V).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n_virtual,n_micro", [(2, 4), (2, 8), (3, 4)])
+def test_interleaved_matches_sequential(n_virtual, n_micro):
+    from torch_cgx_tpu.parallel.pipeline import (
+        spmd_pipeline_interleaved,
+        stack_interleaved_params,
+    )
+
+    n_stages = 4
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    chunks = _stages(n_stages * n_virtual, seed=5)
+    stacked = stack_interleaved_params(chunks, n_stages, n_virtual)
+    x = jnp.asarray(np.random.default_rng(6).normal(size=(16, D)), jnp.float32)
+
+    def run(stacked_local, xfull):
+        micro = split_microbatches(xfull, n_micro)
+        out = spmd_pipeline_interleaved(
+            _stage_fn, stacked_local, micro, axis_name="pp",
+            n_stages=n_stages, n_virtual=n_virtual,
+        )
+        return merge_microbatches(out)
+
+    got = jax.jit(
+        jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
+                      out_specs=P(), check_vma=False)
+    )(stacked, x)
+    want = _sequential(chunks, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_interleaved_grads_match_sequential():
+    from torch_cgx_tpu.parallel.pipeline import (
+        spmd_pipeline_interleaved,
+        stack_interleaved_params,
+    )
+
+    n_stages, n_virtual, n_micro = 2, 2, 4
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    chunks = _stages(n_stages * n_virtual, seed=7)
+    stacked = stack_interleaved_params(chunks, n_stages, n_virtual)
+    x = jnp.asarray(np.random.default_rng(8).normal(size=(8, D)), jnp.float32)
+
+    def pipe_loss(stacked_p):
+        def run(stacked_local, xfull):
+            micro = split_microbatches(xfull, n_micro)
+            out = spmd_pipeline_interleaved(
+                _stage_fn, stacked_local, micro, axis_name="pp",
+                n_stages=n_stages, n_virtual=n_virtual,
+            )
+            return jnp.sum(merge_microbatches(out) ** 2)
+
+        return jax.shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P()),
+            out_specs=P(), check_vma=False,
+        )(stacked_p, x)
+
+    def seq_loss(stacked_p):
+        # invert the interleaved permutation: stacked row s*V + v is chunk
+        # v*S + s
+        rows = {}
+        for s in range(n_stages):
+            for v in range(n_virtual):
+                rows[v * n_stages + s] = s * n_virtual + v
+        ordered = [
+            jax.tree.map(lambda x_, r=rows[j]: x_[r], stacked_p)
+            for j in range(n_stages * n_virtual)
+        ]
+        return jnp.sum(_sequential(ordered, x) ** 2)
+
+    gp = jax.jit(jax.grad(pipe_loss))(stacked)
+    gs = jax.grad(seq_loss)(stacked)
+    for a, b in zip(jax.tree.leaves(gp), jax.tree.leaves(gs)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_interleaved_rejects_ragged_microbatches():
+    from torch_cgx_tpu.parallel.pipeline import spmd_pipeline_interleaved
+
+    n_stages = 4
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    chunks = _stages(n_stages * 2)
+    from torch_cgx_tpu.parallel.pipeline import stack_interleaved_params
+
+    stacked = stack_interleaved_params(chunks, n_stages, 2)
+    x = jnp.ones((6, 2, D), jnp.float32)  # 6 % 4 != 0
+
+    def run(stacked_local, micro):
+        return spmd_pipeline_interleaved(
+            _stage_fn, stacked_local, micro, axis_name="pp",
+            n_stages=n_stages, n_virtual=2,
+        )
+
+    with pytest.raises(AssertionError, match="microbatches % n_stages"):
+        jax.jit(
+            jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
+                          out_specs=P(), check_vma=False)
+        )(stacked, x)

@@ -22,8 +22,8 @@ not silently timed.
 from __future__ import annotations
 
 import argparse
-import functools
-import time
+import sys
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
@@ -32,35 +32,13 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+from bench import scan_time  # noqa: E402 — single source of timing truth
+
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
 
 CB = 32  # chunk buckets (codec.CHUNK_BUCKETS)
-
-
-def scan_time(fn, stack, iters: int = 6) -> float:
-    def runner(s):
-        def body(c, x):
-            out = fn(x)
-            leaf = jax.tree.leaves(out)[0]
-            return c + leaf.ravel()[0].astype(jnp.float32), 0
-
-        return lax.scan(body, jnp.float32(0), s)[0]
-
-    jr = jax.jit(runner)
-
-    def timed(s):
-        np.asarray(jr(s))
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            o = jr(s)
-        np.asarray(o)
-        return (time.perf_counter() - t0) / iters
-
-    k = jax.tree.leaves(stack)[0].shape[0]
-    t_k = timed(stack)
-    t_1 = timed(jax.tree.map(lambda a: a[:1], stack))
-    return max((t_k - t_1) / (k - 1), 1e-9)
 
 
 def make_variant_kernel(name: str, bits: int, b: int, tc: int):
@@ -162,8 +140,10 @@ def main():
     ap.add_argument("--mb", type=int, default=128, help="payload MB (fp32)")
     ap.add_argument("--bits", type=int, default=4)
     ap.add_argument("--bucket", type=int, default=512)
-    ap.add_argument("--k", type=int, default=3, help="scan slots")
+    ap.add_argument("--k", type=int, default=3, help="scan slots (>= 2)")
     args = ap.parse_args()
+    if args.k < 2:
+        ap.error("--k must be >= 2 (slope timing needs two scan lengths)")
 
     import os
 

@@ -110,6 +110,117 @@ def spmd_pipeline(
     return outputs
 
 
+def stack_interleaved_params(chunk_params: Sequence, n_stages: int,
+                             n_virtual: int):
+    """Stack D = n_virtual * n_stages chunk pytrees for
+    :func:`spmd_pipeline_interleaved`: global chunk ``v * S + s`` lands at
+    stacked row ``s * V + v``, so a contiguous ``P('pp')`` shard hands
+    device ``s`` exactly its round-robin chunks ``{s, S+s, 2S+s, ...}``
+    (local row v = virtual index v)."""
+    s_count, v_count = n_stages, n_virtual
+    assert len(chunk_params) == s_count * v_count, (
+        len(chunk_params), s_count, v_count)
+    order = [
+        v * s_count + s for s in range(s_count) for v in range(v_count)
+    ]
+    return jax.tree.map(lambda *xs: jnp.stack(xs),
+                        *[chunk_params[j] for j in order])
+
+
+def spmd_pipeline_interleaved(
+    stage_fn: Callable,
+    local_params,
+    microbatches: jax.Array,
+    *,
+    axis_name: str = "pp",
+    n_stages: int,
+    n_virtual: int,
+):
+    """Interleaved virtual-stage pipeline (Megatron-LM style) inside
+    ``shard_map``: each device holds ``n_virtual`` model chunks assigned
+    round-robin (device s owns global chunks ``v*S + s``), so the pipeline
+    fill costs S ticks instead of the V*S a GPipe schedule of the same
+    depth pays — the bubble fraction drops from ``(VS-1)/(VM+VS-1)`` to
+    ``(S-1)/(VM+S-1)``, a ~V-fold reduction for M >> S.
+
+    Schedule: microbatches run in groups of S. Microbatch k executes chunk
+    c at tick ``(k//S)*V*S + c*S + s + (k%S)`` on device ``s = (c*S+s)%S``;
+    every inter-chunk hop is the same right-rotation ``lax.ppermute`` (the
+    S-1 -> 0 wraparound carries the payload from chunk c on the last device
+    to chunk c+1 on device 0), so each device computes exactly one
+    (microbatch, chunk) per tick and the single recv slot suffices. At tick
+    t device s recovers its work item from ``r = t - s``: ``q = r mod VS``
+    decomposes uniquely as ``q = c*S + (k mod S)`` and
+    ``k = (r//VS)*S + (k mod S)``.
+
+    Backward comes from AD through the scan (like :func:`spmd_pipeline`,
+    whose carrier/stream contracts this shares: stage in/out shapes equal,
+    microbatch stream replicated over pp, M % S == 0). Activation memory is
+    therefore O(V*M) per device — use :func:`pipeline_1f1b` when memory,
+    not bubble, binds.
+
+    ``local_params``: this device's ``(V, ...)`` slice of
+    :func:`stack_interleaved_params` output (shard over pp). Returns the
+    (M, ...) outputs of the final chunk, replicated over pp.
+    """
+    s_count, v_count = n_stages, n_virtual
+    m = microbatches.shape[0]
+    assert m % s_count == 0, (
+        f"interleaved schedule needs microbatches % n_stages == 0, got "
+        f"{m} % {s_count}")
+    p_rows = jax.tree.leaves(local_params)[0].shape[0]
+    assert p_rows == v_count, (
+        f"local_params leading axis is {p_rows}, expected n_virtual="
+        f"{v_count}: pass this device's pp shard of "
+        "stack_interleaved_params (in_specs=P('pp')), not the full stack")
+    stage = lax.axis_index(axis_name)
+    vs = v_count * s_count
+    ticks = v_count * m + s_count - 1
+    zero = jnp.zeros_like(microbatches[0])
+    right = [(i, (i + 1) % s_count) for i in range(s_count)]
+
+    def tick(carry, t):
+        recv, outputs = carry
+        r = t - stage
+        q = jnp.remainder(r, vs)
+        c = q // s_count
+        u = q % s_count
+        k = jnp.maximum(r, 0) // vs * s_count + u
+        active = jnp.logical_and(r >= 0, k < m)
+
+        # chunk c's params: dynamic slice on the local virtual axis
+        p_c = jax.tree.map(
+            lambda x: lax.dynamic_index_in_dim(x, c, 0, keepdims=False),
+            local_params,
+        )
+        inject = microbatches[jnp.minimum(k, m - 1)]
+        x = jnp.where(jnp.logical_and(stage == 0, c == 0), inject, recv)
+        y = stage_fn(p_c, x)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+
+        is_final = jnp.logical_and(
+            jnp.logical_and(stage == s_count - 1, c == v_count - 1), active
+        )
+        outputs = lax.cond(
+            is_final,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.minimum(k, m - 1), 0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        recv = lax.ppermute(y, axis_name, right)
+        return (recv, outputs), None
+
+    outputs0 = jnp.zeros((m,) + zero.shape, zero.dtype)
+    (_, outputs), _ = lax.scan(tick, (zero, outputs0), jnp.arange(ticks))
+    outputs = lax.psum(
+        jnp.where(stage == s_count - 1, outputs, jnp.zeros_like(outputs)),
+        axis_name,
+    )
+    return outputs
+
+
 def live_stash_microbatches(n_stages: int) -> int:
     """Per-stage activation-stash bound of the 1F1B schedule: microbatch k's
     input is stashed at its forward tick (k + s) and freed at its backward
