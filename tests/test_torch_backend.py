@@ -11,6 +11,7 @@ processes rendezvousing over a file store (no MPI on TPU hosts).
 
 from __future__ import annotations
 
+import atexit
 import multiprocessing as mp
 import os
 import sys
@@ -22,55 +23,140 @@ import pytest
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _bootstrap(rank: int, ws: int, initfile: str, target_name: str, q):
-    """Child entry: pin JAX to CPU before any import, init the group, run."""
-    try:
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS", None)
-        sys.path.insert(0, _REPO)
-        import torch.distributed as dist
-        import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+def _pool_worker(rank: int, ws: int, task_q, result_q) -> None:
+    """Persistent rank process: imports once, then runs one worker body per
+    task with a fresh process group (the reference's setUp/tearDown cycle,
+    test_cgx.py:53-67) — spawning + torch import per test was ~80% of the
+    suite's wall time."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS", None)
+    sys.path.insert(0, _REPO)
+    import torch.distributed as dist
+    import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+    from torch_cgx_tpu import config as cgx_config
 
-        dist.init_process_group(
-            "cgx", init_method=f"file://{initfile}", rank=rank, world_size=ws
-        )
-        target = globals()[target_name]
-        target(rank, ws)
-        dist.barrier()
-        dist.destroy_process_group()
-        q.put((rank, None))
-    except Exception:
-        q.put((rank, traceback.format_exc()))
-        raise
+    while True:
+        item = task_q.get()
+        if item is None:
+            return
+        target_name, initfile = item
+        env_before = {
+            k: v for k, v in os.environ.items() if k.startswith("CGX_")
+        }
+        try:
+            cgx_config.clear_registry()
+            dist.init_process_group(
+                "cgx", init_method=f"file://{initfile}", rank=rank,
+                world_size=ws,
+            )
+            globals()[target_name](rank, ws)
+            dist.barrier()
+            result_q.put((rank, None))
+        except Exception:
+            result_q.put((rank, traceback.format_exc()))
+        finally:
+            try:
+                dist.destroy_process_group()
+            except Exception:
+                pass
+            for k in [k for k in os.environ if k.startswith("CGX_")]:
+                if k not in env_before:
+                    os.environ.pop(k)
+            os.environ.update(env_before)
+
+
+class _RankPool:
+    def __init__(self, ws: int):
+        self.ws = ws
+        ctx = mp.get_context("spawn")
+        self.task_qs = [ctx.Queue() for _ in range(ws)]
+        self.result_q = ctx.Queue()
+        self.procs = [
+            ctx.Process(
+                target=_pool_worker,
+                args=(r, ws, self.task_qs[r], self.result_q),
+                daemon=True,
+            )
+            for r in range(ws)
+        ]
+        for p in self.procs:
+            p.start()
+
+    def alive(self) -> bool:
+        return all(p.is_alive() for p in self.procs)
+
+    def run(self, target_name: str, timeout: float):
+        import time as _time
+
+        initfile = tempfile.mktemp(prefix="cgx_test_store_")
+        for q in self.task_qs:
+            q.put((target_name, initfile))
+        errors = []
+        timed_out = False
+        deadline = _time.monotonic() + timeout
+        got = 0
+        while got < self.ws:
+            try:
+                rank, err = self.result_q.get(timeout=2.0)
+            except Exception:
+                if not self.alive():
+                    dead = [
+                        r for r, p in enumerate(self.procs) if not p.is_alive()
+                    ]
+                    errors.append(f"rank(s) {dead} died without a result")
+                    timed_out = True
+                    break
+                if _time.monotonic() >= deadline:
+                    errors.append(
+                        "timeout waiting for a rank (possible deadlock)"
+                    )
+                    timed_out = True
+                    break
+                continue
+            got += 1
+            if err is not None:
+                errors.append(f"rank {rank}:\n{err}")
+        if os.path.exists(initfile):
+            os.unlink(initfile)
+        return errors, timed_out
+
+    def shutdown(self) -> None:
+        for q in self.task_qs:
+            try:
+                q.put(None)
+            except Exception:
+                pass
+        for p in self.procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+
+
+_POOLS: dict = {}
+
+
+def _shutdown_pools() -> None:
+    for pool in _POOLS.values():
+        pool.shutdown()
+    _POOLS.clear()
+
+
+atexit.register(_shutdown_pools)
 
 
 def _launch(target, ws: int, timeout: float = 240.0) -> None:
-    initfile = tempfile.mktemp(prefix="cgx_test_store_")
-    ctx = mp.get_context("spawn")
-    q = ctx.Queue()
-    procs = [
-        ctx.Process(
-            target=_bootstrap, args=(r, ws, initfile, target.__name__, q)
-        )
-        for r in range(ws)
-    ]
-    for p in procs:
-        p.start()
-    errors = []
-    for _ in range(ws):
-        try:
-            rank, err = q.get(timeout=timeout)
-        except Exception:
-            errors.append("timeout waiting for a rank (possible deadlock)")
-            break
-        if err is not None:
-            errors.append(f"rank {rank}:\n{err}")
-    for p in procs:
-        p.join(timeout=30)
-        if p.is_alive():
-            p.terminate()
-    if os.path.exists(initfile):
-        os.unlink(initfile)
+    pool = _POOLS.get(ws)
+    if pool is None or not pool.alive():
+        if pool is not None:
+            pool.shutdown()
+        pool = _RankPool(ws)
+        _POOLS[ws] = pool
+    errors, timed_out = pool.run(target.__name__, timeout)
+    if timed_out or not pool.alive():
+        # A hung or dead rank poisons the pool — tear it down so the next
+        # test gets a fresh one.
+        pool.shutdown()
+        _POOLS.pop(ws, None)
     assert not errors, "\n".join(errors)
 
 
