@@ -357,9 +357,36 @@ def bench_allreduce(devices) -> dict:
     }
 
 
+def _device_watchdog(seconds: float = 300.0):
+    """Backend init can hang indefinitely when the device transport is
+    wedged (observed: a dead client's claim blocking the service). Emit a
+    diagnosable JSON line and exit instead of hanging the driver."""
+    import threading
+
+    done = threading.Event()
+
+    def fire():
+        if done.wait(seconds):
+            return
+        print(json.dumps({
+            "metric": "device_init_failure",
+            "value": 0,
+            "unit": "none",
+            "vs_baseline": 0,
+            "detail": {"error": f"jax.devices() not ready in {seconds:.0f}s "
+                                "(device transport unreachable?)"},
+        }), flush=True)
+        os._exit(2)
+
+    threading.Thread(target=fire, daemon=True).start()
+    return done
+
+
 def main() -> None:
     _preflight_lint()
+    ready = _device_watchdog()
     devices = jax.devices()
+    ready.set()
     if len(devices) > 1:
         result = bench_allreduce(devices)
     else:

@@ -103,3 +103,27 @@ def test_init_distributed_single_host_noop(monkeypatch):
               "MEGASCALE_COORDINATOR_ADDRESS"):
         monkeypatch.delenv(k, raising=False)
     assert init_distributed() is False
+
+
+def test_profile_capture(tmp_path, monkeypatch):
+    """CGX_TRACE_DIR gates jax.profiler capture; unset/empty -> no-op."""
+    import jax.numpy as jnp
+
+    from torch_cgx_tpu.utils import profile_capture
+
+    # Unset and empty both take the no-op branch (and never touch an
+    # ambient trace dir); the profiler must not be left active.
+    for off in (None, ""):
+        if off is None:
+            monkeypatch.delenv("CGX_TRACE_DIR", raising=False)
+        else:
+            monkeypatch.setenv("CGX_TRACE_DIR", off)
+        with profile_capture("a"):
+            jnp.ones((4,)).block_until_ready()
+    assert not any(tmp_path.iterdir()), "no-op branch wrote artifacts"
+
+    monkeypatch.setenv("CGX_TRACE_DIR", str(tmp_path))
+    with profile_capture("b"):
+        jnp.ones((4,)).block_until_ready()
+    out = tmp_path / "b"
+    assert out.exists() and any(out.rglob("*")), "no profile artifacts"

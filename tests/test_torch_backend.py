@@ -94,16 +94,31 @@ class _RankPool:
         errors = []
         timed_out = False
         deadline = _time.monotonic() + timeout
-        got = 0
-        while got < self.ws:
+        received: set = set()
+
+        def take(rank, err):
+            received.add(rank)
+            if err is not None:
+                errors.append(f"rank {rank}:\n{err}")
+
+        while len(received) < self.ws:
             try:
-                rank, err = self.result_q.get(timeout=2.0)
+                take(*self.result_q.get(timeout=2.0))
             except Exception:
                 if not self.alive():
+                    # Drain results that arrived concurrently with the death
+                    # so surviving ranks' tracebacks aren't discarded.
+                    while True:
+                        try:
+                            take(*self.result_q.get_nowait())
+                        except Exception:
+                            break
                     dead = [
-                        r for r, p in enumerate(self.procs) if not p.is_alive()
+                        r for r, p in enumerate(self.procs)
+                        if not p.is_alive() and r not in received
                     ]
-                    errors.append(f"rank(s) {dead} died without a result")
+                    if dead:
+                        errors.append(f"rank(s) {dead} died without a result")
                     timed_out = True
                     break
                 if _time.monotonic() >= deadline:
@@ -112,10 +127,6 @@ class _RankPool:
                     )
                     timed_out = True
                     break
-                continue
-            got += 1
-            if err is not None:
-                errors.append(f"rank {rank}:\n{err}")
         if os.path.exists(initfile):
             os.unlink(initfile)
         return errors, timed_out

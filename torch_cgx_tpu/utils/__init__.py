@@ -1,6 +1,6 @@
 from . import env
 from .logging import get_logger, metrics
-from .tracing import named_scope, trace_span
+from .tracing import named_scope, profile_capture, trace_span
 from .tree import leaf_paths, path_str, round_up, tree_size_bytes
 
 __all__ = [
@@ -8,6 +8,7 @@ __all__ = [
     "get_logger",
     "metrics",
     "named_scope",
+    "profile_capture",
     "trace_span",
     "leaf_paths",
     "path_str",

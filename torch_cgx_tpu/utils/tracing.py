@@ -33,3 +33,25 @@ def named_scope(name: str):
     """Annotation for traced (jitted) code regions — shows up in the XLA HLO
     and device profile."""
     return jax.named_scope(name)
+
+
+@contextlib.contextmanager
+def profile_capture(subdir: str = "cgx"):
+    """Write a device profile (Perfetto/XPlane, viewable in TensorBoard or
+    ui.perfetto.dev) for the enclosed region when ``CGX_TRACE_DIR`` is set;
+    a no-op otherwise. Wrap a few training steps:
+
+        with profile_capture("step100"):
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, batch, i)
+            jax.block_until_ready(params)
+    """
+    import os
+
+    base = os.environ.get("CGX_TRACE_DIR")
+    if not base:
+        yield
+        return
+    path = os.path.join(base, subdir)
+    with jax.profiler.trace(path):
+        yield
