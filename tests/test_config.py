@@ -112,7 +112,9 @@ def test_profile_capture(tmp_path, monkeypatch):
     from torch_cgx_tpu.utils import profile_capture
 
     # Unset and empty both take the no-op branch (and never touch an
-    # ambient trace dir); the profiler must not be left active.
+    # ambient trace dir); run from tmp_path so a regression that writes
+    # relative to cwd is caught by the emptiness assert below.
+    monkeypatch.chdir(tmp_path)
     for off in (None, ""):
         if off is None:
             monkeypatch.delenv("CGX_TRACE_DIR", raising=False)

@@ -413,3 +413,131 @@ def test_interleaved_rejects_ragged_microbatches():
             jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
                           out_specs=P(), check_vma=False)
         )(stacked, x)
+
+
+def test_pipeline_compressed_hops():
+    """8-bit quantized activation hops: outputs track the uncompressed
+    pipeline closely and gradients still flow (STE backward)."""
+    from torch_cgx_tpu.config import CompressionConfig
+
+    n_stages, n_micro = 4, 8
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    stages = _stages(n_stages, seed=9)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.default_rng(10).normal(size=(16, D)), jnp.float32)
+    cc = CompressionConfig(bits=8, bucket_size=64)
+
+    def run(hop_cc):
+        def body(stacked_local, xfull):
+            micro = split_microbatches(xfull, n_micro)
+            out = spmd_pipeline(
+                _stage_fn, stacked_local, micro, axis_name="pp",
+                n_stages=n_stages, hop_cc=hop_cc,
+            )
+            return merge_microbatches(out)
+
+        return jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                          out_specs=P(), check_vma=False)
+        )(stacked, x)
+
+    plain = np.asarray(run(None))
+    comp = np.asarray(run(cc))
+    # 3 quantized hops with per-hop bucket error ~range/255; tanh keeps
+    # activations in [-1, 1] so the compounded error stays small.
+    assert np.abs(comp - plain).max() < 0.1, np.abs(comp - plain).max()
+    assert not np.array_equal(comp, plain)  # compression actually engaged
+
+    def loss(stacked_p):
+        def body(stacked_local, xfull):
+            micro = split_microbatches(xfull, n_micro)
+            out = spmd_pipeline(
+                _stage_fn, stacked_local, micro, axis_name="pp",
+                n_stages=n_stages, hop_cc=cc,
+            )
+            return jnp.sum(merge_microbatches(out) ** 2)
+
+        return jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                             out_specs=P(), check_vma=False)(stacked_p, x)
+
+    g = jax.jit(jax.grad(loss))(stacked)
+    for leaf in jax.tree.leaves(g):
+        arr = np.asarray(leaf)
+        assert np.isfinite(arr).all()
+        assert np.abs(arr).max() > 0  # cotangents crossed the quantized hops
+
+
+def test_interleaved_compressed_hops():
+    """hop_cc on the interleaved schedule: compressed output tracks the
+    plain run within quantization error."""
+    from torch_cgx_tpu.config import CompressionConfig
+    from torch_cgx_tpu.parallel.pipeline import (
+        spmd_pipeline_interleaved,
+        stack_interleaved_params,
+    )
+
+    n_stages, n_virtual, n_micro = 4, 2, 8
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    chunks = _stages(n_stages * n_virtual, seed=11)
+    stacked = stack_interleaved_params(chunks, n_stages, n_virtual)
+    x = jnp.asarray(np.random.default_rng(12).normal(size=(16, D)), jnp.float32)
+
+    def run(hop_cc):
+        def body(stacked_local, xfull):
+            micro = split_microbatches(xfull, n_micro)
+            out = spmd_pipeline_interleaved(
+                _stage_fn, stacked_local, micro, axis_name="pp",
+                n_stages=n_stages, n_virtual=n_virtual, hop_cc=hop_cc,
+            )
+            return merge_microbatches(out)
+
+        return np.asarray(jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+                          out_specs=P(), check_vma=False)
+        )(stacked, x))
+
+    plain = run(None)
+    comp = run(CompressionConfig(bits=8, bucket_size=64))
+    assert np.abs(comp - plain).max() < 0.15, np.abs(comp - plain).max()
+    assert not np.array_equal(comp, plain)
+
+
+def test_1f1b_compressed_hops():
+    """hop_cc on 1F1B: both the activation (right) and cotangent (left)
+    hops compress; loss/grads track the plain schedule."""
+    from torch_cgx_tpu.config import CompressionConfig
+    from torch_cgx_tpu.parallel.pipeline import pipeline_1f1b
+
+    n_stages = 4
+    m = 2 * n_stages
+    mesh = Mesh(np.asarray(jax.devices()[:n_stages]), ("pp",))
+    stages = _stages(n_stages, seed=13)
+    stacked = stack_stage_params(stages)
+    rng = np.random.default_rng(14)
+    micro = jnp.asarray(rng.normal(size=(m, 2, D)), jnp.float32)
+    tgts = jnp.asarray(rng.normal(size=(m, 2, D)) * 0.1, jnp.float32)
+
+    def run(hop_cc):
+        def body(stacked_local, micro_local, t):
+            return pipeline_1f1b(
+                _stage_fn, _loss_fn, stacked_local, micro_local, t,
+                axis_name="pp", n_stages=n_stages, hop_cc=hop_cc,
+            )
+
+        loss, grads = jax.jit(
+            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
+                          out_specs=(P(), P("pp")), check_vma=False)
+        )(stacked, micro, tgts)
+        return float(loss), jax.tree.map(np.asarray, grads)
+
+    l_plain, g_plain = run(None)
+    l_comp, g_comp = run(CompressionConfig(bits=8, bucket_size=64))
+    assert abs(l_comp - l_plain) < 0.05 * abs(l_plain) + 1e-3, (l_comp, l_plain)
+    for a, b in zip(jax.tree.leaves(g_comp), jax.tree.leaves(g_plain)):
+        assert np.isfinite(a).all()
+        # same order of magnitude, not identical (compression engaged)
+        assert np.abs(a - b).max() < 0.2 * (np.abs(b).max() + 1e-6)
+    assert any(
+        not np.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(g_comp), jax.tree.leaves(g_plain))
+    )

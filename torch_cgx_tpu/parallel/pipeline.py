@@ -19,11 +19,22 @@ and allreduces them over the data-parallel axes of the same mesh.
 
 from __future__ import annotations
 
-from typing import Callable, Sequence
+from typing import Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from ..config import CompressionConfig
+from .reducers import quantized_ppermute
+
+
+def _hop(y, axis_name, perm, hop_cc):
+    """One inter-stage transfer: plain ppermute, or the quantized wire
+    (packed bit-planes + meta, STE backward) when ``hop_cc`` is given."""
+    if hop_cc is None:
+        return lax.ppermute(y, axis_name, perm)
+    return quantized_ppermute(y, axis_name, perm, hop_cc)
 
 
 def _squeeze_stage_axis(local_params):
@@ -54,6 +65,7 @@ def spmd_pipeline(
     *,
     axis_name: str = "pp",
     n_stages: int,
+    hop_cc: Optional[CompressionConfig] = None,
 ):
     """Run a GPipe pipeline **inside shard_map**.
 
@@ -94,7 +106,7 @@ def spmd_pipeline(
             lambda o: o,
             outputs,
         )
-        recv = lax.ppermute(y, axis_name, right)
+        recv = _hop(y, axis_name, right, hop_cc)
         return (recv, outputs), None
 
     outputs0 = jnp.zeros((m,) + zero.shape, zero.dtype)
@@ -135,6 +147,7 @@ def spmd_pipeline_interleaved(
     axis_name: str = "pp",
     n_stages: int,
     n_virtual: int,
+    hop_cc: Optional[CompressionConfig] = None,
 ):
     """Interleaved virtual-stage pipeline (Megatron-LM style) inside
     ``shard_map``: each device holds ``n_virtual`` model chunks assigned
@@ -209,7 +222,7 @@ def spmd_pipeline_interleaved(
             lambda o: o,
             outputs,
         )
-        recv = lax.ppermute(y, axis_name, right)
+        recv = _hop(y, axis_name, right, hop_cc)
         return (recv, outputs), None
 
     outputs0 = jnp.zeros((m,) + zero.shape, zero.dtype)
@@ -241,6 +254,7 @@ def pipeline_1f1b(
     *,
     axis_name: str = "pp",
     n_stages: int,
+    hop_cc: Optional[CompressionConfig] = None,
 ):
     """One-forward-one-backward (1F1B / PipeDream-flush) pipelined training
     step **inside shard_map** — forward AND backward are scheduled
@@ -347,8 +361,8 @@ def pipeline_1f1b(
             jnp.logical_and(do_b, is_last), l_b.astype(jnp.float32), 0.0
         )
 
-        recv_x = lax.ppermute(y, axis_name, right)
-        recv_cot = lax.ppermute(cot_x, axis_name, left)
+        recv_x = _hop(y, axis_name, right, hop_cc)
+        recv_cot = _hop(cot_x, axis_name, left, hop_cc)
         return (recv_x, recv_cot, stash, gacc, lacc), None
 
     stash0 = jnp.zeros((k_slots,) + zero.shape, zero.dtype)
