@@ -169,6 +169,120 @@ def allreduce_flat(
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
+def _stage1_roundtrip_piece(
+    piece: jax.Array,
+    cc: CompressionConfig,
+    *,
+    mesh,
+    axes: Sequence[str],
+    topo: TopologyConfig,
+    key: Optional[jax.Array],
+) -> jax.Array:
+    """One fusion slice's wire decode, mirroring the reducers' decision tree
+    (quantized_allreduce / hierarchical_allreduce prologues): exact wires
+    (PSUM reduction, compression off for the stage, dummy codec, ws == 1
+    without the force-codec knob) round-trip unchanged — zero residual."""
+    from ..ops import dispatch
+    from .reducers import _chunk_size, _pad_rows, _phase_key, quantized_allreduce
+
+    if cfg_mod.dummy_compression():
+        return piece  # pass-through codec decodes exactly
+
+    if len(axes) == 2:
+        # hierarchical_allreduce prologue (reducers.py): per-level keys and
+        # ws==1 routing must match or the residual measures a different
+        # quantization than the wire's.
+        cross_axis, intra_axis = axes
+        ws_intra = mesh.shape[intra_axis]
+        ws_cross = mesh.shape[cross_axis]
+        key_intra = jax.random.fold_in(key, 3) if key is not None else None
+        key_cross = jax.random.fold_in(key, 5) if key is not None else None
+        if ws_intra == 1 and ws_cross == 1:
+            return piece
+        if ws_intra == 1:
+            if not topo.cross_compress:
+                return piece
+            return _stage1_roundtrip_piece(
+                piece, cc, mesh=mesh, axes=(cross_axis,),
+                topo=dataclasses.replace(
+                    topo, intra_reduction=topo.cross_reduction
+                ),
+                key=key_cross,
+            )
+        # Stage 1 = the intra level (both the leader scheme's
+        # reduce-scatter and the non-leader full intra allreduce quantize
+        # the same (ws, chunk) rows first).
+        if not topo.intra_compress:
+            # Stage 1 is an exact psum; the later cross-stage quantization
+            # acts on the *shared* reduced chunk, which per-device EF
+            # cannot attribute — treat the wire as exact (EF no-op).
+            return piece
+        axis, ws, k = intra_axis, ws_intra, key_intra
+        red = topo.intra_reduction
+    else:
+        axis = axes[0]
+        ws = mesh.shape[axis]
+        red = (
+            topo.intra_reduction
+            if axis != mesh_mod.CROSS_AXIS
+            else topo.cross_reduction
+        )
+        k = key
+        if ws == 1:
+            # ws==1 runs no collective: identity, or the force-codec proxy
+            # round trip — quantized_allreduce's own ws==1 branch IS the
+            # wire, so reuse it verbatim.
+            return quantized_allreduce(piece, axis, 1, cc, red, k)
+
+    if not cc.enabled or red == cfg_mod.REDUCTION_PSUM:
+        return piece
+    # SRA/all-to-all/Ring all quantize the 32-aligned (ws, chunk) rows
+    # first (reduce_scatter_quantized / ring segments); Ring's later
+    # per-hop requantizations act on accumulated sums and are not
+    # per-device-attributable — first-hop measurement is the EF residual.
+    k = _phase_key(k, 1, axis)
+    rows = _pad_rows(piece, ws, _chunk_size(piece.shape[0], ws))
+    q = dispatch.quantize_batch(rows, cc, k if cc.stochastic else None)
+    rt = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
+    return rt.reshape(-1)[: piece.shape[0]]
+
+
+def _local_roundtrip_flat(
+    flat: jax.Array,
+    cc: CompressionConfig,
+    *,
+    mesh,
+    axes: Sequence[str],
+    topology: Optional[TopologyConfig],
+    key: Optional[jax.Array],
+) -> jax.Array:
+    """What this device's contribution decodes to on the wire: mirrors
+    :func:`allreduce_flat`'s fusion slicing, fake-ratio head/tail split and
+    the reducers' stage-1 quantization (layout, bucket restarts, stochastic
+    keys). Exact for the default SRA path; for Ring (per-hop
+    requantization) it measures the first hop only."""
+    topo = topology or cfg_mod.topology_from_env()
+    n = flat.shape[0]
+    ratio = cfg_mod.fake_ratio()
+    tail = None
+    if ratio is not None and cc.enabled and n > 1:
+        m = max(1, int(np.ceil(ratio * n)))
+        tail = lax.slice(flat, (m,), (n,))  # never travels: exact
+        flat, n = lax.slice(flat, (0,), (m,)), m
+    pieces = []
+    for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
+        piece = lax.slice(flat, (off,), (off + ln,))
+        k = jax.random.fold_in(key, off) if key is not None else None
+        pieces.append(
+            _stage1_roundtrip_piece(
+                piece, cc, mesh=mesh, axes=axes, topo=topo, key=k
+            )
+        )
+    if tail is not None:
+        pieces.append(tail)
+    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+
 def allreduce_tree(
     tree,
     *,
@@ -178,12 +292,18 @@ def allreduce_tree(
     key: Optional[jax.Array] = None,
     average: bool = False,
     compress_small: bool = False,
+    return_roundtrip: bool = False,
 ):
     """Quantized allreduce of a gradient pytree (call inside shard_map).
 
     ``average=True`` divides by the total axis world size *before*
     quantization — the reference hook's semantics (grads pre-divided in
     Python, backend sums; allreduce_hooks.py:53-54, SURVEY.md §8.12).
+
+    ``return_roundtrip=True`` additionally returns a tree of this device's
+    contribution as it decodes on the wire (:func:`_local_roundtrip_flat`
+    over the same fused layout) — the error-feedback residual base.
+    Uncompressed leaves round-trip unchanged (zero residual).
     """
     axes = tuple(axes)
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
@@ -198,6 +318,7 @@ def allreduce_tree(
 
     groups = _group_leaves(paths_leaves, compress_small)
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
+    rt_out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     for gi, g in enumerate(groups):
         # distinct stochastic-rounding stream per fused group (groups would
         # otherwise share fold sequences and thus random fields)
@@ -220,9 +341,16 @@ def allreduce_tree(
                     fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                     key=g_key,
                 )
+                if return_roundtrip:
+                    rt_flat = _local_roundtrip_flat(
+                        fused, g.cc, mesh=mesh, axes=axes,
+                        topology=topology, key=g_key,
+                    )
             else:
                 metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))
                 reduced = fused
+                if return_roundtrip:
+                    rt_flat = fused  # exact wire: zero residual
                 for a in axes:
                     if mesh.shape[a] > 1:
                         reduced = lax.psum(reduced, a)
@@ -230,5 +358,12 @@ def allreduce_tree(
         for i, leaf in zip(g.indices, leaves):
             n = leaf.size
             out[i] = lax.slice(reduced, (off,), (off + n,)).reshape(leaf.shape)
+            if return_roundtrip:
+                rt_out[i] = lax.slice(rt_flat, (off,), (off + n,)).reshape(
+                    leaf.shape
+                )
             off += n
-    return jax.tree_util.tree_unflatten(treedef, out)
+    result = jax.tree_util.tree_unflatten(treedef, out)
+    if return_roundtrip:
+        return result, jax.tree_util.tree_unflatten(treedef, rt_out)
+    return result

@@ -25,10 +25,37 @@ import numpy as np
 import optax
 from jax.sharding import PartitionSpec as P
 
+from typing import NamedTuple
+
 from .. import config as cfg_mod
 from ..config import TopologyConfig
 from . import mesh as mesh_mod
 from .allreduce import allreduce_tree
+
+
+class ErrorFeedbackState(NamedTuple):
+    """Per-device residual of the quantized gradient transport. NOTE: this
+    state VARIES across data-parallel devices — under shard_map it must be
+    sharded (leading device axis or explicit per-device placement), never
+    declared replicated."""
+
+    e: optax.Updates
+
+
+def _ef_sync(grads, e, *, mesh, axes, topology, key, divisor):
+    """Shared EF recipe (single source for the transform and the train
+    step): pre-divide (§8.12 order), add residuals, quantized-sum, and
+    measure the new residual against the sync's own stage-1 wire decode.
+    Returns ``(reduced_f32, e_new)``."""
+    g_eff = jax.tree.map(
+        lambda g, ee: g.astype(jnp.float32) / divisor + ee, grads, e
+    )
+    reduced, rt = allreduce_tree(
+        g_eff, mesh=mesh, axes=axes, topology=topology, key=key,
+        average=False, return_roundtrip=True,
+    )
+    e_new = jax.tree.map(lambda g, r: g - r.astype(jnp.float32), g_eff, rt)
+    return reduced, e_new
 
 
 def gradient_sync(
@@ -60,24 +87,54 @@ def compressed_allreduce_transform(
     axes: Sequence[str] = (mesh_mod.DP_AXIS,),
     topology: Optional[TopologyConfig] = None,
     average: bool = True,
+    error_feedback: bool = False,
 ) -> optax.GradientTransformation:
     """optax transformation performing the quantized allreduce; prepend to an
     optimizer chain running inside shard_map:
 
         optax.chain(cgx.compressed_allreduce_transform(mesh=mesh), optax.adam(1e-3))
+
+    ``error_feedback=True`` adds EF-style residual accumulation: the exact
+    quantization error of this device's wire contribution (the sync's own
+    stage-1 round trip, ``allreduce_tree(return_roundtrip=True)``) is
+    carried in the optimizer state and added to the next step's gradient —
+    the low-bit bias corrector the reference's kernels stub out but never
+    wire (cuda_compression_operations.cu:69-84). It pays off when
+    per-bucket outliers bias the quantization of small coordinates (see
+    tests); at 1-bit it can HURT with the SRA transport — the residuals
+    inflate the dynamic range the second-stage requantization must cover.
+    The EF state is PER-DEVICE: inside shard_map, shard it (see
+    :func:`make_train_step`'s ``error_feedback`` plumbing or manage the
+    state placement yourself); declaring it replicated silently corrupts
+    the residuals.
     """
+    ws_total = int(np.prod([mesh.shape[a] for a in axes]))
 
     def init_fn(params):
-        del params
-        return optax.EmptyState()
+        if not error_feedback:
+            return optax.EmptyState()
+        return ErrorFeedbackState(
+            e=jax.tree.map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), params
+            )
+        )
 
     def update_fn(updates, state, params=None):
         del params
-        return (
-            gradient_sync(updates, mesh=mesh, axes=axes, topology=topology,
-                          average=average),
-            state,
+        if not error_feedback:
+            return (
+                gradient_sync(updates, mesh=mesh, axes=axes,
+                              topology=topology, average=average),
+                state,
+            )
+        reduced, e_new = _ef_sync(
+            updates, state.e, mesh=mesh, axes=axes, topology=topology,
+            key=None, divisor=ws_total if average else 1,
         )
+        reduced = jax.tree.map(
+            lambda r, u: r.astype(u.dtype), reduced, updates
+        )
+        return reduced, ErrorFeedbackState(e=e_new)
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -92,6 +149,7 @@ def make_train_step(
     topology: Optional[TopologyConfig] = None,
     stochastic_seed: Optional[int] = None,
     donate: bool = True,
+    error_feedback: bool = False,
 ):
     """Build a jitted compressed-DP train step.
 
@@ -116,6 +174,14 @@ def make_train_step(
     sums over sequence shards — join the quantized allreduce over
     ``axes + (sp_axis,)``. Only a single dp axis composes with sp (the
     reducers support at most two allreduce axes).
+
+    ``error_feedback=True`` carries a per-device quantization residual
+    (see :func:`compressed_allreduce_transform`): the step signature
+    becomes ``step(params, opt_state, ef, batch, step_idx) -> (params,
+    opt_state, ef, loss)`` where ``ef`` comes from
+    :func:`init_error_feedback` — leaves are ``(ws, *param.shape)``
+    f32 sharded over the sync axes on the leading device dim, so every
+    device keeps its own residual.
     """
     import inspect
 
@@ -138,7 +204,7 @@ def make_train_step(
             return P(axes, sp_axis)
         return P(axes)
 
-    def _step(params, opt_state, batch, step_idx):
+    def _grads_and_key(params, batch, step_idx):
         if wants_rng:
             r = jax.random.fold_in(
                 jax.random.PRNGKey(stochastic_seed or 0), step_idx
@@ -152,6 +218,10 @@ def make_train_step(
         key = None
         if stochastic_seed is not None:
             key = jax.random.fold_in(jax.random.PRNGKey(stochastic_seed), step_idx)
+        return loss, grads, key
+
+    def _step(params, opt_state, batch, step_idx):
+        loss, grads, key = _grads_and_key(params, batch, step_idx)
         grads = gradient_sync(
             grads, mesh=mesh, axes=sync_axes, topology=topology, key=key,
             average=True,
@@ -160,6 +230,26 @@ def make_train_step(
         params = optax.apply_updates(params, updates)
         loss = jax.lax.psum(loss, sync_axes) / ws_total
         return params, opt_state, loss
+
+    def _step_ef(params, opt_state, ef, batch, step_idx):
+        loss, grads, key = _grads_and_key(params, batch, step_idx)
+        e = jax.tree.map(lambda x: jnp.squeeze(x, 0), ef)
+        reduced, e_new = _ef_sync(
+            grads, e, mesh=mesh, axes=sync_axes, topology=topology,
+            key=key, divisor=ws_total,
+        )
+        grads_out = jax.tree.map(
+            lambda r, g: r.astype(g.dtype), reduced, grads
+        )
+        updates, opt_state = optimizer.update(grads_out, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.psum(loss, sync_axes) / ws_total
+        return (
+            params,
+            opt_state,
+            jax.tree.map(lambda x: x[None], e_new),
+            loss,
+        )
 
     # The batch in_specs depend on per-leaf rank (rank-1 leaves can't carry
     # the sp dim), so the shard_map is built per batch tree-structure and
@@ -174,11 +264,20 @@ def make_train_step(
             batch_spec = jax.tree_util.tree_unflatten(
                 treedef, [_batch_leaf_spec(l) for l in leaves]
             )
+            ef_spec = P(sync_axes)
             sharded = jax.shard_map(
-                _step,
+                _step_ef if error_feedback else _step,
                 mesh=mesh,
-                in_specs=(P(), P(), batch_spec, P()),
-                out_specs=(P(), P(), P()),
+                in_specs=(
+                    (P(), P(), ef_spec, batch_spec, P())
+                    if error_feedback
+                    else (P(), P(), batch_spec, P())
+                ),
+                out_specs=(
+                    (P(), P(), ef_spec, P())
+                    if error_feedback
+                    else (P(), P(), P())
+                ),
                 # Only the gradient-sync (and sp) axes are manual; any other
                 # mesh axis — tp, ep — stays under GSPMD control, so
                 # tensor-parallel parameter shardings survive the step
@@ -191,14 +290,46 @@ def make_train_step(
                 # collective composition.
                 check_vma=False,
             )
-            fn = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+            donate_idx = ()
+            if donate:
+                # params, opt_state — and the EF residual buffer, which is
+                # param-sized f32 and would otherwise double-buffer.
+                donate_idx = (0, 1, 2) if error_feedback else (0, 1)
+            fn = jax.jit(sharded, donate_argnums=donate_idx)
             built[cache_key] = fn
         return fn
 
-    def step(params, opt_state, batch, step_idx):
-        return _build(batch)(params, opt_state, batch, step_idx)
+    if error_feedback:
+
+        def step(params, opt_state, ef, batch, step_idx):
+            return _build(batch)(params, opt_state, ef, batch, step_idx)
+
+    else:
+
+        def step(params, opt_state, batch, step_idx):
+            return _build(batch)(params, opt_state, batch, step_idx)
 
     return step
+
+
+def init_error_feedback(
+    params,
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    sp_axis: Optional[str] = None,
+):
+    """Zero-initialized per-device EF residuals for
+    :func:`make_train_step` ``(error_feedback=True)``: each leaf is
+    ``(ws, *param.shape)`` f32, sharded over the sync axes on the leading
+    device dim so every device owns exactly its own residual row."""
+    from jax.sharding import NamedSharding
+
+    sync_axes = tuple(axes) if sp_axis is None else tuple(axes) + (sp_axis,)
+    ws = int(np.prod([mesh.shape[a] for a in sync_axes]))
+    z = jax.tree.map(
+        lambda p: jnp.zeros((ws,) + p.shape, jnp.float32), params
+    )
+    return jax.device_put(z, NamedSharding(mesh, P(sync_axes)))
 
 
 def replicate(tree, mesh):
