@@ -239,3 +239,29 @@ def test_tile_chunks_env_override(monkeypatch):
     q = codec_pallas.quantize_batch(xs, 4, 64, interpret=True)
     q_ref = jax.vmap(lambda r: codec.quantize(r, 4, 64))(xs)
     np.testing.assert_array_equal(np.asarray(q.packed), np.asarray(q_ref.packed))
+
+
+@pytest.mark.parametrize("shape_case", ["flat", "chunks"])
+def test_butterfly_pack_byte_identity(monkeypatch, shape_case):
+    """CGX_PALLAS_PACK=butterfly must emit exactly the same wire bytes as
+    the default sum pack (both quantize kernel families)."""
+    from torch_cgx_tpu.ops import codec_pallas
+
+    bits = 4
+    if shape_case == "flat":
+        b, n = 128, 128 * 32 * 4  # whole chunks, bucket % 128 == 0
+    else:
+        b, n = 96, 96 * 32 * 2  # 32-aligned but not 128: chunk kernels
+    rng = np.random.default_rng(5)
+    xs = jnp.asarray(rng.normal(size=(1, n)), jnp.float32)
+
+    monkeypatch.delenv("CGX_PALLAS_PACK", raising=False)
+    q_sum = codec_pallas.quantize_batch(xs, bits, b, interpret=True)
+    monkeypatch.setenv("CGX_PALLAS_PACK", "butterfly")
+    q_bf = codec_pallas.quantize_batch(xs, bits, b, interpret=True)
+    np.testing.assert_array_equal(np.asarray(q_sum.packed), np.asarray(q_bf.packed))
+    np.testing.assert_array_equal(np.asarray(q_sum.meta), np.asarray(q_bf.meta))
+
+    monkeypatch.setenv("CGX_PALLAS_PACK", "bogus")
+    with pytest.raises(ValueError, match="CGX_PALLAS_PACK"):
+        codec_pallas.quantize_batch(xs, bits, b, interpret=True)

@@ -108,6 +108,44 @@ def _tile_chunks(n_chunks: int, bucket_size: int, bits: int) -> int:
     return int(min(16, cap, max(1, n_chunks)))
 
 
+def _pack_strategy() -> str:
+    """Bit-plane pack lowering: ``sum`` (cross-sublane reduction of shifted
+    bits — the default) or ``butterfly`` (log2(32) pairwise shift-OR folds).
+    Both emit identical bytes (CPU-asserted in the suite); the knob exists
+    so the faster lowering can be picked empirically per chip generation
+    without a code change."""
+    raw = (_env.get_optional_str_env("CGX_PALLAS_PACK") or "sum").lower()
+    if raw not in ("sum", "butterfly"):
+        raise ValueError(
+            f"CGX_PALLAS_PACK={raw!r}: expected 'sum' or 'butterfly'"
+        )
+    return raw
+
+
+def _pack_planes(lvl, bits: int, sub_axis: int, strategy: str):
+    """planes[w] = sum over the 32-sublane axis of ((lvl >> w) & 1) << s.
+    ``butterfly``: fold halves with shift-OR — 5 full-width steps over
+    halving data instead of a 32-way strided reduction."""
+    if strategy == "sum":
+        sub = jax.lax.broadcasted_iota(jnp.int32, lvl.shape, sub_axis)
+        return [
+            jnp.sum(((lvl >> w) & 1) << sub, axis=sub_axis) for w in range(bits)
+        ]
+    assert lvl.shape[sub_axis] == CHUNK_BUCKETS, (
+        "butterfly pack folds exactly 32 sublanes", lvl.shape, sub_axis)
+    planes = []
+    for w in range(bits):
+        a = (lvl >> w) & 1
+        sh = CHUNK_BUCKETS // 2
+        while sh >= 1:
+            lo = jax.lax.slice_in_dim(a, 0, sh, axis=sub_axis)
+            hi = jax.lax.slice_in_dim(a, sh, 2 * sh, axis=sub_axis)
+            a = lo | (hi << sh)
+            sh //= 2
+        planes.append(jnp.squeeze(a, axis=sub_axis))
+    return planes
+
+
 def _stochastic_r(seed_ref, shape):
     """In-kernel U[0,1) rounding offsets from the hardware PRNG. Routed
     through int32 because Mosaic lacks uint32->f32 (values stay < 2^24)."""
@@ -124,7 +162,7 @@ def _stochastic_r(seed_ref, shape):
 
 
 def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, tc,
-                     stochastic):
+                     stochastic, pack="sum"):
     maxlvl = np.float32((1 << bits) - 1)
     x = x_ref[:].astype(jnp.float32)  # (TC*32, B)
     b = x.shape[1]
@@ -138,10 +176,8 @@ def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, tc,
     # XLA/numpy/C++ codecs.
     lvl = jnp.clip(jnp.floor((x - bmin) / safe + r), 0, maxlvl).astype(jnp.int32)
     lv3 = lvl.reshape(tc, CHUNK_BUCKETS, b)
-    sub = jax.lax.broadcasted_iota(jnp.int32, (tc, CHUNK_BUCKETS, b), 1)
-    planes = [
-        jnp.sum(((lv3 >> w) & 1) << sub, axis=1) for w in range(bits)
-    ]  # each (TC, B); disjoint bits -> int32 wrap on the s=31 term is exact
+    planes = _pack_planes(lv3, bits, 1, pack)
+    # each (TC, B); disjoint bits -> int32 wrap on the s=31 term is exact
     # (TC, bits, B) stacked then flattened to a 2-D (TC*bits, B) store —
     # a 2-D out avoids the sublane padding a (., bits, B) 3-D out pays
     # for bits < 8.
@@ -178,7 +214,9 @@ def _pipe_tc(n_chunks: int, bucket_size: int) -> int:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bits", "bucket_size", "stochastic", "interpret", "tc"),
+    static_argnames=(
+        "bits", "bucket_size", "stochastic", "interpret", "tc", "pack",
+    ),
 )
 def _quantize_flat_impl(
     xs: jax.Array,
@@ -189,6 +227,7 @@ def _quantize_flat_impl(
     stochastic: bool,
     interpret: bool = False,
     tc: int = 8,
+    pack: str = "sum",
 ):
     """Zero-relayout quantize over rows of full chunks (t_r == 0,
     bucket_size % 128 == 0).
@@ -231,12 +270,8 @@ def _quantize_flat_impl(
         lvl = jnp.clip(jnp.floor((x4 - bmin) / safe + r), 0, maxlvl).astype(
             jnp.int32
         )
-        sub = jax.lax.broadcasted_iota(
-            jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
-        )
-        planes = [
-            jnp.sum(((lvl >> w) & 1) << sub, axis=1) for w in range(bits)
-        ]  # disjoint bits -> int32 wrap on the s=31 term is exact
+        planes = _pack_planes(lvl, bits, 1, pack)
+        # disjoint bits -> int32 wrap on the s=31 term is exact
         words_ref[:] = jnp.stack(planes, axis=1).reshape(
             tc * bits * rb, 128
         )
@@ -332,7 +367,9 @@ def _dequantize_flat_impl(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("bits", "bucket_size", "stochastic", "interpret", "tc"),
+    static_argnames=(
+        "bits", "bucket_size", "stochastic", "interpret", "tc", "pack",
+    ),
 )
 def _quantize_chunks_impl(
     xb: jax.Array,
@@ -343,6 +380,7 @@ def _quantize_chunks_impl(
     stochastic: bool,
     interpret: bool = False,
     tc: int = 8,
+    pack: str = "sum",
 ):
     """xb: (nb, B) bucket rows, nb % 32 == 0. Returns
     (words (nb//32 * bits, B) uint32, meta (nb, 2) f32)."""
@@ -354,7 +392,8 @@ def _quantize_chunks_impl(
 
     words, meta = pl.pallas_call(
         functools.partial(
-            _quantize_kernel, bits=bits, tc=tc, stochastic=stochastic
+            _quantize_kernel, bits=bits, tc=tc, stochastic=stochastic,
+            pack=pack,
         ),
         grid=(cp // tc,),
         in_specs=[
@@ -469,6 +508,7 @@ def quantize_batch(
             stochastic=stochastic,
             interpret=interpret,
             tc=_pipe_tc(rows * c_r, b),
+            pack=_pack_strategy(),
         )
         return codec.QTensor(
             packed=jax.lax.bitcast_convert_type(words, jnp.uint32).reshape(
@@ -494,6 +534,7 @@ def quantize_batch(
             stochastic=stochastic,
             interpret=interpret,
             tc=_tile_chunks(rows * c_r, b, bits),
+            pack=_pack_strategy(),
         )
         word_parts.append(words.reshape(rows, c_r * bits * b))
         meta_parts.append(meta.reshape(rows, c_r * CHUNK_BUCKETS, 2))
