@@ -39,6 +39,8 @@ def parse_args():
     p.add_argument("--bits", type=int, default=4)
     p.add_argument("--bucket-size", type=int, default=512)
     p.add_argument("--stochastic", action="store_true", help="QSGD stochastic rounding")
+    p.add_argument("--error-feedback", action="store_true",
+                   help="accumulate per-device wire-quantization residuals")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
     p.add_argument("--seq", type=int, default=128)
@@ -162,7 +164,16 @@ def main():
         sp_axis="sp" if args.sp > 1 else None,
         stochastic_seed=cgx_config.global_seed() if args.stochastic else None,
         donate=False,
+        error_feedback=args.error_feedback,
     )
+    ef = None
+    if args.error_feedback:
+        from torch_cgx_tpu.parallel import init_error_feedback
+
+        ef = init_error_feedback(
+            params, mesh, axes=dp_axes,
+            sp_axis="sp" if args.sp > 1 else None,
+        )
 
     losses = []
     for i in range(args.steps):
@@ -171,7 +182,12 @@ def main():
             jnp.asarray(data[lo : lo + args.batch]), mesh, dp_axes,
             sp_axis="sp" if args.sp > 1 else None,
         )
-        params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
+        if args.error_feedback:
+            params, opt_state, ef, loss = step(
+                params, opt_state, ef, batch, jnp.int32(i)
+            )
+        else:
+            params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
         losses.append(float(loss))
         if (i + 1) % max(1, args.steps // 5) == 0:
             print(f"step {i + 1}/{args.steps}: loss={losses[-1]:.4f}")
