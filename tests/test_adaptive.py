@@ -1,0 +1,140 @@
+"""Adaptive per-layer bit allocation (parallel/adaptive.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from torch_cgx_tpu import config as cgx_config
+from torch_cgx_tpu.parallel import (
+    adapt_bits,
+    allreduce_tree,
+    flat_mesh,
+    measure_layer_stats,
+    solve_bit_allocation,
+)
+from torch_cgx_tpu.parallel.adaptive import LayerStat
+
+
+def test_measure_skips_ineligible_layers(monkeypatch):
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    grads = {
+        "kernel": jnp.ones((64, 32), jnp.float32),
+        "bias": jnp.ones((64,), jnp.float32),  # dim<=1: uncompressed
+        "tiny": jnp.ones((2, 2), jnp.float32),  # < minimal: uncompressed
+    }
+    stats = measure_layer_stats(grads)
+    assert set(stats) == {"kernel"}
+    assert stats["kernel"].numel == 64 * 32
+
+
+def test_solver_respects_budget_and_prefers_noisy_layers():
+    n = 10_000
+    stats = {
+        "noisy": LayerStat(numel=n, mean_sq_range=100.0),
+        "quiet": LayerStat(numel=n, mean_sq_range=0.01),
+    }
+    alloc = solve_bit_allocation(stats, avg_bits=4.0, bits_range=(2, 8))
+    total_bits = sum(stats[k].numel * b for k, b in alloc.items())
+    assert total_bits <= 4.0 * 2 * n + 1e-9
+    assert alloc["noisy"] > alloc["quiet"], alloc
+    assert 2 <= alloc["quiet"] and alloc["noisy"] <= 8
+
+    # budget at the floor: everyone gets the minimum
+    alloc_lo = solve_bit_allocation(stats, avg_bits=2.0, bits_range=(2, 8))
+    assert alloc_lo == {"noisy": 2, "quiet": 2}
+
+    # unlimited budget: everyone maxes out
+    alloc_hi = solve_bit_allocation(stats, avg_bits=8.0, bits_range=(2, 8))
+    assert alloc_hi == {"noisy": 8, "quiet": 8}
+
+
+def test_solver_validates_bits_range():
+    with pytest.raises(ValueError, match="bits_range"):
+        solve_bit_allocation({}, 4.0, bits_range=(0, 8))
+
+
+def test_adaptive_beats_uniform_at_same_budget(monkeypatch):
+    """Two layers, one with 100x the bucket range: the adaptive split at an
+    average of 4 bits must reduce end-to-end allreduce error vs uniform
+    4-bit on the same gradients."""
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    monkeypatch.setenv(cgx_config.COMPRESSION_BUCKET_SIZE, "64")
+    mesh = flat_mesh()
+    rng = np.random.default_rng(0)
+    grads = {
+        "wild": jnp.asarray(rng.normal(size=(64, 64)) * 100, jnp.float32),
+        "tame": jnp.asarray(rng.normal(size=(64, 64)) * 1, jnp.float32),
+    }
+
+    def reduced_error():
+        def fn(g):
+            return allreduce_tree(g, mesh=mesh, average=True)
+
+        out = jax.jit(
+            shard_map(fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+        )(jax.device_put(grads, NamedSharding(mesh, P())))
+        return sum(
+            float(jnp.sum((a - b) ** 2))
+            for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(grads))
+        )
+
+    err_uniform = reduced_error()
+    alloc = adapt_bits(grads, avg_bits=4.0, bucket_size=64)
+    assert alloc["wild"] > alloc["tame"], alloc
+    # budget respected
+    n = 64 * 64
+    assert alloc["wild"] * n + alloc["tame"] * n <= 4.0 * 2 * n
+    err_adaptive = reduced_error()
+    assert err_adaptive < err_uniform * 0.9, (err_adaptive, err_uniform)
+
+
+def test_adapt_takes_effect_through_train_step_cache(monkeypatch):
+    """adapt_bits must invalidate make_train_step's cached trace (registry
+    version in the build key): starting from a compression-OFF default env,
+    post-adaptation steps must actually compress (trajectory diverges from
+    the exact-f32 twin), and pre-adaptation steps must not."""
+    monkeypatch.delenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, raising=False)
+    import optax
+
+    from torch_cgx_tpu.parallel import make_train_step, replicate, shard_batch
+
+    mesh = flat_mesh()
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.normal(size=(32, 32)) * 0.3, jnp.float32)}
+
+    def loss_fn(p, b):
+        return jnp.mean((b[0] @ p["w"] - b[1]) ** 2)
+
+    opt = optax.sgd(0.1)
+    xs = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    ys = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+
+    def run(adapt_at):
+        step = make_train_step(loss_fn, opt, mesh, donate=False)
+        p = replicate(params, mesh)
+        s = replicate(opt.init(params), mesh)
+        snaps = []
+        for i in range(4):
+            if i == adapt_at:
+                g = {"w": np.asarray(p["w"])}
+                alloc = adapt_bits(g, avg_bits=2.0, bucket_size=32)
+                assert alloc == {"w": 2}, alloc
+            b = shard_batch((xs, ys), mesh)
+            p, s, _ = step(p, s, b, jnp.int32(i))
+            snaps.append(np.asarray(p["w"]))
+        return snaps
+
+    plain = run(adapt_at=99)  # never adapts: exact f32 sync throughout
+    adapted = run(adapt_at=2)
+    cgx_config.clear_registry()
+    # identical before adaptation...
+    np.testing.assert_array_equal(plain[0], adapted[0])
+    np.testing.assert_array_equal(plain[1], adapted[1])
+    # ...and 2-bit-compressed after: the stale-cache bug would keep these
+    # equal forever.
+    assert not np.array_equal(plain[2], adapted[2]), (
+        "adaptation never took effect (stale train-step cache)")

@@ -278,6 +278,20 @@ _layer_sizes: Dict[int, list] = {}  # bucket_idx -> [numel per layer]
 # Name-pattern registry: JAX-idiomatic — regex over pytree leaf paths.
 _pattern_configs: Dict[str, CompressionConfig] = {}
 
+# Bumped on every registry mutation; trace caches that bake per-layer
+# configs in at trace time (make_train_step) key on it so a re-registration
+# (e.g. adapt_bits) forces a retrace instead of silently never applying.
+_registry_version: int = 0
+
+
+def registry_version() -> int:
+    return _registry_version
+
+
+def _bump_registry_version() -> None:
+    global _registry_version
+    _registry_version += 1
+
 
 def register_layer(
     bucket_idx: int,
@@ -309,6 +323,7 @@ def register_layer(
     _layer_configs[(bucket_idx, layer_idx)] = CompressionConfig(
         bits=bits, bucket_size=bucket_size
     )
+    _bump_registry_version()
 
 
 def set_quantization_bits(layer_id: LayerId, bits: int) -> None:
@@ -370,6 +385,7 @@ def set_layer_pattern_config(pattern: str, config: CompressionConfig) -> None:
     (e.g. ``r".*kernel$"``). Later registrations win."""
     re.compile(pattern)  # validate eagerly
     _pattern_configs[pattern] = config
+    _bump_registry_version()
 
 
 def resolve_pattern_config(path: str) -> Optional[CompressionConfig]:
@@ -388,3 +404,4 @@ def clear_registry() -> None:
     _layer_configs.clear()
     _layer_sizes.clear()
     _pattern_configs.clear()
+    _bump_registry_version()

@@ -1,0 +1,197 @@
+"""Adaptive per-layer bit allocation for gradient compression.
+
+The reference carries a per-layer config registry but leaves choosing the
+bits to the user (SURVEY.md §5.6); its research lineage (L-GreCo) picks
+them automatically by solving an error/budget trade-off. TPU-native take:
+
+* :func:`measure_layer_stats` — per-layer bucket-range statistics from a
+  gradient pytree (one host pass, run every K steps).
+* :func:`solve_bit_allocation` — minimize the summed max-min quantization
+  error model  ``E_l(b) = numel_l * mean_range_l^2 / (12 (2^b-1)^2)``
+  subject to an average-bits budget, by greedy marginal-gain ascent
+  (optimal here: the per-layer error is convex and decreasing in integer
+  bits, so marginal gains are monotone).
+* :func:`apply_bit_allocation` — write the result into the name-pattern
+  registry consumed by :func:`..parallel.allreduce.resolve_leaf_config`.
+
+Changing a layer's bits changes compiled shapes, so re-solving forces a
+retrace of the train step (~seconds on TPU): re-solve every few hundred
+steps, not every step. Layers the eligibility rules exclude (dim <= 1,
+tiny, non-float) are skipped entirely — their wire is exact.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import re
+from typing import Dict, Mapping, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config as cfg_mod
+from ..utils.tree import path_str
+from .allreduce import resolve_leaf_config
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerStat:
+    """Per-layer quantization-error ingredients: element count, the mean
+    squared per-bucket range of the (flattened) gradient, and the resolved
+    config the measurement used (bits are overwritten by the solver; every
+    other field — bucket size, stochastic, skip mode — is preserved when
+    the allocation is applied)."""
+
+    numel: int
+    mean_sq_range: float
+    cc: "cfg_mod.CompressionConfig" = None
+
+
+def measure_layer_stats(
+    grads,
+    *,
+    bucket_size: Optional[int] = None,
+    compress_small: bool = False,
+) -> Dict[str, LayerStat]:
+    """One host pass over a gradient pytree -> per-layer ``LayerStat``.
+
+    Eligibility is structural (float, rank > 1 unless ``compress_small``,
+    >= the minimal size) — NOT gated on compression being enabled already:
+    turning compression on IS what the allocation does, so it must work
+    from a bits=32 default environment. ``bucket_size`` defaults to each
+    layer's resolved config.
+    """
+    with_path, _ = jax.tree_util.tree_flatten_with_path(grads)
+    out: Dict[str, LayerStat] = {}
+    for p, leaf in with_path:
+        path = path_str(p)
+        if not any(
+            leaf.dtype == d
+            for d in (np.float32, jnp.bfloat16, np.float16)
+        ):
+            continue
+        if leaf.size < cfg_mod.minimal_size():
+            continue
+        if not compress_small and leaf.ndim <= 1:
+            continue
+        cc = resolve_leaf_config(path, leaf, compress_small=compress_small)
+        b = bucket_size or cc.bucket_size
+        x = np.asarray(leaf, np.float64).reshape(-1)
+        n = x.size
+        nb = -(-n // b)
+        pad = nb * b - n
+        if pad:
+            x = np.concatenate([x, np.repeat(x[-1], pad)])
+        rows = x.reshape(nb, b)
+        rng = rows.max(axis=1) - rows.min(axis=1)
+        out[path] = LayerStat(
+            numel=n,
+            mean_sq_range=float(np.mean(rng**2)),
+            cc=dataclasses.replace(cc, bucket_size=b),
+        )
+    return out
+
+
+def _err(stat: LayerStat, bits: int) -> float:
+    """Expected max-min quantization MSE at ``bits`` (uniform-error model:
+    unit^2/12 per element, unit = range/(2^bits - 1))."""
+    return stat.numel * stat.mean_sq_range / (12.0 * (2**bits - 1) ** 2)
+
+
+def solve_bit_allocation(
+    stats: Mapping[str, LayerStat],
+    avg_bits: float,
+    *,
+    bits_range: Tuple[int, int] = (2, 8),
+) -> Dict[str, int]:
+    """Per-layer bits minimizing summed expected quantization error under
+    ``sum(numel * bits) <= avg_bits * sum(numel)``.
+
+    Greedy marginal-gain ascent from the floor: repeatedly give one more
+    bit to the layer with the best error reduction per payload bit. Exact
+    when layers have equal size (marginal gains shrink monotonically);
+    with mixed sizes it is the standard knapsack-greedy approximation.
+    """
+    lo, hi = bits_range
+    if not 1 <= lo <= hi <= 8:
+        raise ValueError(f"bits_range must satisfy 1 <= lo <= hi <= 8, got {bits_range}")
+    if avg_bits < lo:
+        raise ValueError(
+            f"avg_bits={avg_bits} is below the bits_range floor {lo}: even "
+            "the minimum allocation would exceed the budget"
+        )
+    total = sum(s.numel for s in stats.values())
+    if not total:
+        return {}
+    budget = avg_bits * total
+    alloc = {path: lo for path in stats}
+    spent = lo * total
+    # max-heap on marginal gain per bit-element
+    heap = []
+    for path, s in stats.items():
+        if lo < hi:
+            gain = (_err(s, lo) - _err(s, lo + 1)) / s.numel
+            heapq.heappush(heap, (-gain, path))
+    while heap:
+        neg_gain, path = heapq.heappop(heap)
+        s = stats[path]
+        if spent + s.numel > budget:
+            continue  # this layer no longer fits; others may be smaller
+        alloc[path] += 1
+        spent += s.numel
+        b = alloc[path]
+        if b < hi:
+            gain = (_err(s, b) - _err(s, b + 1)) / s.numel
+            heapq.heappush(heap, (-gain, path))
+    return alloc
+
+
+def apply_bit_allocation(
+    alloc: Mapping[str, int],
+    stats: Mapping[str, LayerStat],
+    *,
+    bucket_size: Optional[int] = None,
+) -> None:
+    """Write an allocation into the name-pattern registry (exact-path
+    patterns), so the next traced allreduce picks it up — the registry
+    version bump forces make_train_step's cached trace to rebuild. Each
+    layer keeps the config it was MEASURED with (bucket size, stochastic,
+    skip mode) and only the bits change; pre-existing pattern settings
+    therefore survive instead of being reset to env defaults."""
+    for path, bits in alloc.items():
+        base = stats[path].cc
+        cfg_mod.set_layer_pattern_config(
+            "^" + re.escape(path) + "$",
+            dataclasses.replace(
+                base,
+                bits=int(bits),
+                bucket_size=int(bucket_size or base.bucket_size),
+            ),
+        )
+
+
+def adapt_bits(
+    grads,
+    avg_bits: float,
+    *,
+    bits_range: Tuple[int, int] = (2, 8),
+    bucket_size: Optional[int] = None,
+    compress_small: bool = False,
+) -> Dict[str, int]:
+    """Measure -> solve -> apply in one call; returns the allocation.
+
+    Call OUTSIDE jit every K steps; the registry-version bump makes
+    make_train_step's cached trace rebuild, so the new bits take effect on
+    the very next step (one retrace):
+
+        if step % 500 == 0:
+            cgx.adapt_bits(jax.device_get(grads), avg_bits=4)
+    """
+    stats = measure_layer_stats(
+        grads, bucket_size=bucket_size, compress_small=compress_small
+    )
+    alloc = solve_bit_allocation(stats, avg_bits, bits_range=bits_range)
+    apply_bit_allocation(alloc, stats, bucket_size=bucket_size)
+    return alloc

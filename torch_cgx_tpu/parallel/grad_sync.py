@@ -258,7 +258,14 @@ def make_train_step(
 
     def _build(batch):
         leaves, treedef = jax.tree_util.tree_flatten(batch)
-        cache_key = (treedef, tuple(getattr(l, "ndim", 0) for l in leaves))
+        # Registry version in the key: per-layer configs are baked in at
+        # trace time, so a re-registration (adapt_bits, new pattern
+        # configs) must produce a fresh trace, not hit the stale one.
+        cache_key = (
+            treedef,
+            tuple(getattr(l, "ndim", 0) for l in leaves),
+            cfg_mod.registry_version(),
+        )
         fn = built.get(cache_key)
         if fn is None:
             batch_spec = jax.tree_util.tree_unflatten(
