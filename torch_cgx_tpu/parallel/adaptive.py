@@ -33,7 +33,7 @@ import numpy as np
 
 from .. import config as cfg_mod
 from ..utils.tree import path_str
-from .allreduce import resolve_leaf_config
+from .allreduce import is_compressible, resolve_leaf_config
 
 
 @dataclasses.dataclass(frozen=True)
@@ -67,14 +67,7 @@ def measure_layer_stats(
     out: Dict[str, LayerStat] = {}
     for p, leaf in with_path:
         path = path_str(p)
-        if not any(
-            leaf.dtype == d
-            for d in (np.float32, jnp.bfloat16, np.float16)
-        ):
-            continue
-        if leaf.size < cfg_mod.minimal_size():
-            continue
-        if not compress_small and leaf.ndim <= 1:
+        if not is_compressible(leaf, compress_small=compress_small):
             continue
         cc = resolve_leaf_config(path, leaf, compress_small=compress_small)
         b = bucket_size or cc.bucket_size
@@ -184,10 +177,17 @@ def adapt_bits(
 
     Call OUTSIDE jit every K steps; the registry-version bump makes
     make_train_step's cached trace rebuild, so the new bits take effect on
-    the very next step (one retrace):
+    the very next step (one retrace).
+
+    ``make_train_step``'s step function does not expose per-step gradients,
+    so obtain the measurement tree explicitly — a one-off
+    ``jax.grad(loss_fn)(params, batch)`` on the current batch (one extra
+    backward every K steps), or any recent gradient snapshot; the bucket
+    RANGE statistics drift slowly, so staleness is benign:
 
         if step % 500 == 0:
-            cgx.adapt_bits(jax.device_get(grads), avg_bits=4)
+            g = jax.device_get(jax.grad(loss_fn)(params_host, batch_host))
+            cgx.adapt_bits(g, avg_bits=4)
     """
     stats = measure_layer_stats(
         grads, bucket_size=bucket_size, compress_small=compress_small

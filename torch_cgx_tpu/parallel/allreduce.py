@@ -44,6 +44,20 @@ def _is_float(leaf) -> bool:
     return any(leaf.dtype == d for d in _FLOAT_DTYPES)
 
 
+def is_compressible(leaf, *, compress_small: bool = False) -> bool:
+    """Structural eligibility, independent of whether compression is
+    currently enabled: float, large enough, and (unless ``compress_small``)
+    rank > 1 — the hook's ``should_compress_`` gates
+    (allreduce_hooks.py:42-45) plus the size floor."""
+    if not _is_float(leaf):
+        return False
+    if leaf.size < cfg_mod.minimal_size():
+        return False
+    if not compress_small and leaf.ndim <= 1:
+        return False
+    return True
+
+
 def resolve_leaf_config(
     path: str, leaf, *, compress_small: bool = False
 ) -> CompressionConfig:
@@ -55,12 +69,7 @@ def resolve_leaf_config(
     (numel > minimal and bits <= 8, compressor.cc:421-425).
     """
     cc = cfg_mod.resolve_pattern_config(path) or cfg_mod.default_compression_config()
-    if not _is_float(leaf):
-        return dataclasses.replace(cc, bits=32)
-    if leaf.size < cfg_mod.minimal_size():
-        return dataclasses.replace(cc, bits=32)
-    if not compress_small and leaf.ndim <= 1:
-        # biases / layernorms: the hook leaves them uncompressed
+    if not is_compressible(leaf, compress_small=compress_small):
         return dataclasses.replace(cc, bits=32)
     return cc
 

@@ -261,11 +261,16 @@ def make_train_step(
         # Registry version in the key: per-layer configs are baked in at
         # trace time, so a re-registration (adapt_bits, new pattern
         # configs) must produce a fresh trace, not hit the stale one.
+        version = cfg_mod.registry_version()
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
-            cfg_mod.registry_version(),
+            version,
         )
+        # Evict traces from older registry versions — each holds a full
+        # compiled executable and can never be hit again.
+        for k in [k for k in built if k[2] != version]:
+            del built[k]
         fn = built.get(cache_key)
         if fn is None:
             batch_spec = jax.tree_util.tree_unflatten(

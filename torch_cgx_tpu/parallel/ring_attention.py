@@ -115,11 +115,16 @@ def ulysses_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    hop_cc=None,
 ) -> jax.Array:
     """Ulysses sequence parallelism: all_to_all heads<->sequence reshard.
 
     (B, H, S/ws, D) -> all_to_all -> (B, H/ws, S, D) -> dense attention ->
     all_to_all back. Requires n_head divisible by the axis size.
+
+    ``hop_cc``: quantize the reshard payloads on the wire
+    (:func:`..parallel.reducers.quantized_all_to_all` — packed bit-planes
+    + per-slice meta, STE backward through the inverse reshard).
     """
     from ..models.attention import dense_attention
 
@@ -130,25 +135,40 @@ def ulysses_attention(
     if h % ws:
         raise ValueError(f"n_head={h} not divisible by sp axis size {ws}")
 
+    def _a2a(t, s_ax, c_ax):
+        if hop_cc is not None:
+            from .reducers import quantized_all_to_all
+
+            return quantized_all_to_all(
+                t, axis_name, split_axis=s_ax, concat_axis=c_ax, cc=hop_cc
+            )
+        return lax.all_to_all(
+            t, axis_name, split_axis=s_ax, concat_axis=c_ax, tiled=True
+        )
+
     def to_heads(t):  # split heads over axis, gather sequence
-        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2, tiled=True)
+        return _a2a(t, 1, 2)
 
     def to_seq(t):  # inverse
-        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1, tiled=True)
+        return _a2a(t, 2, 1)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
     out = dense_attention(qh, kh, vh, causal=causal)
     return to_seq(out)
 
 
-def make_sp_attention(axis_name: str, impl: str = "ring"):
+def make_sp_attention(axis_name: str, impl: str = "ring", hop_cc=None):
     """Build an ``attn_fn`` for ``MultiHeadAttention`` running under
     ``shard_map`` with the sequence dimension sharded over ``axis_name``.
 
     ``impl``: "ring" (arbitrary axis size, O(S_local^2) memory) or "ulysses"
-    (n_head % ws == 0, lowest traffic on ICI).
+    (n_head % ws == 0, lowest traffic on ICI). ``hop_cc``: quantize the
+    Ulysses reshard payloads (ulysses only — the ring's loop-carried KV
+    hops would compound per-hop error and are not compressed).
     """
     if impl == "ring":
+        if hop_cc is not None:
+            raise ValueError("hop_cc is supported for impl='ulysses' only")
         fn = ring_attention
     elif impl == "ulysses":
         fn = ulysses_attention
@@ -162,6 +182,7 @@ def make_sp_attention(axis_name: str, impl: str = "ring"):
                 "sequence-parallel attention does not support padding masks "
                 "yet; pad to full blocks or use dense attention"
             )
-        return fn(q, k, v, axis_name=axis_name, causal=causal)
+        kw = {"hop_cc": hop_cc} if impl == "ulysses" else {}
+        return fn(q, k, v, axis_name=axis_name, causal=causal, **kw)
 
     return attn_fn
