@@ -15,7 +15,7 @@ from torch_cgx_tpu.parallel import (
     measure_layer_stats,
     solve_bit_allocation,
 )
-from torch_cgx_tpu.parallel.adaptive import LayerStat
+from torch_cgx_tpu.parallel.adaptive import LayerStat, apply_bit_allocation
 
 
 def test_measure_skips_ineligible_layers(monkeypatch):
@@ -138,3 +138,16 @@ def test_adapt_takes_effect_through_train_step_cache(monkeypatch):
     # equal forever.
     assert not np.array_equal(plain[2], adapted[2]), (
         "adaptation never took effect (stale train-step cache)")
+
+
+def test_apply_allocation_with_bare_layerstats(monkeypatch):
+    """LayerStats constructed without a measured config (cc=None — the
+    solver-test pattern) must fall back to the env defaults instead of
+    raising (advisor r3)."""
+    monkeypatch.setenv(cgx_config.COMPRESSION_BUCKET_SIZE, "256")
+    stats = {
+        "layer": LayerStat(numel=4096, mean_sq_range=1.0),
+    }
+    apply_bit_allocation({"layer": 3}, stats)
+    cc = cgx_config.resolve_pattern_config("layer")
+    assert cc is not None and cc.bits == 3 and cc.bucket_size == 256

@@ -46,7 +46,7 @@ class LayerStat:
 
     numel: int
     mean_sq_range: float
-    cc: "cfg_mod.CompressionConfig" = None
+    cc: Optional["cfg_mod.CompressionConfig"] = None
 
 
 def measure_layer_stats(
@@ -154,7 +154,7 @@ def apply_bit_allocation(
     skip mode) and only the bits change; pre-existing pattern settings
     therefore survive instead of being reset to env defaults."""
     for path, bits in alloc.items():
-        base = stats[path].cc
+        base = stats[path].cc or cfg_mod.default_compression_config()
         cfg_mod.set_layer_pattern_config(
             "^" + re.escape(path) + "$",
             dataclasses.replace(

@@ -178,6 +178,79 @@ def allreduce_flat(
     return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
+def _roundtrip_wire_1axis(
+    piece: jax.Array,
+    cc: CompressionConfig,
+    *,
+    axis: str,
+    ws: int,
+    red: str,
+    key: Optional[jax.Array],
+    leader_rs: bool = False,
+) -> jax.Array:
+    """What this device's contribution to one single-axis reduction decodes
+    to on the wire — per-algorithm mirror of ``quantized_allreduce``'s (or,
+    with ``leader_rs``, ``reduce_scatter_quantized``'s) stage-1 layout AND
+    stochastic key derivation, so the EF residual measures the same random
+    draw the wire used."""
+    from ..ops import dispatch
+    from .reducers import _chunk_size, _pad_rows, _phase_key, quantized_allreduce
+
+    if ws == 1:
+        # ws==1 runs no collective: identity, or the force-codec proxy
+        # round trip — quantized_allreduce's own ws==1 branch IS the
+        # wire, so reuse it verbatim.
+        return quantized_allreduce(piece, axis, 1, cc, red, key)
+    if not cc.enabled:
+        return piece
+    if leader_rs:
+        # hierarchical leader scheme: stage 1 is reduce_scatter_quantized
+        # regardless of the configured reduction type
+        # (reducers.hierarchical_allreduce gates on intra_cc.enabled only)
+        # — the SRA stage-1 layout and key.
+        red = cfg_mod.REDUCTION_SRA
+    if red == cfg_mod.REDUCTION_PSUM:
+        return piece
+    n = piece.shape[0]
+    chunk = _chunk_size(n, ws)
+    if red == cfg_mod.REDUCTION_ALLTOALL:
+        # alltoall_allreduce quantizes the whole buffer as ONE row keyed
+        # fold_in(key, axis_index), and every peer decodes exactly those
+        # bytes — a fully mirrorable wire.
+        k = (
+            jax.random.fold_in(key, lax.axis_index(axis))
+            if key is not None and cc.stochastic
+            else None
+        )
+        q = dispatch.quantize_batch(piece[None], cc, k)
+        return dispatch.dequantize_batch(q, out_dtype=piece.dtype)[0]
+    if red == cfg_mod.REDUCTION_RING:
+        # ring_allreduce's only per-device-attributable quantization of RAW
+        # data is the step-0 hop: the own outgoing segment (row index =
+        # rank) keyed fold_in(fold_in(key, 0), rank). Later hops requantize
+        # accumulated sums — treated exact for EF purposes.
+        rank = lax.axis_index(axis)
+        rows = _pad_rows(piece, ws, chunk)
+        own = lax.dynamic_slice(rows, (rank, 0), (1, chunk))
+        k = (
+            jax.random.fold_in(jax.random.fold_in(key, 0), rank)
+            if key is not None and cc.stochastic
+            else None
+        )
+        q = dispatch.quantize_batch(own, cc, k)
+        rt_own = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
+        rows = lax.dynamic_update_slice(rows, rt_own, (rank, 0))
+        return rows.reshape(-1)[:n]
+    # SRA: stage-1 quantizes the (ws, chunk) rows with the phase-1 key
+    # (reduce_scatter_quantized). The allgather-phase requantization acts on
+    # the reduced chunk — not per-device-attributable, treated exact.
+    k = _phase_key(key, 1, axis)
+    rows = _pad_rows(piece, ws, chunk)
+    q = dispatch.quantize_batch(rows, cc, k if cc.stochastic else None)
+    rt = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
+    return rt.reshape(-1)[:n]
+
+
 def _stage1_roundtrip_piece(
     piece: jax.Array,
     cc: CompressionConfig,
@@ -191,69 +264,53 @@ def _stage1_roundtrip_piece(
     (quantized_allreduce / hierarchical_allreduce prologues): exact wires
     (PSUM reduction, compression off for the stage, dummy codec, ws == 1
     without the force-codec knob) round-trip unchanged — zero residual."""
-    from ..ops import dispatch
-    from .reducers import _chunk_size, _pad_rows, _phase_key, quantized_allreduce
-
     if cfg_mod.dummy_compression():
         return piece  # pass-through codec decodes exactly
 
     if len(axes) == 2:
-        # hierarchical_allreduce prologue (reducers.py): per-level keys and
-        # ws==1 routing must match or the residual measures a different
-        # quantization than the wire's.
+        # hierarchical_allreduce prologue (reducers.py): per-level keys,
+        # per-level configs and ws==1 routing must match or the residual
+        # measures a different quantization than the wire's.
         cross_axis, intra_axis = axes
         ws_intra = mesh.shape[intra_axis]
         ws_cross = mesh.shape[cross_axis]
         key_intra = jax.random.fold_in(key, 3) if key is not None else None
         key_cross = jax.random.fold_in(key, 5) if key is not None else None
+        intra_cc = cc if topo.intra_compress else CompressionConfig(bits=32)
+        cross_cc = cc if topo.cross_compress else CompressionConfig(bits=32)
         if ws_intra == 1 and ws_cross == 1:
             return piece
         if ws_intra == 1:
-            if not topo.cross_compress:
-                return piece
-            return _stage1_roundtrip_piece(
-                piece, cc, mesh=mesh, axes=(cross_axis,),
-                topo=dataclasses.replace(
-                    topo, intra_reduction=topo.cross_reduction
-                ),
-                key=key_cross,
+            return _roundtrip_wire_1axis(
+                piece, cross_cc, axis=cross_axis, ws=ws_cross,
+                red=topo.cross_reduction, key=key_cross,
             )
-        # Stage 1 = the intra level (both the leader scheme's
-        # reduce-scatter and the non-leader full intra allreduce quantize
-        # the same (ws, chunk) rows first).
-        if not topo.intra_compress:
-            # Stage 1 is an exact psum; the later cross-stage quantization
-            # acts on the *shared* reduced chunk, which per-device EF
-            # cannot attribute — treat the wire as exact (EF no-op).
+        if ws_cross == 1 or not topo.intra_broadcast:
+            # Stage 1 = a full intra allreduce via quantized_allreduce
+            # (the non-leader scheme, or the degenerate single-node mesh).
+            return _roundtrip_wire_1axis(
+                piece, intra_cc, axis=intra_axis, ws=ws_intra,
+                red=topo.intra_reduction, key=key_intra,
+            )
+        # Leader scheme: stage 1 is the quantized intra reduce-scatter iff
+        # intra compression is on — otherwise an exact psum_scatter, and
+        # the later cross-stage quantization acts on the *shared* reduced
+        # chunk, which per-device EF cannot attribute (treated exact).
+        if not intra_cc.enabled:
             return piece
-        axis, ws, k = intra_axis, ws_intra, key_intra
-        red = topo.intra_reduction
-    else:
-        axis = axes[0]
-        ws = mesh.shape[axis]
-        red = (
-            topo.intra_reduction
-            if axis != mesh_mod.CROSS_AXIS
-            else topo.cross_reduction
+        return _roundtrip_wire_1axis(
+            piece, intra_cc, axis=intra_axis, ws=ws_intra,
+            red=topo.intra_reduction, key=key_intra, leader_rs=True,
         )
-        k = key
-        if ws == 1:
-            # ws==1 runs no collective: identity, or the force-codec proxy
-            # round trip — quantized_allreduce's own ws==1 branch IS the
-            # wire, so reuse it verbatim.
-            return quantized_allreduce(piece, axis, 1, cc, red, k)
-
-    if not cc.enabled or red == cfg_mod.REDUCTION_PSUM:
-        return piece
-    # SRA/all-to-all/Ring all quantize the 32-aligned (ws, chunk) rows
-    # first (reduce_scatter_quantized / ring segments); Ring's later
-    # per-hop requantizations act on accumulated sums and are not
-    # per-device-attributable — first-hop measurement is the EF residual.
-    k = _phase_key(k, 1, axis)
-    rows = _pad_rows(piece, ws, _chunk_size(piece.shape[0], ws))
-    q = dispatch.quantize_batch(rows, cc, k if cc.stochastic else None)
-    rt = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
-    return rt.reshape(-1)[: piece.shape[0]]
+    axis = axes[0]
+    red = (
+        topo.intra_reduction
+        if axis != mesh_mod.CROSS_AXIS
+        else topo.cross_reduction
+    )
+    return _roundtrip_wire_1axis(
+        piece, cc, axis=axis, ws=mesh.shape[axis], red=red, key=key
+    )
 
 
 def _local_roundtrip_flat(

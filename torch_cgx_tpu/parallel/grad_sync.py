@@ -34,12 +34,41 @@ from .allreduce import allreduce_tree
 
 
 class ErrorFeedbackState(NamedTuple):
-    """Per-device residual of the quantized gradient transport. NOTE: this
-    state VARIES across data-parallel devices — under shard_map it must be
-    sharded (leading device axis or explicit per-device placement), never
-    declared replicated."""
+    """Per-device residual of the quantized gradient transport.
+
+    HAZARD: this state VARIES across data-parallel devices — under
+    shard_map it must be sharded (leading device axis or explicit
+    per-device placement), NEVER declared replicated (``in_specs=P()``):
+    XLA would then fold the divergent per-device residuals into one
+    replica value and silently corrupt the correction. The safe wiring is
+    ``make_train_step(..., error_feedback=True)`` +
+    :func:`init_error_feedback`, which place the state on the device axis
+    for you.
+    """
 
     e: optax.Updates
+
+
+_EF_PLACEMENT_WARNED = False
+
+
+def _warn_ef_placement_once():
+    """One-time trace-time reminder that EF state is per-device (the
+    docstring-only hazard promoted to a runtime signal — advisor r3)."""
+    global _EF_PLACEMENT_WARNED
+    if _EF_PLACEMENT_WARNED:
+        return
+    _EF_PLACEMENT_WARNED = True
+    import warnings
+
+    warnings.warn(
+        "error_feedback=True carries PER-DEVICE residual state: inside "
+        "shard_map the ErrorFeedbackState must be sharded over the device "
+        "axis, not declared replicated (in_specs=P()), or the residuals "
+        "are silently corrupted. Use make_train_step(error_feedback=True) "
+        "with init_error_feedback for the safe wiring.",
+        stacklevel=3,
+    )
 
 
 def _ef_sync(grads, e, *, mesh, axes, topology, key, divisor):
@@ -127,6 +156,7 @@ def compressed_allreduce_transform(
                               topology=topology, average=average),
                 state,
             )
+        _warn_ef_placement_once()
         reduced, e_new = _ef_sync(
             updates, state.e, mesh=mesh, axes=axes, topology=topology,
             key=None, divisor=ws_total if average else 1,
