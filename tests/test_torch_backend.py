@@ -358,6 +358,86 @@ def _worker_collectives(rank: int, ws: int) -> None:
     cfg.clear_registry()
 
 
+def _worker_alltoall_base(rank: int, ws: int) -> None:
+    """dist.all_to_all_single — even split (MPI_Alltoall analogue) and
+    uneven splits (MPI_Alltoallv), ProcessGroupCGX.cc:638-705."""
+    import torch
+    import torch.distributed as dist
+
+    # Even split: rank r sends slice j the values r*ws + j.
+    inp = torch.arange(ws * 3, dtype=torch.float32) + rank * ws * 3
+    out = torch.empty(ws * 3, dtype=torch.float32)
+    dist.all_to_all_single(out, inp)
+    want = torch.cat(
+        [torch.arange(3, dtype=torch.float32) + j * ws * 3 + rank * 3
+         for j in range(ws)]
+    )
+    assert torch.equal(out, want), (rank, out, want)
+
+    # Non-contiguous output (stride-2 column view): results must land in
+    # the caller's tensor, not a detached reshape copy.
+    big = torch.zeros(ws * 3, 2)
+    outc = big[:, 0]
+    dist.all_to_all_single(outc, inp)
+    assert torch.equal(big[:, 0], want), (rank, big[:, 0], want)
+    assert torch.equal(big[:, 1], torch.zeros(ws * 3))
+
+    # Even split, 2-D rows (dim-0 divides; trailing dims ride along).
+    inp2 = torch.arange(ws * 2 * 4, dtype=torch.float32).reshape(ws * 2, 4) + rank * 1000
+    out2 = torch.empty_like(inp2)
+    dist.all_to_all_single(out2, inp2)
+    for j in range(ws):
+        want_j = (
+            torch.arange(2 * 4, dtype=torch.float32).reshape(2, 4)
+            + rank * 2 * 4 + j * 1000
+        )
+        assert torch.equal(out2[j * 2 : (j + 1) * 2], want_j)
+
+    # Uneven splits (alltoallv): rank r sends j a block of (j + 1) rows;
+    # rank r receives (r + 1) rows from every peer.
+    in_splits = [j + 1 for j in range(ws)]
+    out_splits = [rank + 1] * ws
+    inp3 = torch.cat(
+        [torch.full((j + 1,), float(rank * 100 + j)) for j in range(ws)]
+    )
+    out3 = torch.empty(sum(out_splits), dtype=torch.float32)
+    dist.all_to_all_single(
+        out3, inp3, output_split_sizes=out_splits, input_split_sizes=in_splits
+    )
+    want3 = torch.cat(
+        [torch.full((rank + 1,), float(j * 100 + rank)) for j in range(ws)]
+    )
+    assert torch.equal(out3, want3), (rank, out3, want3)
+
+    # Uneven with zero-sized splits and int64 payloads.
+    in_splits = [0 if j % 2 else 2 for j in range(ws)]
+    out_splits = [0 if rank % 2 else 2 for _ in range(ws)]
+    inp4 = torch.arange(sum(in_splits), dtype=torch.int64) + rank * 10
+    out4 = torch.empty(sum(out_splits), dtype=torch.int64)
+    dist.all_to_all_single(
+        out4, inp4, output_split_sizes=out_splits, input_split_sizes=in_splits
+    )
+    if rank % 2 == 0:
+        want4 = torch.cat(
+            [torch.arange(2, dtype=torch.int64)
+             + j * 10 + sum(in_splits[:rank]) for j in range(ws)]
+        )
+        assert torch.equal(out4, want4), (rank, out4, want4)
+    else:
+        assert out4.numel() == 0
+
+    # Mismatched split-size validation raises on the calling thread.
+    try:
+        dist.all_to_all_single(
+            torch.empty(4), torch.empty(5),
+            output_split_sizes=[], input_split_sizes=[],
+        )
+    except Exception:
+        pass
+    else:
+        raise AssertionError("uneven dim-0 with even split did not raise")
+
+
 def _worker_ddp(rank: int, ws: int) -> None:
     import torch
     import torch.distributed as dist
@@ -529,6 +609,76 @@ def _worker_fsdp(rank: int, ws: int) -> None:
     dist.barrier()
 
 
+def _worker_fsdp_quantized_allgather(rank: int, ws: int) -> None:
+    """CGX_FSDP_ALLGATHER_BITS: the parameter all-gather (the half of
+    ZeRO-3's traffic reduce_scatter_tensor leaves raw) rides an 8-bit
+    max-min wire — decoded identically on every rank, within the bucket
+    envelope, and the full quantized-both-ways workflow still trains."""
+    import os
+
+    import torch
+    import torch.distributed as dist
+
+    n = 640
+    base = torch.linspace(-1, 1, n)
+    shard = base * (rank + 1)
+
+    # Default (bits=0): raw exact gather.
+    full = torch.zeros(n * ws)
+    dist.all_gather_into_tensor(full, shard)
+    for j in range(ws):
+        assert torch.equal(full[j * n : (j + 1) * n], base * (j + 1))
+
+    # 8-bit wire: per-bucket envelope + nonzero error (it really quantized).
+    os.environ["CGX_FSDP_ALLGATHER_BITS"] = "8"
+    os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = "128"
+    full_q = torch.zeros(n * ws)
+    dist.all_gather_into_tensor(full_q, shard)
+    for j in range(ws):
+        seg = full_q[j * n : (j + 1) * n]
+        ref = base * (j + 1)
+        err = (seg - ref).abs().max().item()
+        bucket_range = (j + 1) * 2 * 127 / (n - 1)
+        bound = bucket_range / (2**8 - 1) / 2 + 1e-6
+        assert 0 < err <= bound, (j, err, bound)
+
+    # Error symmetry: every rank decoded identical bytes.
+    mx, mn = full_q.clone(), full_q.clone()
+    dist.all_reduce(mx, op=dist.ReduceOp.MAX)
+    dist.all_reduce(mn, op=dist.ReduceOp.MIN)
+    assert torch.equal(mx, mn), "gathered params differ across ranks"
+
+    # ZeRO-3 loop with BOTH directions compressed still trains.
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "8"
+    torch.manual_seed(0)
+    d_in, d_out = 32, 8
+    w = torch.randn(d_in, d_out) * 0.1
+    flat = w.reshape(-1)
+    pn = flat.numel()
+    shard_n = -(-pn // ws)
+    padded = torch.cat([flat, torch.zeros(shard_n * ws - pn)])
+    my_shard = padded[rank * shard_n : (rank + 1) * shard_n].clone()
+    torch.manual_seed(17)
+    x_all = torch.randn(ws * 16, d_in)
+    y_all = x_all @ torch.randn(d_in, d_out)
+    x = x_all[rank * 16 : (rank + 1) * 16]
+    y = y_all[rank * 16 : (rank + 1) * 16]
+    losses = []
+    for _ in range(50):
+        fullp = torch.zeros(shard_n * ws)
+        dist.all_gather_into_tensor(fullp, my_shard)
+        wt = fullp[:pn].reshape(d_in, d_out).detach().requires_grad_(True)
+        loss = ((x @ wt - y) ** 2).mean()
+        loss.backward()
+        g = torch.cat([wt.grad.reshape(-1), torch.zeros(shard_n * ws - pn)])
+        gshard = torch.zeros(shard_n)
+        dist.reduce_scatter_tensor(gshard, g, op=dist.ReduceOp.AVG)
+        my_shard = my_shard - 0.05 * gshard
+        losses.append(float(loss))
+    assert losses[-1] < 0.3 * losses[0], losses
+    dist.barrier()
+
+
 # ---------------------------------------------------------------------------
 # Tests.
 # ---------------------------------------------------------------------------
@@ -542,6 +692,16 @@ def test_collectives_ws2():
 @pytest.mark.torch_bridge
 def test_collectives_ws4():
     _launch(_worker_collectives, ws=4, timeout=360.0)
+
+
+@pytest.mark.torch_bridge
+def test_alltoall_base_ws2():
+    _launch(_worker_alltoall_base, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_alltoall_base_ws4():
+    _launch(_worker_alltoall_base, ws=4)
 
 
 @pytest.mark.torch_bridge
@@ -567,6 +727,16 @@ def test_sharded_collectives_ws4():
 @pytest.mark.torch_bridge
 def test_fsdp_training_ws2():
     _launch(_worker_fsdp, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_fsdp_quantized_allgather_ws2():
+    _launch(_worker_fsdp_quantized_allgather, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_fsdp_quantized_allgather_ws4():
+    _launch(_worker_fsdp_quantized_allgather, ws=4)
 
 
 def _worker_subgroup(rank: int, ws: int) -> None:

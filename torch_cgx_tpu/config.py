@@ -49,8 +49,11 @@ DEBUG_ALL_TO_ALL_REDUCTION = "CGX_DEBUG_ALL_TO_ALL_REDUCTION"
 DEBUG_FORCE_CODEC = "CGX_DEBUG_FORCE_CODEC"
 STANDALONE_LAYER_ELEMS = "CGX_STANDALONE_LAYER_ELEMS"
 # TPU-only additions (no reference analogue):
+FSDP_ALLGATHER_BITS = "CGX_FSDP_ALLGATHER_BITS"  # 0 (off, default) | 2..8
 STOCHASTIC_ROUNDING = "CGX_STOCHASTIC_ROUNDING"  # QSGD_DETERMENISTIC inverse
 CODEC_IMPL = "CGX_CODEC_IMPL"  # "xla" | "pallas" | "auto"
+CODEC_ENCODE = "CGX_CODEC_ENCODE"  # "div" (byte-identical) | "mul" (fast)
+METRICS_RUNTIME = "CGX_METRICS_RUNTIME"  # per-execution wire counters
 BRIDGE_DEVICE_CODEC = "CGX_BRIDGE_DEVICE_CODEC"  # "auto" | "on" | "off"
 BRIDGE_DEVICE_MIN_NUMEL = "CGX_BRIDGE_DEVICE_MIN_NUMEL"
 SEED = "CGX_SEED"
@@ -218,6 +221,35 @@ def force_codec() -> bool:
     single chip measure the codec work each rank performs inside SRA — the
     bench harness's north-star proxy uses it."""
     return _env.get_bool_env_or_default(DEBUG_FORCE_CODEC, False)
+
+
+def runtime_metrics() -> bool:
+    """CGX_METRICS_RUNTIME: bump wire-traffic counters at EXECUTION time via
+    a host callback (one per compiled allreduce group per step per device
+    program), not just at trace time — runtime observability the reference's
+    printf-only logging lacks (SURVEY §5.5). Off by default: the callback
+    costs a host round trip per step."""
+    return _env.get_bool_env_or_default(METRICS_RUNTIME, False)
+
+
+def fsdp_allgather_config() -> Optional["CompressionConfig"]:
+    """CGX_FSDP_ALLGATHER_BITS: compress the FSDP *parameter* all-gather
+    (``all_gather_into_tensor``) at this many bits — the other half of
+    ZeRO-3's per-step traffic, which the gradient reduce-scatter codec
+    leaves raw. 0 (default) disables; 2-8 enable a max-min wire at that
+    width using the default bucket size. The reference cannot run FSDP at
+    all (ProcessGroupCGX.cc:631-636 throws), so this knob is beyond-
+    reference completion, default-off for exactness.
+    """
+    bits = _env.get_int_env_or_default(FSDP_ALLGATHER_BITS, 0)
+    if bits <= 0:
+        return None
+    if not 2 <= bits <= MAX_BITS:
+        raise ValueError(
+            f"{FSDP_ALLGATHER_BITS} must be 0 (off) or 2..{MAX_BITS}, got {bits}"
+        )
+    base = default_compression_config()
+    return dataclasses.replace(base, bits=bits)
 
 
 def standalone_layer_elems() -> int:

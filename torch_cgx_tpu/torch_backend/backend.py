@@ -19,12 +19,17 @@ MPI/CUDA: the *architecture* is preserved —
 * the requantize + self-dequantize **error-symmetry step** on the reduced
   chunk (scatter_reduce_allgather.cc:157-160) so exactness oracles hold,
 * thin uncompressed wrappers for broadcast / allgather / gather / scatter /
-  alltoall / send / recv / barrier (ProcessGroupCGX.cc:341-833),
+  alltoall / send / recv / barrier (ProcessGroupCGX.cc:341-833), plus
+  ``alltoall_base`` with even (MPI_Alltoall) and uneven (MPI_Alltoallv)
+  splits — the ``dist.all_to_all_single`` entry point
+  (ProcessGroupCGX.cc:638-705),
 * ``all_gather_into_tensor`` / ``reduce_scatter_tensor`` — the collectives
   FSDP/ZeRO sharding is built from; the reference throws on both
   (ProcessGroupCGX.cc:631-636,827-833), which is why FSDP can never run on
   it. ``reduce_scatter_tensor`` compresses eligible float chunks (it is the
-  scatter-reduce half of SRA), and
+  scatter-reduce half of SRA); ``all_gather_into_tensor`` compresses the
+  parameter gather when ``CGX_FSDP_ALLGATHER_BITS`` is set (both halves of
+  ZeRO-3's per-step traffic ride the wire format), and
 * NotImplementedError on ``allreduce_coalesced`` like the reference
   (ProcessGroupCGX.cc:422-428).
 
@@ -856,6 +861,102 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
         return self._submit(run, output_tensors)
 
+    def _a2a_lengths(self, t: torch.Tensor, splits) -> Tuple[List[int], List[int]]:
+        """Per-destination element (length, offset) pairs for alltoall_base —
+        the c10d computeLengthsAndOffsets semantics: split sizes count dim-0
+        rows; empty splits mean the even split (ProcessGroupCGX.cc:645-650,
+        673-680)."""
+        ws = self._size
+        n = t.numel()
+        if not splits:
+            dim0 = t.shape[0] if t.dim() else 0
+            if dim0 % ws:
+                raise ValueError(
+                    f"cgx alltoall_base: tensor dim 0 ({dim0}) does not "
+                    f"divide equally across group size {ws}"
+                )
+            lens = [n // ws] * ws
+        else:
+            if len(splits) != ws:
+                raise ValueError(
+                    f"cgx alltoall_base: {len(splits)} split sizes for "
+                    f"group size {ws}"
+                )
+            dim0 = t.shape[0] if t.dim() else 0
+            if sum(int(s) for s in splits) != dim0:
+                raise ValueError(
+                    f"cgx alltoall_base: split sizes sum to "
+                    f"{sum(int(s) for s in splits)}, tensor dim 0 is {dim0}"
+                )
+            row = n // dim0 if dim0 else 0
+            lens = [int(s) * row for s in splits]
+        offs, acc = [], 0
+        for ln in lens:
+            offs.append(acc)
+            acc += ln
+        return lens, offs
+
+    def alltoall_base(
+        self, output, input, output_split_sizes, input_split_sizes, opts=None
+    ):
+        """Single-tensor all-to-all — even (MPI_Alltoall) and uneven
+        (MPI_Alltoallv) splits, the ``dist.all_to_all_single`` entry point
+        (ProcessGroupCGX.cc:638-705)."""
+        if output.dtype != input.dtype:
+            raise ValueError(
+                "cgx alltoall_base: tensors are not equal in data type"
+            )
+        # Validate on the calling thread, like the reference's TORCH_CHECKs
+        # before enqueue.
+        in_lens, in_offs = self._a2a_lengths(input, input_split_sizes)
+        out_lens, out_offs = self._a2a_lengths(output, output_split_sizes)
+        seq = self._next_seq()
+        ws, me = self._size, self._rank
+
+        def run():
+            key = f"cgx{seq}a2b"
+            flat_in = input.detach().contiguous().reshape(-1)
+            # reshape(-1) of a non-contiguous output is a detached copy —
+            # stage there and copy back stride-aware at the end (same
+            # hazard as _allgather_base).
+            contig = output.is_contiguous()
+            flat_out = (
+                output.detach().reshape(-1)
+                if contig
+                else torch.empty(output.numel(), dtype=output.dtype)
+            )
+            for j in range(ws):
+                if j == me:
+                    continue
+                piece = flat_in[in_offs[j] : in_offs[j] + in_lens[j]]
+                self._put(
+                    f"{key}/{me}>{j}",
+                    self._bytes_of(piece) if in_lens[j] else b"",
+                )
+            with torch.no_grad():
+                flat_out[out_offs[me] : out_offs[me] + out_lens[me]].copy_(
+                    flat_in[in_offs[me] : in_offs[me] + in_lens[me]]
+                )
+                for j in range(ws):
+                    if j == me:
+                        continue
+                    buf = self._take(f"{key}/{j}>{me}")
+                    got = buf.size // flat_out.element_size()
+                    if got != out_lens[j]:
+                        raise RuntimeError(
+                            f"cgx alltoall_base: rank {j} sent {got} elements "
+                            f"but rank {me}'s output splits expect "
+                            f"{out_lens[j]} — mismatched split sizes"
+                        )
+                    if got:
+                        flat_out[
+                            out_offs[j] : out_offs[j] + out_lens[j]
+                        ].copy_(torch.from_numpy(buf.copy()).view(output.dtype))
+                if not contig:
+                    output.copy_(flat_out.reshape(output.shape))
+
+        return self._submit(run, [output])
+
     def barrier(self, opts=None):
         seq = self._next_seq()
 
@@ -992,6 +1093,15 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
     def _allgather_base(self, output, input, opts=None):
         seq = self._next_seq()
+        cc = cfg.fsdp_allgather_config()
+        compress = (
+            cc is not None
+            and cc.enabled
+            and self._size > 1
+            and input.dtype in _TORCH_FLOATS
+            and input.numel() >= cfg.minimal_size()
+            and not cfg.dummy_compression()
+        )
 
         def run():
             key = f"cgx{seq}agb"
@@ -1002,16 +1112,42 @@ class ProcessGroupCGX(dist.ProcessGroup):
             flat = output.reshape(-1) if contig else torch.empty(
                 output.numel(), dtype=output.dtype
             )
-            self._put(f"{key}/{self._rank}", self._bytes_of(input))
-            for j in range(self._size):
-                dst = flat[j * n : (j + 1) * n]
-                if j == self._rank:
+            if compress:
+                # Quantized parameter all-gather (CGX_FSDP_ALLGATHER_BITS):
+                # each rank frames its shard once; EVERY rank — the owner
+                # included — decodes the same wire bytes, so all replicas of
+                # the gathered parameter are bit-identical (the error-
+                # symmetry invariant, applied to ZeRO-3's unsharding).
+                wdt = _wire_dtype(input.dtype)
+                seg = [_Segment(0, n, cc.bits, cc.bucket_size)]
+                arr = _to_np(input).astype(np.float32, copy=False)
+                wire = _compress_frames(
+                    arr, seg, False, self._stochastic_rng(), wdt
+                )
+                self._put(f"{key}/{self._rank}", wire)
+                scratch = np.empty(n, np.float32)
+                for j in range(self._size):
+                    if j == self._rank:
+                        buf = np.frombuffer(wire, np.uint8)
+                    else:
+                        buf = self._take(
+                            f"{key}/{j}", readers=self._size - 1
+                        )
+                    _decompress_frames(
+                        buf, seg, scratch, False, add=False, wire_dtype=wdt
+                    )
+                    _from_np(flat[j * n : (j + 1) * n], scratch)
+            else:
+                self._put(f"{key}/{self._rank}", self._bytes_of(input))
+                for j in range(self._size):
+                    dst = flat[j * n : (j + 1) * n]
+                    if j == self._rank:
+                        with torch.no_grad():
+                            dst.copy_(input.reshape(-1))
+                        continue
+                    buf = self._take(f"{key}/{j}", readers=self._size - 1)
                     with torch.no_grad():
-                        dst.copy_(input.reshape(-1))
-                    continue
-                buf = self._take(f"{key}/{j}", readers=self._size - 1)
-                with torch.no_grad():
-                    dst.copy_(self._tensor_from(buf, dst))
+                        dst.copy_(self._tensor_from(buf, dst))
             if not contig:
                 with torch.no_grad():
                     output.copy_(flat.reshape(output.shape))
