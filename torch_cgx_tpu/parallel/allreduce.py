@@ -74,6 +74,23 @@ def resolve_leaf_config(
     return cc
 
 
+def _runtime_count(name: str, n: int) -> None:
+    """Execution-time counter bump (CGX_METRICS_RUNTIME): an effectful host
+    callback baked into the traced program, so `metrics` reflects steps
+    actually run, not programs traced (reference gap §5.5, VERDICT r3 weak
+    #5). No-op (nothing staged) when the knob is off at trace time."""
+    if not cfg_mod.runtime_metrics():
+        return
+    from jax.experimental import io_callback
+
+    io_callback(
+        lambda v: metrics.add(name, float(v)),
+        None,
+        jnp.float32(n),
+        ordered=False,
+    )
+
+
 @dataclasses.dataclass(frozen=True)
 class _Group:
     cc: CompressionConfig
@@ -242,12 +259,16 @@ def _roundtrip_wire_1axis(
         rows = lax.dynamic_update_slice(rows, rt_own, (rank, 0))
         return rows.reshape(-1)[:n]
     # SRA: stage-1 quantizes the (ws, chunk) rows with the phase-1 key
-    # (reduce_scatter_quantized). The allgather-phase requantization acts on
-    # the reduced chunk — not per-device-attributable, treated exact.
+    # (reduce_scatter_quantized) — except the own row, whose quantized copy
+    # the reducer discards in favor of the raw chunk (exact round trip).
+    # The allgather-phase requantization acts on the reduced chunk — not
+    # per-device-attributable, treated exact.
     k = _phase_key(key, 1, axis)
     rows = _pad_rows(piece, ws, chunk)
     q = dispatch.quantize_batch(rows, cc, k if cc.stochastic else None)
     rt = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
+    own = (jnp.arange(ws) == lax.axis_index(axis))[:, None]
+    rt = jnp.where(own, rows.astype(rt.dtype), rt)
     return rt.reshape(-1)[:n]
 
 
@@ -398,11 +419,14 @@ def allreduce_tree(
         with named_scope(
             f"cgx_allreduce_b{g.cc.bits}_{np.dtype(g.dtype).name}"
         ):
-            # NOTE: these counters increment at *trace* time (once per
-            # compiled program), so they measure elems per traced allreduce
-            # program, not per executed step.
+            # NOTE: the trace.* counters increment at *trace* time (once per
+            # compiled program); with CGX_METRICS_RUNTIME=1 the runtime.*
+            # counters additionally bump per EXECUTION through a host
+            # callback (per device program — divide by the device count for
+            # per-step totals).
             if g.cc.enabled:
                 metrics.add("trace.allreduce.compressed_elems", float(fused.shape[0]))
+                _runtime_count("runtime.allreduce.compressed_elems", fused.shape[0])
                 reduced = allreduce_flat(
                     fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                     key=g_key,
@@ -414,6 +438,7 @@ def allreduce_tree(
                     )
             else:
                 metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))
+                _runtime_count("runtime.allreduce.raw_elems", fused.shape[0])
                 reduced = fused
                 if return_roundtrip:
                     rt_flat = fused  # exact wire: zero residual

@@ -119,7 +119,10 @@ def reduce_scatter_quantized(
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
     """SRA round 1 (scatter_reduce_allgather.cc:116-155): quantize the peers'
-    chunks, exchange via all_to_all, decompress-accumulate own chunk.
+    chunks, exchange via all_to_all, decompress-accumulate into the RAW own
+    chunk — one's own contribution stays exact during scatter-reduce, like
+    the reference (it accumulates peers into the unquantized owned slice,
+    .cc:116-155); only the ws-1 peer contributions carry quantization error.
 
     Returns this device's reduced chunk, float32[chunk_size(n, ws)].
     """
@@ -128,6 +131,10 @@ def reduce_scatter_quantized(
     q = _quantize_rows(xs, cc, key)
     q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
     vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
+    # The row arriving from oneself is one's own quantized chunk — swap in
+    # the raw values instead (free accuracy the SPMD form doesn't forfeit).
+    own = (jnp.arange(ws) == lax.axis_index(axis_name))[:, None]
+    vals = jnp.where(own, xs.astype(jnp.float32), vals)
     return jnp.sum(vals, axis=0)
 
 
