@@ -59,6 +59,28 @@ HBM_GBPS = {
 }
 
 
+BENCH_LOG = Path(__file__).resolve().parent / "BENCH_LOG.jsonl"
+
+
+def log_jsonl(record: dict) -> None:
+    """Append a structured perf record to the committed BENCH_LOG.jsonl so
+    round-over-round performance is diffable as data, not prose (VERDICT r3
+    missing #1 / next #5 — the round-3 transport incident erased a whole
+    round's evidence because nothing persisted per-variant results)."""
+    rec = dict(record)
+    rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S"))
+    try:
+        rec.setdefault("chip", jax.devices()[0].device_kind)
+        rec.setdefault("backend", jax.default_backend())
+    except Exception:
+        pass  # never let logging break (or hang) the measurement itself
+    try:
+        with open(BENCH_LOG, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass
+
+
 def _preflight_lint() -> None:
     proc = subprocess.run(
         [sys.executable, str(Path(__file__).parent / "tools" / "lint.py")],
@@ -368,14 +390,24 @@ def _device_watchdog(seconds: float = 300.0):
     def fire():
         if done.wait(seconds):
             return
-        print(json.dumps({
+        failure = {
             "metric": "device_init_failure",
             "value": 0,
             "unit": "none",
             "vs_baseline": 0,
             "detail": {"error": f"jax.devices() not ready in {seconds:.0f}s "
                                 "(device transport unreachable?)"},
-        }), flush=True)
+        }
+        try:  # record the incident as data (must not call jax.devices())
+            with open(BENCH_LOG, "a") as f:
+                f.write(json.dumps({
+                    "tool": "bench",
+                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+                    **failure,
+                }) + "\n")
+        except OSError:
+            pass
+        print(json.dumps(failure), flush=True)
         os._exit(2)
 
     threading.Thread(target=fire, daemon=True).start()
@@ -393,6 +425,7 @@ def main() -> None:
         on_tpu = jax.default_backend() == "tpu"
         result = bench_codec(on_tpu)
         result["detail"]["train_step"] = bench_train_step(on_tpu)
+    log_jsonl({"tool": "bench", **result})
     print(json.dumps(result))
 
 

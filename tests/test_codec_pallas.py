@@ -265,3 +265,60 @@ def test_butterfly_pack_byte_identity(monkeypatch, shape_case):
     monkeypatch.setenv("CGX_PALLAS_PACK", "bogus")
     with pytest.raises(ValueError, match="CGX_PALLAS_PACK"):
         codec_pallas.quantize_batch(xs, bits, b, interpret=True)
+
+
+def test_mul_encode_envelope_and_constant_exact(monkeypatch):
+    """CGX_CODEC_ENCODE=mul (reciprocal-multiply level encode): trades
+    strict cross-impl byte-identity (last-ulp ties may pick the adjacent
+    level) for encode throughput. The error envelope, constant-bucket
+    exactness, and decode round trip must all still hold."""
+    monkeypatch.setenv("CGX_CODEC_ENCODE", "mul")
+    bits, bucket = 4, 512
+    rows, m = 2, 64 * bucket
+    rng = np.random.default_rng(3)
+    xs = jnp.asarray(rng.normal(size=(rows, m)), jnp.float32)
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    y = codec_pallas.dequantize_batch(q, interpret=True, out_dtype=jnp.float32)
+    unit = np.asarray(q.meta, np.float32)[..., 0].max()
+    assert np.abs(np.asarray(y) - np.asarray(xs)).max() <= unit / 2 + 1e-6
+    # differs from the div encode in at most a tiny fraction of levels, and
+    # any differing value is off by exactly one level
+    q_div = jax.vmap(lambda r: codec.quantize(r, bits, bucket))(xs)
+    y_div = jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=jnp.float32))(q_div)
+    diff = np.abs(np.asarray(y) - np.asarray(y_div))
+    assert (diff <= unit * 1.01).all()
+    # diffs below unit/10 are last-ulp decode arithmetic, not level moves
+    moved = np.mean(diff > unit * 0.1)
+    assert moved < 1e-3, f"{moved:%} of levels moved"
+    # constants stay bit-exact
+    const = jnp.full((1, m), 2.75, jnp.float32)
+    qc = codec_pallas.quantize_batch(const, bits, bucket, interpret=True)
+    yc = codec_pallas.dequantize_batch(qc, interpret=True, out_dtype=jnp.float32)
+    np.testing.assert_array_equal(np.asarray(yc), np.asarray(const))
+
+
+@pytest.mark.tpu  # compiled Mosaic lowering of the butterfly pack
+def test_flat_pack_butterfly_tpu(monkeypatch):
+    monkeypatch.setenv("CGX_PALLAS_PACK", "butterfly")
+    bits, bucket = 4, 512
+    xs = jnp.asarray(
+        np.random.default_rng(5).normal(size=(2, 64 * bucket)), jnp.float32
+    )
+    q_p = codec_pallas.quantize_batch(xs, bits, bucket)
+    monkeypatch.delenv("CGX_PALLAS_PACK")
+    q_s = codec_pallas.quantize_batch(xs, bits, bucket)
+    np.testing.assert_array_equal(np.asarray(q_p.packed), np.asarray(q_s.packed))
+    np.testing.assert_array_equal(np.asarray(q_p.meta), np.asarray(q_s.meta))
+
+
+@pytest.mark.tpu  # compiled Mosaic lowering of the mul encode
+def test_mul_encode_tpu(monkeypatch):
+    monkeypatch.setenv("CGX_CODEC_ENCODE", "mul")
+    bits, bucket = 4, 512
+    xs = jnp.asarray(
+        np.random.default_rng(6).normal(size=(1, 64 * bucket)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket)
+    y = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32)
+    unit = np.asarray(q.meta, np.float32)[..., 0].max()
+    assert np.abs(np.asarray(y) - np.asarray(xs)).max() <= unit / 2 + 1e-6

@@ -49,8 +49,10 @@ def make_variant_kernel(name: str, bits: int, b: int, tc: int):
     maxlvl = np.float32((1 << bits) - 1)
 
     def meta_of(x4):
-        bmax = jnp.max(jnp.max(x4, axis=3, keepdims=True), axis=2, keepdims=True)
-        bmin = jnp.min(jnp.min(x4, axis=3, keepdims=True), axis=2, keepdims=True)
+        # rb axis first (full-width folds), lane reduction on rb x less data
+        # — same order as _quantize_flat_impl.
+        bmax = jnp.max(jnp.max(x4, axis=2, keepdims=True), axis=3, keepdims=True)
+        bmin = jnp.min(jnp.min(x4, axis=2, keepdims=True), axis=3, keepdims=True)
         unit = (bmax - bmin) * np.float32(1.0 / maxlvl)
         safe = jnp.where(unit > 0, unit, np.float32(1.0))
         return unit, bmin, safe
@@ -204,6 +206,20 @@ def main():
         f = run_variant_kernel(args.variant, stack[0], bits, b, tc)
         t = scan_time(f, stack)
 
+    from bench import log_jsonl
+
+    log_jsonl({
+        "tool": "qbench",
+        "variant": args.variant,
+        "tc": tc,
+        "mb": args.mb,
+        "bits": bits,
+        "bucket": b,
+        "pack": os.environ.get("CGX_PALLAS_PACK", "sum"),
+        "encode": os.environ.get("CGX_CODEC_ENCODE", "div"),
+        "t_ms": round(t * 1e3, 3),
+        "gbps_in": round(gb / t, 1),
+    })
     print(
         f"variant={args.variant} tc={tc} mb={args.mb} bits={bits} bucket={b} "
         f"t={t * 1e3:.3f} ms  {gb / t:.1f} GB/s(in)"

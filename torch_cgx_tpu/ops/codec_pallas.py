@@ -108,6 +108,38 @@ def _tile_chunks(n_chunks: int, bucket_size: int, bits: int) -> int:
     return int(min(16, cap, max(1, n_chunks)))
 
 
+def _encode_strategy() -> str:
+    """Level-encode lowering: ``div`` (the default — per-element divide,
+    bit-identical to the XLA/numpy/C++ codecs) or ``mul`` (one reciprocal
+    per bucket + per-element multiply — the per-element VPU divide is the
+    prime suspect for the quantize kernel's roofline gap, PERF_NOTES.md).
+    ``mul`` may differ from the other implementations in the last-ulp tie
+    cases (a value landing within ~1 ulp of a rounding boundary picks the
+    neighboring level); the error envelope and constant-bucket exactness
+    are unaffected, and all devices in a program share one mode, so
+    reducer error symmetry holds. Keep the default for strict cross-impl
+    byte-identity."""
+    raw = (_env.get_optional_str_env("CGX_CODEC_ENCODE") or "div").lower()
+    if raw not in ("div", "mul"):
+        raise ValueError(
+            f"CGX_CODEC_ENCODE={raw!r}: expected 'div' or 'mul'"
+        )
+    return raw
+
+
+def _encode_lvl(x, bmin, safe, r, maxlvl, encode: str):
+    """Shared level encode for the quantize kernels."""
+    if encode == "mul":
+        inv = np.float32(1.0) / safe  # one divide per bucket, not element
+        return jnp.clip(
+            jnp.floor((x - bmin) * inv + r), 0, maxlvl
+        ).astype(jnp.int32)
+    # Divide: byte-identical with the XLA/numpy/C++ codecs.
+    return jnp.clip(
+        jnp.floor((x - bmin) / safe + r), 0, maxlvl
+    ).astype(jnp.int32)
+
+
 def _pack_strategy() -> str:
     """Bit-plane pack lowering: ``sum`` (cross-sublane reduction of shifted
     bits — the default) or ``butterfly`` (log2(32) pairwise shift-OR folds).
@@ -162,7 +194,7 @@ def _stochastic_r(seed_ref, shape):
 
 
 def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, tc,
-                     stochastic, pack="sum"):
+                     stochastic, pack="sum", encode="div"):
     maxlvl = np.float32((1 << bits) - 1)
     x = x_ref[:].astype(jnp.float32)  # (TC*32, B)
     b = x.shape[1]
@@ -172,9 +204,7 @@ def _quantize_kernel(seed_ref, x_ref, words_ref, meta_ref, *, bits, tc,
     unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
     safe = jnp.where(unit > 0, unit, np.float32(1.0))
     r = _stochastic_r(seed_ref, x.shape) if stochastic else np.float32(0.5)
-    # Divide, not multiply-by-reciprocal: keeps levels bit-identical to the
-    # XLA/numpy/C++ codecs.
-    lvl = jnp.clip(jnp.floor((x - bmin) / safe + r), 0, maxlvl).astype(jnp.int32)
+    lvl = _encode_lvl(x, bmin, safe, r, maxlvl, encode)
     lv3 = lvl.reshape(tc, CHUNK_BUCKETS, b)
     planes = _pack_planes(lv3, bits, 1, pack)
     # each (TC, B); disjoint bits -> int32 wrap on the s=31 term is exact
@@ -216,6 +246,7 @@ def _pipe_tc(n_chunks: int, bucket_size: int) -> int:
     jax.jit,
     static_argnames=(
         "bits", "bucket_size", "stochastic", "interpret", "tc", "pack",
+        "encode",
     ),
 )
 def _quantize_flat_impl(
@@ -228,6 +259,7 @@ def _quantize_flat_impl(
     interpret: bool = False,
     tc: int = 8,
     pack: str = "sum",
+    encode: str = "div",
 ):
     """Zero-relayout quantize over rows of full chunks (t_r == 0,
     bucket_size % 128 == 0).
@@ -255,21 +287,20 @@ def _quantize_flat_impl(
 
     def kernel(seed_ref, x_ref, words_ref, meta_ref):
         x4 = x_ref[:].astype(jnp.float32).reshape(tc, CHUNK_BUCKETS, rb, 128)
+        # Reduce the rb (sublane-group) axis FIRST — full-width elementwise
+        # folds — so the expensive cross-lane reduction runs on rb x less
+        # data. Max/min are order-independent: bytes unchanged.
         bmax = jnp.max(
-            jnp.max(x4, axis=3, keepdims=True), axis=2, keepdims=True
+            jnp.max(x4, axis=2, keepdims=True), axis=3, keepdims=True
         )
         bmin = jnp.min(
-            jnp.min(x4, axis=3, keepdims=True), axis=2, keepdims=True
+            jnp.min(x4, axis=2, keepdims=True), axis=3, keepdims=True
         )
         # Reciprocal-multiply like codec.compute_meta (byte-identity).
         unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
         safe = jnp.where(unit > 0, unit, np.float32(1.0))
         r = _stochastic_r(seed_ref, x4.shape) if stochastic else np.float32(0.5)
-        # Divide, not reciprocal-multiply: byte-identity with the other
-        # codec implementations.
-        lvl = jnp.clip(jnp.floor((x4 - bmin) / safe + r), 0, maxlvl).astype(
-            jnp.int32
-        )
+        lvl = _encode_lvl(x4, bmin, safe, r, maxlvl, encode)
         planes = _pack_planes(lvl, bits, 1, pack)
         # disjoint bits -> int32 wrap on the s=31 term is exact
         words_ref[:] = jnp.stack(planes, axis=1).reshape(
@@ -369,6 +400,7 @@ def _dequantize_flat_impl(
     jax.jit,
     static_argnames=(
         "bits", "bucket_size", "stochastic", "interpret", "tc", "pack",
+        "encode",
     ),
 )
 def _quantize_chunks_impl(
@@ -381,6 +413,7 @@ def _quantize_chunks_impl(
     interpret: bool = False,
     tc: int = 8,
     pack: str = "sum",
+    encode: str = "div",
 ):
     """xb: (nb, B) bucket rows, nb % 32 == 0. Returns
     (words (nb//32 * bits, B) uint32, meta (nb, 2) f32)."""
@@ -393,7 +426,7 @@ def _quantize_chunks_impl(
     words, meta = pl.pallas_call(
         functools.partial(
             _quantize_kernel, bits=bits, tc=tc, stochastic=stochastic,
-            pack=pack,
+            pack=pack, encode=encode,
         ),
         grid=(cp // tc,),
         in_specs=[
@@ -509,6 +542,7 @@ def quantize_batch(
             interpret=interpret,
             tc=_pipe_tc(rows * c_r, b),
             pack=_pack_strategy(),
+            encode=_encode_strategy(),
         )
         return codec.QTensor(
             packed=jax.lax.bitcast_convert_type(words, jnp.uint32).reshape(
@@ -535,6 +569,7 @@ def quantize_batch(
             interpret=interpret,
             tc=_tile_chunks(rows * c_r, b, bits),
             pack=_pack_strategy(),
+            encode=_encode_strategy(),
         )
         word_parts.append(words.reshape(rows, c_r * bits * b))
         meta_parts.append(meta.reshape(rows, c_r * CHUNK_BUCKETS, 2))
