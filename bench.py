@@ -69,9 +69,14 @@ def log_jsonl(record: dict) -> None:
     round's evidence because nothing persisted per-variant results)."""
     rec = dict(record)
     rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S"))
+    # NOT setdefault: its default argument evaluates eagerly, which would
+    # probe jax.devices() even when the caller pre-filled the keys (the
+    # watchdog must never touch the backend).
     try:
-        rec.setdefault("chip", jax.devices()[0].device_kind)
-        rec.setdefault("backend", jax.default_backend())
+        if "chip" not in rec:
+            rec["chip"] = jax.devices()[0].device_kind
+        if "backend" not in rec:
+            rec["backend"] = jax.default_backend()
     except Exception:
         pass  # never let logging break (or hang) the measurement itself
     try:
@@ -398,16 +403,13 @@ def _device_watchdog(seconds: float = 300.0):
             "detail": {"error": f"jax.devices() not ready in {seconds:.0f}s "
                                 "(device transport unreachable?)"},
         }
-        try:  # record the incident as data (must not call jax.devices())
-            with open(BENCH_LOG, "a") as f:
-                f.write(json.dumps({
-                    "tool": "bench",
-                    "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-                    **failure,
-                }) + "\n")
-        except OSError:
-            pass
+        # Driver-visible line FIRST: a blocking filesystem write must not
+        # suppress the very failure report the watchdog exists to emit.
         print(json.dumps(failure), flush=True)
+        # Best-effort incident record; chip/backend pre-filled so log_jsonl
+        # never probes the (wedged) backend.
+        log_jsonl({"tool": "bench", "chip": "unreachable",
+                   "backend": "unreachable", **failure})
         os._exit(2)
 
     threading.Thread(target=fire, daemon=True).start()

@@ -106,7 +106,7 @@ def test_compressed_exact_constant_bits(bits):
     check_exact(out, np.full((4096,), EXPECT_CONST, np.float32))
 
 
-@pytest.mark.parametrize("algo", ["sra", "ring"])
+@pytest.mark.parametrize("algo", ["sra", "ring", "alltoall"])
 @pytest.mark.parametrize("bits", [2, 4, 8])
 @pytest.mark.parametrize("bucket_size", [64, 512])
 def test_error_envelope(algo, bits, bucket_size):
@@ -115,6 +115,7 @@ def test_error_envelope(algo, bits, bucket_size):
     fn = {
         "sra": lambda x: reducers.sra_allreduce(x, "dp", WS, cc),
         "ring": lambda x: reducers.ring_allreduce(x, "dp", WS, cc),
+        "alltoall": lambda x: reducers.alltoall_allreduce(x, "dp", WS, cc),
     }[algo]
     inputs = arange_inputs(size)
     out = run_flat(inputs, fn)

@@ -76,6 +76,23 @@ def make_variant_kernel(name: str, bits: int, b: int, tc: int):
     def kernel(x_ref, w_ref, m_ref):
         x4 = x_ref[:].astype(jnp.float32).reshape(tc, CB, rb, 128)
         unit, bmin, safe = meta_of(x4)
+        if name == "metalane":
+            # Lane-major meta store: per chunk one (128,) row holding
+            # [32 units | 32 mins | 64 zeros] — a full-width store instead
+            # of the wire's (., 2) narrow pairs (the transpose back to the
+            # wire layout outside the kernel costs one tiny XLA pass on
+            # n/64 bytes). Measures the remedy for the narrow-store lead,
+            # not just its removal (nometa). Payload identical to current.
+            lvl = jnp.clip(
+                jnp.floor((x4 - bmin) / safe + np.float32(0.5)), 0, maxlvl
+            ).astype(jnp.int32)
+            w_ref[:] = pack_sum(lvl)
+            m_ref[:] = jnp.concatenate(
+                [unit.reshape(tc, CB), bmin.reshape(tc, CB),
+                 jnp.zeros((tc, 64), jnp.float32)],
+                axis=1,
+            )  # (tc, 128)
+            return
         if name == "read":
             w_ref[:] = jnp.broadcast_to(
                 unit.astype(jnp.int32).reshape(tc, 1, 1, 1),
@@ -112,6 +129,14 @@ def run_variant_kernel(name, xs, bits, b, tc):
     rb = b // 128
     n_chunks = rows * m // (CB * b)
     kernel = make_variant_kernel(name, bits, b, tc)
+    if name == "metalane":
+        meta_spec = pl.BlockSpec((tc, 128), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+        meta_shape = jax.ShapeDtypeStruct((n_chunks, 128), jnp.float32)
+    else:
+        meta_spec = pl.BlockSpec((tc * CB, 2), lambda i: (i, 0),
+                                 memory_space=pltpu.VMEM)
+        meta_shape = jax.ShapeDtypeStruct((n_chunks * CB, 2), jnp.float32)
     f = pl.pallas_call(
         kernel,
         grid=(n_chunks // tc,),
@@ -122,12 +147,11 @@ def run_variant_kernel(name, xs, bits, b, tc):
         out_specs=[
             pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((tc * CB, 2), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
+            meta_spec,
         ],
         out_shape=[
             jax.ShapeDtypeStruct((n_chunks * bits * rb, 128), jnp.int32),
-            jax.ShapeDtypeStruct((n_chunks * CB, 2), jnp.float32),
+            meta_shape,
         ],
     )
     return jax.jit(lambda x: f(x.reshape(-1, 128)))
@@ -136,7 +160,7 @@ def run_variant_kernel(name, xs, bits, b, tc):
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("variant", choices=[
-        "current", "butterfly", "mul", "nometa", "read", "dequant",
+        "current", "butterfly", "mul", "nometa", "metalane", "read", "dequant",
     ])
     ap.add_argument("--tc", type=int, default=0, help="tile chunks override")
     ap.add_argument("--mb", type=int, default=128, help="payload MB (fp32)")
@@ -182,6 +206,24 @@ def main():
             )
     else:
         # byte-identity check on a small slice (except bound variants)
+        if args.variant == "metalane":
+            # payload must match the oracle exactly; only the meta LAYOUT
+            # differs by design ([32 units | 32 mins | pad] lane-major rows)
+            ns = CB * b * 2 * tc
+            xsmall = stack[0][:, :ns]
+            words, meta = run_variant_kernel(args.variant, xsmall, bits, b, tc)(xsmall)
+            ref = codec_pallas.quantize_batch(xsmall, bits, b)
+            ref_words = jax.lax.bitcast_convert_type(
+                ref.packed.reshape(-1, 128), jnp.int32
+            )
+            ref_meta = jnp.asarray(ref.meta, jnp.float32).reshape(-1, 2)
+            w_ok = bool(jnp.array_equal(words, ref_words))
+            u_ok = bool(jnp.array_equal(meta[:, :CB].reshape(-1), ref_meta[:, 0]))
+            m_ok = bool(jnp.array_equal(meta[:, CB : 2 * CB].reshape(-1), ref_meta[:, 1]))
+            assert w_ok and u_ok and m_ok, (
+                f"wire mismatch: words={w_ok} units={u_ok} mins={m_ok}"
+            )
+            print("byte_check: ok (meta lane-major by design)")
         if args.variant in ("butterfly", "mul"):
             ns = CB * b * 2 * tc
             xsmall = stack[0][:, :ns]
