@@ -80,15 +80,66 @@ def test_ulysses_rejects_indivisible_heads():
         _run_sharded(fn, mesh, q, k, v)
 
 
-def test_make_sp_attention_rejects_mask():
+def _padding_mask(b=2, s=64, seed=3):
+    """Random trailing-padding mask: batch i keeps a random prefix (always
+    at least the first token, so no query row is fully masked under
+    causal)."""
+    rng = np.random.default_rng(seed)
+    keep = rng.integers(1, s + 1, size=(b,))
+    return jnp.asarray(np.arange(s)[None, :] < keep[:, None])
+
+
+def _run_sharded_mask(fn, mesh, q, k, v, mask):
+    spec = P(None, None, "sp", None)
+    mspec = P(None, "sp")
+    sharded = jax.jit(
+        jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
+            out_specs=spec,
+        )
+    )
+    args = [jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v)]
+    m = jax.device_put(mask, NamedSharding(mesh, mspec))
+    return np.asarray(sharded(*args, m))
+
+
+@pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_attention_padding_mask_matches_dense(impl, causal):
+    """Key-padding masks under sequence parallelism: the ring rotates the
+    mask slice with its K/V block; Ulysses all_gathers the slices — both
+    must reproduce the dense oracle on every non-padded query row."""
+    ws = 4
+    mesh = _mesh(ws)
+    q, k, v = _qkv()
+    mask = _padding_mask()
+    expected = np.asarray(dense_attention(q, k, v, causal=causal, mask=mask))
+    attn = make_sp_attention("sp", impl=impl)
+
+    def fn(q, k, v, m):
+        return attn(q, k, v, causal=causal, mask=m)
+
+    out = _run_sharded_mask(fn, mesh, q, k, v, mask)
+    valid = np.asarray(mask)  # (B, S): compare non-padded query rows only
+    for bi in range(out.shape[0]):
+        np.testing.assert_allclose(
+            out[bi][:, valid[bi]], expected[bi][:, valid[bi]],
+            rtol=2e-5, atol=2e-5,
+        )
+
+
+def test_sp_attention_rejects_nonlocal_mask():
+    """Masks must be the (B, S_local) slice, not the global (B, S) mask —
+    a global mask inside shard_map is a shape bug, caught loudly."""
     attn = make_sp_attention("sp", impl="ring")
     q, k, v = _qkv(s=8)
     mesh = _mesh(2)
 
     def fn(q, k, v):
+        # closed-over GLOBAL mask: (2, 8) against s_local = 4
         return attn(q, k, v, causal=False, mask=jnp.ones((2, 8), bool))
 
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(NotImplementedError, match="key-padding"):
         _run_sharded(fn, mesh, q, k, v)
 
 
@@ -141,6 +192,56 @@ def test_gpt2_with_ring_attention_matches_dense():
         )
     )
     np.testing.assert_allclose(out, expected, rtol=5e-4, atol=5e-4)
+
+
+def test_gpt2_with_sp_padding_mask_matches_dense():
+    """GPT-2 forward with a key-padding mask under ring sequence
+    parallelism equals the dense masked forward on non-padded positions."""
+    from torch_cgx_tpu.models import GPT2, GPT2Config
+
+    mesh = _mesh(4)
+    cfg = GPT2Config.tiny(dtype=jnp.float32)
+    s = 64
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, s), 0, cfg.vocab_size)
+    mask = _padding_mask(b=2, s=s, seed=9)
+
+    dense_model = GPT2(cfg)
+    params = dense_model.init(jax.random.PRNGKey(0), tokens)
+    expected = np.asarray(
+        dense_model.apply(params, tokens, attn_mask=mask, train=False)
+    )
+
+    sp_model = GPT2(cfg, attn_fn=make_sp_attention("sp", impl="ring"))
+
+    def fwd(params, tokens, positions, m):
+        return sp_model.apply(
+            params, tokens, positions=positions, attn_mask=m, train=False
+        )
+
+    tok_spec = P(None, "sp")
+    positions = jnp.broadcast_to(jnp.arange(s)[None, :], tokens.shape)
+    sharded = jax.jit(
+        jax.shard_map(
+            fwd,
+            mesh=mesh,
+            in_specs=(P(), tok_spec, tok_spec, tok_spec),
+            out_specs=tok_spec,
+            check_vma=False,
+        )
+    )
+    out = np.asarray(
+        sharded(
+            jax.device_put(params, NamedSharding(mesh, P())),
+            jax.device_put(tokens, NamedSharding(mesh, tok_spec)),
+            jax.device_put(positions, NamedSharding(mesh, tok_spec)),
+            jax.device_put(mask, NamedSharding(mesh, tok_spec)),
+        )
+    )
+    valid = np.asarray(mask)
+    for bi in range(2):
+        np.testing.assert_allclose(
+            out[bi][valid[bi]], expected[bi][valid[bi]], rtol=5e-4, atol=5e-4
+        )
 
 
 def test_sp_lm_loss_matches_dense():

@@ -62,13 +62,13 @@ class Block(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, x, train: bool = True):
+    def __call__(self, x, mask=None, train: bool = True):
         cfg = self.cfg
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(cfg.dtype)
         x = x + MultiHeadAttention(
             cfg.d_model, cfg.n_head, dtype=cfg.dtype, causal=True,
             attn_fn=self.attn_fn, dropout=cfg.dropout, name="attn",
-        )(y, train=train)
+        )(y, mask=mask, train=train)
         y = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(cfg.dtype)
         if cfg.n_experts > 0:
             from ..parallel.moe import MoEMlp
@@ -92,10 +92,16 @@ class GPT2(nn.Module):
     attn_fn: Optional[Callable] = None
 
     @nn.compact
-    def __call__(self, tokens, positions=None, train: bool = True):
+    def __call__(self, tokens, positions=None, attn_mask=None,
+                 train: bool = True):
         """``positions``: optional global token positions (B, S) or (S,) —
         required under sequence parallelism, where the local shard's
-        positions are not ``arange(s_local)``."""
+        positions are not ``arange(s_local)``.
+
+        ``attn_mask``: optional bool (B, S) key-padding mask (True =
+        attend), passed to every block's attention; under sequence
+        parallelism pass the LOCAL (B, S_local) slice, sharded like the
+        tokens."""
         cfg = self.cfg
         b, s = tokens.shape
         wte = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype, name="wte")
@@ -108,7 +114,9 @@ class GPT2(nn.Module):
         if cfg.dropout:
             x = nn.Dropout(cfg.dropout, deterministic=not train)(x)
         for i in range(cfg.n_layer):
-            x = Block(cfg, attn_fn=self.attn_fn, name=f"h_{i}")(x, train=train)
+            x = Block(cfg, attn_fn=self.attn_fn, name=f"h_{i}")(
+                x, mask=attn_mask, train=train
+            )
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # tied embedding head, f32 logits
         logits = x.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T

@@ -42,6 +42,17 @@ def _block_scores(q, k, scale):
     ) * scale
 
 
+def _check_sp_mask(mask, q):
+    if mask is None:
+        return None
+    if mask.ndim != 2 or mask.shape != (q.shape[0], q.shape[2]):
+        raise NotImplementedError(
+            "sequence-parallel attention supports only (B, S_local) "
+            f"key-padding masks; got shape {mask.shape} for q {q.shape}"
+        )
+    return mask
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -49,6 +60,7 @@ def ring_attention(
     *,
     axis_name: str,
     causal: bool = True,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Blockwise ring attention over a sequence-sharded mesh axis.
 
@@ -57,12 +69,18 @@ def ring_attention(
     contribution into a running (max, normalizer, weighted-sum) accumulator.
     Returns the attention output for the local query block, same
     shape/dtype as ``q``.
+
+    ``mask``: optional bool (B, S_local) key-padding mask (True = attend),
+    the LOCAL slice of the global (B, S) mask — sharded exactly like the
+    tokens. It rides the ring alongside its K/V block, so each step masks
+    the arriving block's keys with the mask slice of the block's origin.
     """
     ws = lax.axis_size(axis_name)
+    mask = _check_sp_mask(mask, q)
     if ws == 1:
         from ..models.attention import dense_attention
 
-        return dense_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal, mask=mask)
 
     b, h, s_local, d = q.shape
     scale = np.float32(1.0 / np.sqrt(d))
@@ -77,18 +95,22 @@ def ring_attention(
     acc = jnp.zeros((b, h, s_local, d), jnp.float32)
 
     # kv starts as own block and hops left each step, so at step s the local
-    # kv block originated at rank (rank + s) mod ws.
+    # kv block originated at rank (rank + s) mod ws. The padding-mask slice
+    # travels with its block.
     shift_left = [(i, (i - 1) % ws) for i in range(ws)]
-    kv = (k, v)
+    kv = (k, v) if mask is None else (k, v, mask)
 
     for step in range(ws):
-        k_blk, v_blk = kv
+        k_blk, v_blk = kv[0], kv[1]
         src = (rank + step) % ws
         scores = _block_scores(qf, k_blk.astype(jnp.float32), scale)
         if causal:
             k_pos = src * s_local + jnp.arange(s_local)
-            mask = q_pos[:, None] >= k_pos[None, :]  # (s_local, s_local)
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
+            cmask = q_pos[:, None] >= k_pos[None, :]  # (s_local, s_local)
+            scores = jnp.where(cmask[None, None], scores, NEG_INF)
+        if mask is not None:
+            # (B, s_local) key mask of the arriving block -> (B, 1, 1, s)
+            scores = jnp.where(kv[2][:, None, None, :], scores, NEG_INF)
         blk_max = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m, blk_max)
         # guard: fully-masked block rows keep m_new finite via maximum(m, .)
@@ -105,6 +127,10 @@ def ring_attention(
             )
 
     out = acc / jnp.maximum(l, np.float32(1e-30))[..., None]
+    # Fully-masked query rows: the finite NEG_INF sentinel makes every score
+    # equal, so p == 1 per key and the row emits the uniform average of v —
+    # exactly dense_attention's uniform-softmax convention. Padded queries'
+    # outputs are meaningless either way; they just stay finite and match.
     return out.astype(q.dtype)
 
 
@@ -116,6 +142,7 @@ def ulysses_attention(
     axis_name: str,
     causal: bool = True,
     hop_cc=None,
+    mask: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Ulysses sequence parallelism: all_to_all heads<->sequence reshard.
 
@@ -125,15 +152,23 @@ def ulysses_attention(
     ``hop_cc``: quantize the reshard payloads on the wire
     (:func:`..parallel.reducers.quantized_all_to_all` — packed bit-planes
     + per-slice meta, STE backward through the inverse reshard).
+
+    ``mask``: optional bool (B, S_local) key-padding mask (local slice,
+    True = attend); after the reshard keys span the full sequence, so the
+    slices are all_gathered into the (B, S) mask the dense kernel needs
+    (ws*B*S bools on the wire — negligible next to the q/k/v reshards).
     """
     from ..models.attention import dense_attention
 
     ws = lax.axis_size(axis_name)
+    mask = _check_sp_mask(mask, q)
     if ws == 1:
-        return dense_attention(q, k, v, causal=causal)
+        return dense_attention(q, k, v, causal=causal, mask=mask)
     h = q.shape[1]
     if h % ws:
         raise ValueError(f"n_head={h} not divisible by sp axis size {ws}")
+    if mask is not None:
+        mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)  # (B, S)
 
     def _a2a(t, s_ax, c_ax):
         if hop_cc is not None:
@@ -153,7 +188,7 @@ def ulysses_attention(
         return _a2a(t, 2, 1)
 
     qh, kh, vh = to_heads(q), to_heads(k), to_heads(v)
-    out = dense_attention(qh, kh, vh, causal=causal)
+    out = dense_attention(qh, kh, vh, causal=causal, mask=mask)
     return to_seq(out)
 
 
@@ -165,6 +200,10 @@ def make_sp_attention(axis_name: str, impl: str = "ring", hop_cc=None):
     (n_head % ws == 0, lowest traffic on ICI). ``hop_cc``: quantize the
     Ulysses reshard payloads (ulysses only — the ring's loop-carried KV
     hops would compound per-hop error and are not compressed).
+
+    Both impls accept a bool (B, S_local) key-padding mask (the local
+    slice, True = attend): the ring rotates it with its K/V block; Ulysses
+    all_gathers the slices for the dense kernel.
     """
     if impl == "ring":
         if hop_cc is not None:
@@ -177,12 +216,7 @@ def make_sp_attention(axis_name: str, impl: str = "ring", hop_cc=None):
 
     @functools.wraps(fn)
     def attn_fn(q, k, v, *, causal: bool = True, mask=None):
-        if mask is not None:
-            raise NotImplementedError(
-                "sequence-parallel attention does not support padding masks "
-                "yet; pad to full blocks or use dense attention"
-            )
         kw = {"hop_cc": hop_cc} if impl == "ulysses" else {}
-        return fn(q, k, v, axis_name=axis_name, causal=causal, **kw)
+        return fn(q, k, v, axis_name=axis_name, causal=causal, mask=mask, **kw)
 
     return attn_fn
