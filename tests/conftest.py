@@ -9,16 +9,22 @@ import os
 
 # Force, don't setdefault: the session env pins JAX_PLATFORMS to the real
 # TPU tunnel; the test suite always runs on the virtual 8-device CPU mesh.
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
-)
-os.environ["JAX_PLATFORMS"] = "cpu"
+# CGX_TEST_TPU=1 opts out (the `pytest -m tpu` hardware run — the cpu pin
+# would otherwise make every tpu-marked test self-skip).
+_ON_TPU = os.environ.get("CGX_TEST_TPU", "0") == "1"
+if not _ON_TPU:
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
 # jax may already have been imported by a pytest plugin (jaxtyping), which
 # captured JAX_PLATFORMS before we overrode it — force the config explicitly.
-jax.config.update("jax_platforms", "cpu")
+if not _ON_TPU:
+    jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_threefry_partitionable", True)
 
 import pytest  # noqa: E402

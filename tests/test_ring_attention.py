@@ -30,14 +30,19 @@ def _qkv(b=2, h=4, s=64, d=16, seed=0):
     return tuple(jax.random.normal(k, shape, jnp.float32) for k in ks)
 
 
-def _run_sharded(fn, mesh, q, k, v):
+def _run_sharded(fn, mesh, q, k, v, mask=None):
+    """fn(q, k, v[, mask]) under shard_map, qkv sequence-sharded over 'sp'
+    (and the optional (B, S) mask sharded on its sequence dim)."""
     spec = P(None, None, "sp", None)
-    sharded = jax.jit(
-        jax.shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
-        )
-    )
+    in_specs = (spec, spec, spec)
     args = [jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v)]
+    if mask is not None:
+        mspec = P(None, "sp")
+        in_specs = in_specs + (mspec,)
+        args.append(jax.device_put(mask, NamedSharding(mesh, mspec)))
+    sharded = jax.jit(
+        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)
+    )
     return np.asarray(sharded(*args))
 
 
@@ -89,20 +94,6 @@ def _padding_mask(b=2, s=64, seed=3):
     return jnp.asarray(np.arange(s)[None, :] < keep[:, None])
 
 
-def _run_sharded_mask(fn, mesh, q, k, v, mask):
-    spec = P(None, None, "sp", None)
-    mspec = P(None, "sp")
-    sharded = jax.jit(
-        jax.shard_map(
-            fn, mesh=mesh, in_specs=(spec, spec, spec, mspec),
-            out_specs=spec,
-        )
-    )
-    args = [jax.device_put(x, NamedSharding(mesh, spec)) for x in (q, k, v)]
-    m = jax.device_put(mask, NamedSharding(mesh, mspec))
-    return np.asarray(sharded(*args, m))
-
-
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 @pytest.mark.parametrize("causal", [True, False])
 def test_sp_attention_padding_mask_matches_dense(impl, causal):
@@ -119,7 +110,7 @@ def test_sp_attention_padding_mask_matches_dense(impl, causal):
     def fn(q, k, v, m):
         return attn(q, k, v, causal=causal, mask=m)
 
-    out = _run_sharded_mask(fn, mesh, q, k, v, mask)
+    out = _run_sharded(fn, mesh, q, k, v, mask=mask)
     valid = np.asarray(mask)  # (B, S): compare non-padded query rows only
     for bi in range(out.shape[0]):
         np.testing.assert_allclose(

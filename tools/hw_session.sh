@@ -1,0 +1,56 @@
+#!/usr/bin/env bash
+# One-shot hardware measurement session (run from the repo root when the
+# TPU transport is reachable). Executes the full PERF_NOTES.md playbook —
+# every result lands in BENCH_LOG.jsonl, so a transport failure mid-way
+# loses only the remaining steps, not the evidence (the round-3 lesson).
+#
+#   bash tools/hw_session.sh            # full program (~15-25 min)
+#   bash tools/hw_session.sh quick      # probe + sweep only, no tests/bench
+#
+# One python process per step: a wedged step kills that process, not the
+# session; keep operands <= 128 MB (docs/PERF_NOTES.md incident notes).
+set -u
+cd "$(dirname "$0")/.."
+mode="${1:-full}"
+log() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
+
+FAILED=0
+run() {  # run <timeout-s> <desc> <cmd...>
+  log "$2"
+  timeout "$1" "${@:3}"
+  rc=$?
+  if [ $rc -ne 0 ]; then echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1)); fi
+  return 0  # keep going: later steps may still work
+}
+
+log "transport probe"
+if ! timeout 240 python -c "import jax; print(jax.devices())"; then
+  echo "TRANSPORT DOWN — aborting session"; exit 2
+fi
+
+# --- the diagnosis sweep (PERF_NOTES.md) --------------------------------
+run 600 "read floor"            python tools/qbench.py read
+run 600 "nometa"                python tools/qbench.py nometa
+run 600 "metalane"              python tools/qbench.py metalane
+run 600 "current"               python tools/qbench.py current
+run 600 "current tc=4"          python tools/qbench.py current --tc 4
+run 600 "current tc=32"         python tools/qbench.py current --tc 32
+run 600 "current tc=64"         python tools/qbench.py current --tc 64
+run 600 "butterfly pack"        env CGX_PALLAS_PACK=butterfly python tools/qbench.py current
+run 600 "mul variant"           python tools/qbench.py mul
+run 600 "mul production knob"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current
+run 600 "mul + best-guess tc"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current --tc 32
+run 600 "dequant reference"     python tools/qbench.py dequant
+
+[ "$mode" = quick ] && { echo "quick mode: done ($FAILED step(s) failed)"; exit $((FAILED > 0)); }
+
+# --- compiled-kernel correctness on the real chip -----------------------
+run 900 "tpu-marked tests" env CGX_TEST_TPU=1 python -m pytest tests/ -m tpu -q --no-header
+
+# --- the driver's headline line (also appended to BENCH_LOG) ------------
+run 1800 "bench.py" python bench.py
+
+echo
+echo "=== session complete ($FAILED step(s) failed); tail of BENCH_LOG.jsonl ==="
+tail -n 20 BENCH_LOG.jsonl 2>/dev/null
+exit $((FAILED > 0))
