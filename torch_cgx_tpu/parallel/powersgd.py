@@ -1,0 +1,200 @@
+"""PowerSGD low-rank gradient compression (Vogels et al., 2019).
+
+Beyond the reference: its compressor hierarchy has exactly two members —
+the max-min Quantizer and a debug pass-through (compressor.h:130,145).
+PowerSGD is the other major gradient-compression family in the DDP world
+(torch ships ``powerSGD_hook`` for it), and it is uncommonly TPU-friendly:
+compress/decompress are plain matmuls (MXU work, not VPU bit-twiddling),
+and the wire payloads P (n x r) and Q (m x r) are *linear* in the
+gradient, so a raw ``lax.psum`` of the factors IS the exact mean of the
+per-device low-rank projections — no per-hop requantization, no error
+asymmetry across replicas.
+
+Per eligible leaf M (reshaped to (n, m), warm-started Q carried in state):
+
+    M  = grad + e              # error feedback (per-device)
+    P  = psum(M @ Q)           # (n, r) on the wire; scale washes out below
+    P  = orthonormalize(P)     # identical on every device
+    Q' = psum(M.T @ P) / ws    # (m, r) on the wire — the MEAN projection
+    M^ = P @ Q'.T              # shared rank-r approximation of mean(M_i)
+    e' = M - M^                # this device's deviation + truncation loss
+
+The Q' division is load-bearing: M^ must approximate the MEAN of the
+EF-corrected gradients so each device's residual subtracts it exactly
+once — mean(e') = mean(M) - M^, the true aggregate truncation loss,
+re-fed next step. (Approximating the SUM instead overcorrects by ws x
+per step and diverges.)
+
+Traffic per step: (n + m) * r values instead of n * m — e.g. a
+768 x 3072 GPT-2 MLP kernel at rank 4 ships 15 360 values instead of
+2.36 M (153x). Ineligible leaves (rank < 2, tiny, or (n+m)r >= nm) ride
+an exact ``lax.psum``.
+
+The warm start is load-bearing: Q persists across steps, so the power
+iteration converges onto the gradient's dominant subspace over time.
+Error feedback is NOT optional here (rank-r truncation loses far more
+than quantization); the state is therefore baked into the transform.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from .. import config as cfg_mod
+from ..utils.logging import metrics
+from . import mesh as mesh_mod
+
+
+class PowerSGDState(NamedTuple):
+    """qs: per-leaf warm-start Q factors (replicated — identical on every
+    device after each factor allreduce). es: per-device EF residuals (the
+    same placement hazard as :class:`ErrorFeedbackState` — NEVER declare
+    them replicated under shard_map)."""
+
+    qs: tuple
+    es: tuple
+
+
+def _matrix_shape(shape) -> Tuple[int, int]:
+    """(n, m) view: leading dim x flattened rest (torch hook convention)."""
+    return int(shape[0]), int(np.prod(shape[1:]))
+
+
+def eligible(leaf, rank: int) -> bool:
+    """Low-rank compression pays off: float, >= 2-D, above the minimal
+    size, and the factors are smaller than the matrix."""
+    if leaf.ndim < 2 or not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    if leaf.size < cfg_mod.minimal_size():
+        return False
+    n, m = _matrix_shape(leaf.shape)
+    r = min(rank, n, m)
+    return (n + m) * r < n * m
+
+
+def _orthonormalize(p: jax.Array) -> jax.Array:
+    """Economic QR of (n, r) — deterministic, so every device (running on
+    identical psum'd input) produces identical factors."""
+    q, _ = jnp.linalg.qr(p)
+    return q
+
+
+def init_powersgd(params, rank: int, *, seed: int = 0) -> PowerSGDState:
+    """Deterministic gaussian Q warm-start per eligible leaf + zero EF
+    residuals. Placement under ``jax.jit`` + ``shard_map``: replicate
+    ``qs``; give each ``es`` leaf a leading device axis sharded over the
+    sync axes (the :func:`init_error_feedback` pattern) and strip it
+    inside the mapped function."""
+    leaves = jax.tree_util.tree_leaves(params)
+    qs, es = [], []
+    for i, leaf in enumerate(leaves):
+        if eligible(leaf, rank):
+            n, m = _matrix_shape(leaf.shape)
+            r = min(rank, n, m)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+            qs.append(
+                jax.random.normal(key, (m, r), jnp.float32)
+                / np.float32(np.sqrt(m))
+            )
+            es.append(jnp.zeros((n, m), jnp.float32))
+        else:
+            qs.append(None)
+            es.append(None)
+    return PowerSGDState(qs=tuple(qs), es=tuple(es))
+
+
+def powersgd_transform(
+    *,
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    rank: int = 4,
+    average: bool = True,
+) -> optax.GradientTransformation:
+    """optax transformation: PowerSGD-compressed gradient allreduce.
+
+    Prepend to an optimizer chain running inside ``shard_map``::
+
+        tx = optax.chain(
+            cgx.powersgd_transform(mesh=mesh, rank=4), optax.adam(1e-3)
+        )
+
+    The state (``PowerSGDState``) carries the warm-start factors
+    (replicated) and per-device EF residuals — under shard_map, shard the
+    ``es`` leaves or manage placement via :func:`init_powersgd`'s
+    docstring. Ineligible leaves take an exact ``psum``. Outputs are
+    bit-identical across devices (the decompressed M^ is computed from
+    psum'd factors only).
+    """
+    axes = tuple(axes)
+    ws = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def _psum(x):
+        for a in axes:
+            if mesh.shape[a] > 1:
+                x = lax.psum(x, a)
+        return x
+
+    def init_fn(params):
+        return init_powersgd(params, rank)
+
+    def update_fn(updates, state, params=None):
+        del params
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        out_scale = np.float32(1 if average else ws)
+        out, qs_new, es_new = [], [], []
+        for leaf, q, e in zip(leaves, state.qs, state.es):
+            if q is None:
+                g = leaf.astype(jnp.float32) / np.float32(
+                    ws if average else 1
+                )
+                red = _psum(g)
+                metrics.add("trace.powersgd.raw_elems", float(leaf.size))
+                out.append(red.astype(leaf.dtype))
+                qs_new.append(None)
+                es_new.append(None)
+                continue
+            n, m = _matrix_shape(leaf.shape)
+            mat = leaf.astype(jnp.float32).reshape(n, m) + e
+            p = _psum(mat @ q)  # scale irrelevant: orthonormalized next
+            p = _orthonormalize(p)
+            # MEAN projection — see the module docstring on why /ws here.
+            q_new = _psum(mat.T @ p) / np.float32(ws)
+            m_hat = p @ q_new.T
+            metrics.add(
+                "trace.powersgd.wire_elems", float((n + m) * q.shape[1])
+            )
+            metrics.add("trace.powersgd.grad_elems", float(n * m))
+            out.append(
+                (m_hat * out_scale).reshape(leaf.shape).astype(leaf.dtype)
+            )
+            qs_new.append(q_new)
+            es_new.append(mat - m_hat)
+        return (
+            jax.tree_util.tree_unflatten(treedef, out),
+            PowerSGDState(qs=tuple(qs_new), es=tuple(es_new)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def compression_ratio(params, rank: int) -> float:
+    """Whole-tree wire BYTES / raw BYTES under this rank: eligible leaves
+    ship f32 factors regardless of gradient dtype (the power iteration
+    runs in f32); the rest ship raw at their own width — so bf16 trees
+    compress 2x less in bytes than in elements."""
+    raw = wire = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        itemsize = np.dtype(leaf.dtype).itemsize
+        raw += leaf.size * itemsize
+        if eligible(leaf, rank):
+            n, m = _matrix_shape(leaf.shape)
+            wire += (n + m) * min(rank, n, m) * 4  # f32 factors
+        else:
+            wire += leaf.size * itemsize
+    return wire / raw if raw else 1.0
