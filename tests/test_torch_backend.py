@@ -560,6 +560,46 @@ def _worker_sharded_collectives(rank: int, ws: int) -> None:
     dist.barrier()
 
 
+def _worker_ddp_torch_powersgd(rank: int, ws: int) -> None:
+    """torch's BUILT-IN PowerSGD DDP comm hook over the cgx process group:
+    the hook allreduces low-rank factor tensors through our backend, so
+    this exercises plain-float allreduce + the hook protocol end-to-end
+    (interop the reference never demonstrates)."""
+    import torch
+    import torch.distributed as dist
+    import torch.nn as nn
+    from torch.distributed.algorithms.ddp_comm_hooks import (
+        powerSGD_hook as psgd,
+    )
+
+    torch.manual_seed(7)
+    model = nn.Sequential(nn.Linear(32, 64), nn.ReLU(), nn.Linear(64, 10))
+    ddp = nn.parallel.DistributedDataParallel(model)
+    state = psgd.PowerSGDState(
+        process_group=None, matrix_approximation_rank=2,
+        start_powerSGD_iter=2,
+    )
+    ddp.register_comm_hook(state, psgd.powerSGD_hook)
+    opt = torch.optim.SGD(ddp.parameters(), lr=0.05)
+    loss_fn = nn.CrossEntropyLoss()
+    torch.manual_seed(100 + rank)
+    losses = []
+    for _ in range(10):
+        x = torch.randn(16, 32)
+        y = torch.randint(0, 10, (16,))
+        opt.zero_grad()
+        loss = loss_fn(ddp(x), y)
+        loss.backward()
+        opt.step()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    for p in ddp.parameters():
+        buf = [torch.zeros_like(p) for _ in range(ws)]
+        dist.all_gather(buf, p.detach())
+        for b in buf[1:]:
+            assert torch.equal(b, buf[0]), "replicas diverged"
+
+
 def _worker_fsdp(rank: int, ws: int) -> None:
     """Fully-sharded (ZeRO-3 style) training through the cgx backend: each
     rank owns a 1/ws shard of the flat parameters, all_gather_into_tensor
@@ -707,6 +747,11 @@ def test_alltoall_base_ws4():
 @pytest.mark.torch_bridge
 def test_ddp_training_ws2():
     _launch(_worker_ddp, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_ddp_torch_powersgd_hook_ws2():
+    _launch(_worker_ddp_torch_powersgd, ws=2)
 
 
 @pytest.mark.torch_bridge

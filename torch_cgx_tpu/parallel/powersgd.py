@@ -145,6 +145,9 @@ def powersgd_transform(
 
     def update_fn(updates, state, params=None):
         del params
+        from .grad_sync import _warn_ef_placement_once
+
+        _warn_ef_placement_once()  # es is per-device, like EF state
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         out_scale = np.float32(1 if average else ws)
         out, qs_new, es_new = [], [], []

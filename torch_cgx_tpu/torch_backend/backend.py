@@ -330,7 +330,26 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
     # -- worker loop ------------------------------------------------------
 
+    @staticmethod
+    def _finish(fut, result, exc) -> None:
+        try:
+            if exc is None:
+                fut.set_result(result)
+            else:  # failed future, like finishWorkMPIError
+                fut.set_exception(exc)
+        except Exception as e:
+            log.error("work completion failed after future done: %s", e)
+
     def _run_loop(self) -> None:
+        # Each future completes on its OWN thread, never on the collective
+        # worker and never serialized behind other completions: torch comm
+        # hooks chain `.then()` callbacks that execute inside set_result,
+        # and a callback may enqueue AND WAIT on the next collective
+        # (torch's built-in powerSGD_hook does, between its P and Q
+        # allreduces). Completing on the worker deadlocks the worker
+        # against itself; completing on one shared thread deadlocks that
+        # thread against the NEXT completion it is itself waiting for.
+        # Thread spawn cost (~tens of us) is noise next to a collective.
         while not self._shutdown.is_set():
             try:
                 item = self._jobs.get(timeout=0.1)
@@ -339,12 +358,22 @@ class ProcessGroupCGX(dist.ProcessGroup):
             fn, fut, result = item
             try:
                 fn()
-                fut.set_result(result)
-            except Exception as e:  # failed future, like finishWorkMPIError
-                try:
-                    fut.set_exception(e)
-                except Exception:
-                    log.error("work failed after future done: %s", e)
+            except Exception as e:
+                args = (fut, None, e)
+            else:
+                args = (fut, result, None)
+            try:
+                threading.Thread(
+                    target=self._finish, args=args, name="cgx-complete",
+                    daemon=True,
+                ).start()
+            except Exception as e:  # thread exhaustion: complete inline
+                # rather than killing the worker loop (a `.then` hook
+                # waiting on a nested collective may then deadlock, but
+                # plain Work.wait callers — the common case — survive).
+                log.warning("completion thread spawn failed (%s); "
+                            "completing inline", e)
+                self._finish(*args)
 
     def _submit(self, fn, result) -> dist.Work:
         fut = Future()
