@@ -106,3 +106,33 @@ def test_training_resume_equivalence(tmp_path):
     for _ in range(2):
         p, s = step(p, s, batch)
     np.testing.assert_array_equal(np.asarray(p["w"]), want)
+
+
+def test_compression_state_roundtrip(tmp_path):
+    """EF residuals and PowerSGD warm-start factors are ordinary pytrees —
+    a resumed run must get back bit-identical compression state (the
+    warm-started Q is load-bearing: losing it restarts the power
+    iteration from random)."""
+
+    from torch_cgx_tpu import checkpoint as ckpt
+    from torch_cgx_tpu.parallel import init_powersgd
+    from torch_cgx_tpu.parallel.grad_sync import ErrorFeedbackState
+
+    params = {"w": jnp.ones((32, 8), jnp.float32), "b": jnp.ones((8,))}
+    psgd = init_powersgd(params, rank=2)
+    # make the state distinctive
+    psgd = psgd._replace(
+        es=tuple(
+            None if e is None else e + 0.25 for e in psgd.es
+        )
+    )
+    ef = ErrorFeedbackState(
+        e={"w": jnp.full((32, 8), 0.5, jnp.float32)}
+    )
+    tree = {"params": params, "psgd": psgd, "ef": ef, "step": jnp.int32(7)}
+    ckpt.save(str(tmp_path), tree, 7)
+    back = ckpt.restore(str(tmp_path), 7, target=tree)
+    for a, b in zip(jax.tree.leaves(tree), jax.tree.leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # pytree structure (incl. the None slots) survives
+    assert jax.tree.structure(tree) == jax.tree.structure(back)
