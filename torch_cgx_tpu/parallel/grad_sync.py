@@ -180,6 +180,7 @@ def make_train_step(
     stochastic_seed: Optional[int] = None,
     donate: bool = True,
     error_feedback: bool = False,
+    powersgd_rank: Optional[int] = None,
 ):
     """Build a jitted compressed-DP train step.
 
@@ -212,9 +213,23 @@ def make_train_step(
     :func:`init_error_feedback` — leaves are ``(ws, *param.shape)``
     f32 sharded over the sync axes on the leading device dim, so every
     device keeps its own residual.
+
+    ``powersgd_rank=r`` replaces the quantized allreduce with PowerSGD
+    low-rank compression (:mod:`.powersgd`) at that rank — the SAFE
+    wiring of its mixed-placement state: the step signature becomes
+    ``step(params, opt_state, psgd, batch, step_idx) -> (params,
+    opt_state, psgd, loss)`` with ``psgd`` from
+    :func:`.powersgd.init_powersgd_state` (warm-start factors replicated,
+    per-device residuals on a leading device axis). Mutually exclusive
+    with ``error_feedback`` (PowerSGD carries its own EF).
     """
     import inspect
 
+    if powersgd_rank is not None and error_feedback:
+        raise ValueError(
+            "make_train_step: powersgd_rank and error_feedback are "
+            "mutually exclusive — PowerSGD carries its own error feedback"
+        )
     axes = tuple(axes)
     sync_axes = axes if sp_axis is None else axes + (sp_axis,)
     if len(sync_axes) > 2:
@@ -261,6 +276,32 @@ def make_train_step(
         loss = jax.lax.psum(loss, sync_axes) / ws_total
         return params, opt_state, loss
 
+    if powersgd_rank is not None:
+        from .powersgd import PowerSGDState, powersgd_transform
+
+        psgd_tx = powersgd_transform(
+            mesh=mesh, axes=sync_axes, rank=powersgd_rank, average=True,
+            placement_warning=False,
+        )
+
+    def _step_psgd(params, opt_state, psgd, batch, step_idx):
+        loss, grads, _ = _grads_and_key(params, batch, step_idx)
+        local = PowerSGDState(
+            qs=psgd.qs,
+            es=tuple(
+                None if e is None else jnp.squeeze(e, 0) for e in psgd.es
+            ),
+        )
+        reduced, st = psgd_tx.update(grads, local)
+        updates, opt_state = optimizer.update(reduced, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.psum(loss, sync_axes) / ws_total
+        out_state = PowerSGDState(
+            qs=st.qs,
+            es=tuple(None if e is None else e[None] for e in st.es),
+        )
+        return params, opt_state, out_state, loss
+
     def _step_ef(params, opt_state, ef, batch, step_idx):
         loss, grads, key = _grads_and_key(params, batch, step_idx)
         e = jax.tree.map(lambda x: jnp.squeeze(x, 0), ef)
@@ -306,18 +347,29 @@ def make_train_step(
             batch_spec = jax.tree_util.tree_unflatten(
                 treedef, [_batch_leaf_spec(l) for l in leaves]
             )
-            ef_spec = P(sync_axes)
+            if powersgd_rank is not None:
+                # pytree-prefix spec: replicated warm-start factors,
+                # per-device residual rows on the leading device dim
+                state_spec = PowerSGDState(qs=P(), es=P(sync_axes))
+            else:
+                state_spec = P(sync_axes)  # EF residual leaves
+            with_state = error_feedback or powersgd_rank is not None
+            body = (
+                _step_psgd
+                if powersgd_rank is not None
+                else (_step_ef if error_feedback else _step)
+            )
             sharded = jax.shard_map(
-                _step_ef if error_feedback else _step,
+                body,
                 mesh=mesh,
                 in_specs=(
-                    (P(), P(), ef_spec, batch_spec, P())
-                    if error_feedback
+                    (P(), P(), state_spec, batch_spec, P())
+                    if with_state
                     else (P(), P(), batch_spec, P())
                 ),
                 out_specs=(
-                    (P(), P(), ef_spec, P())
-                    if error_feedback
+                    (P(), P(), state_spec, P())
+                    if with_state
                     else (P(), P(), P())
                 ),
                 # Only the gradient-sync (and sp) axes are manual; any other
@@ -334,17 +386,17 @@ def make_train_step(
             )
             donate_idx = ()
             if donate:
-                # params, opt_state — and the EF residual buffer, which is
+                # params, opt_state — and the EF/PowerSGD state, which is
                 # param-sized f32 and would otherwise double-buffer.
-                donate_idx = (0, 1, 2) if error_feedback else (0, 1)
+                donate_idx = (0, 1, 2) if with_state else (0, 1)
             fn = jax.jit(sharded, donate_argnums=donate_idx)
             built[cache_key] = fn
         return fn
 
-    if error_feedback:
+    if error_feedback or powersgd_rank is not None:
 
-        def step(params, opt_state, ef, batch, step_idx):
-            return _build(batch)(params, opt_state, ef, batch, step_idx)
+        def step(params, opt_state, state, batch, step_idx):
+            return _build(batch)(params, opt_state, state, batch, step_idx)
 
     else:
 

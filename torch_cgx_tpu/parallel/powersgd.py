@@ -115,6 +115,7 @@ def powersgd_transform(
     axes: Sequence[str] = (mesh_mod.DP_AXIS,),
     rank: int = 4,
     average: bool = True,
+    placement_warning: bool = True,
 ) -> optax.GradientTransformation:
     """optax transformation: PowerSGD-compressed gradient allreduce.
 
@@ -145,9 +146,12 @@ def powersgd_transform(
 
     def update_fn(updates, state, params=None):
         del params
-        from .grad_sync import _warn_ef_placement_once
+        if placement_warning:  # es is per-device, like EF state;
+            # make_train_step(powersgd_rank=...) wires placement itself
+            # and passes False
+            from .grad_sync import _warn_ef_placement_once
 
-        _warn_ef_placement_once()  # es is per-device, like EF state
+            _warn_ef_placement_once()
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         out_scale = np.float32(1 if average else ws)
         out, qs_new, es_new = [], [], []
@@ -184,6 +188,47 @@ def powersgd_transform(
         )
 
     return optax.GradientTransformation(init_fn, update_fn)
+
+
+def init_powersgd_state(
+    params,
+    mesh,
+    rank: int,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    sp_axis=None,
+    *,
+    seed: int = 0,
+) -> PowerSGDState:
+    """Placement-ready state for ``make_train_step(powersgd_rank=...)``:
+    ``qs`` replicated; each ``es`` leaf stacked to ``(ws, n, m)`` and
+    sharded over the sync axes on the leading device dim (the
+    :func:`init_error_feedback` pattern), so every device owns exactly its
+    own residual row."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sync_axes = tuple(axes) if sp_axis is None else tuple(axes) + (sp_axis,)
+    ws = int(np.prod([mesh.shape[a] for a in sync_axes]))
+    # Build the factors directly rather than via init_powersgd: its (n, m)
+    # zero residuals would be a full-parameter-sized allocation thrown
+    # away immediately (the stacked per-device es replaces them).
+    leaves = jax.tree_util.tree_leaves(params)
+    qs, es = [], []
+    for i, leaf in enumerate(leaves):
+        if eligible(leaf, rank):
+            n, m = _matrix_shape(leaf.shape)
+            r = min(rank, n, m)
+            key = jax.random.fold_in(jax.random.PRNGKey(seed), i)
+            qs.append(
+                jax.random.normal(key, (m, r), jnp.float32)
+                / np.float32(np.sqrt(m))
+            )
+            es.append(jnp.zeros((ws, n, m), jnp.float32))
+        else:
+            qs.append(None)
+            es.append(None)
+    qs = jax.device_put(tuple(qs), NamedSharding(mesh, P()))
+    es = jax.device_put(tuple(es), NamedSharding(mesh, P(sync_axes)))
+    return PowerSGDState(qs=qs, es=es)
 
 
 def compression_ratio(params, rank: int) -> float:
