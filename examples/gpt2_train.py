@@ -41,6 +41,15 @@ def parse_args():
     p.add_argument("--stochastic", action="store_true", help="QSGD stochastic rounding")
     p.add_argument("--error-feedback", action="store_true",
                    help="accumulate per-device wire-quantization residuals")
+    def _rank(v):
+        v = int(v)
+        if v < 0:
+            raise argparse.ArgumentTypeError("powersgd rank must be >= 0")
+        return v
+
+    p.add_argument("--powersgd-rank", type=_rank, default=0,
+                   help="replace the quantized allreduce with PowerSGD "
+                        "low-rank compression at this rank (0 = off)")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
     p.add_argument("--seq", type=int, default=128)
@@ -156,23 +165,31 @@ def main():
         def loss_fn(p, batch):
             return lm_loss(model.apply({"params": p}, batch), batch)
 
+    sp_axis = "sp" if args.sp > 1 else None
     step = make_train_step(
         loss_fn,
         opt,
         mesh,
         axes=dp_axes,
-        sp_axis="sp" if args.sp > 1 else None,
+        sp_axis=sp_axis,
         stochastic_seed=cgx_config.global_seed() if args.stochastic else None,
         donate=False,
         error_feedback=args.error_feedback,
+        powersgd_rank=args.powersgd_rank or None,
     )
-    ef = None
-    if args.error_feedback:
+    state = None
+    if args.powersgd_rank:
+        from torch_cgx_tpu.parallel import init_powersgd_state
+
+        state = init_powersgd_state(
+            params, mesh, rank=args.powersgd_rank, axes=dp_axes,
+            sp_axis=sp_axis,
+        )
+    elif args.error_feedback:
         from torch_cgx_tpu.parallel import init_error_feedback
 
-        ef = init_error_feedback(
-            params, mesh, axes=dp_axes,
-            sp_axis="sp" if args.sp > 1 else None,
+        state = init_error_feedback(
+            params, mesh, axes=dp_axes, sp_axis=sp_axis,
         )
 
     losses = []
@@ -180,11 +197,11 @@ def main():
         lo = (i * args.batch) % (len(data) - args.batch)
         batch = shard_batch(
             jnp.asarray(data[lo : lo + args.batch]), mesh, dp_axes,
-            sp_axis="sp" if args.sp > 1 else None,
+            sp_axis=sp_axis,
         )
-        if args.error_feedback:
-            params, opt_state, ef, loss = step(
-                params, opt_state, ef, batch, jnp.int32(i)
+        if state is not None:
+            params, opt_state, state, loss = step(
+                params, opt_state, state, batch, jnp.int32(i)
             )
         else:
             params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
