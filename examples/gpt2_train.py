@@ -64,6 +64,11 @@ def parse_args():
 
 def main():
     args = parse_args()
+    if args.powersgd_rank and args.error_feedback:
+        raise SystemExit(
+            "gpt2_train.py: error: --powersgd-rank and --error-feedback "
+            "are mutually exclusive"
+        )
     if args.cpu:
         # Force, don't setdefault: append to whatever XLA_FLAGS exists.
         os.environ["XLA_FLAGS"] = (
@@ -165,9 +170,6 @@ def main():
         def loss_fn(p, batch):
             return lm_loss(model.apply({"params": p}, batch), batch)
 
-    if args.powersgd_rank and args.error_feedback:
-        p_err = "--powersgd-rank and --error-feedback are mutually exclusive"
-        raise SystemExit(f"gpt2_train.py: error: {p_err}")
     sp_axis = "sp" if args.sp > 1 else None
     step = make_train_step(
         loss_fn,

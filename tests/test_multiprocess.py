@@ -1,0 +1,151 @@
+"""TRUE multi-process JAX-path integration: the multi-host story end-to-end.
+
+SURVEY §5.8's distributed backend on the JAX side is
+``jax.distributed.initialize`` (``mesh.init_distributed``) + XLA
+collectives across processes. The rest of the suite simulates multi-chip
+with a single-process virtual mesh; this file spawns REAL processes (one
+CPU device each, Gloo cross-process collectives) and drives the
+bootstrap, the quantized allreduce, ``shard_batch``'s
+local-slice-to-global-array path, and a full ``make_train_step`` —
+the closest a CPU host gets to the reference's ``mpirun`` launches.
+"""
+
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import socket
+import sys
+import traceback
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _proc_main(rank: int, ws: int, port: int, q) -> None:
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        sys.path.insert(0, _REPO)
+        os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+        os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = "64"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        import jax.numpy as jnp
+        import numpy as np
+        import optax
+        from jax import shard_map
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+        from torch_cgx_tpu.config import CompressionConfig
+        from torch_cgx_tpu.parallel import (
+            make_train_step,
+            replicate,
+            shard_batch,
+        )
+        from torch_cgx_tpu.parallel.mesh import init_distributed
+        from torch_cgx_tpu.parallel.reducers import quantized_allreduce
+
+        assert init_distributed(f"localhost:{port}", ws, rank)
+        assert jax.process_count() == ws and jax.device_count() == ws
+        mesh = Mesh(np.asarray(jax.devices()), ("dp",))
+        cc = CompressionConfig(bits=4, bucket_size=64)
+
+        # 1) quantized SRA across PROCESSES: constant-exactness oracle.
+        x = jnp.full((256,), float(rank + 1), jnp.float32)
+        garr = jax.make_array_from_process_local_data(
+            NamedSharding(mesh, P("dp")), np.asarray(x)[None]
+        )
+        fn = jax.jit(
+            shard_map(
+                lambda v: quantized_allreduce(v[0], "dp", ws, cc, "SRA")[None],
+                mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+                check_vma=False,
+            )
+        )
+        local = np.asarray(fn(garr).addressable_shards[0].data)
+        expect = ws * (ws + 1) // 2
+        assert (local == expect).all(), (rank, local[0, :4], expect)
+
+        # 2) full train step: per-process local batch slices via
+        # shard_batch (make_array_from_process_local_data), quantized
+        # gradient sync, replicated update.
+        rng = np.random.default_rng(0)  # same data plan on every process
+        Wt = rng.normal(size=(16, 4)).astype(np.float32)
+        X = rng.normal(size=(64, 16)).astype(np.float32)
+        Y = X @ Wt
+        n_local = X.shape[0] // ws
+        Xl = X[rank * n_local : (rank + 1) * n_local]
+        Yl = Y[rank * n_local : (rank + 1) * n_local]
+
+        def loss_fn(p, b):
+            return jnp.mean((b[0] @ p["w"] - b[1]) ** 2)
+
+        params = {"w": jnp.zeros((16, 4), jnp.float32)}
+        opt = optax.sgd(5e-2)
+        step = make_train_step(loss_fn, opt, mesh, donate=False)
+        p = replicate(params, mesh)
+        s = replicate(opt.init(params), mesh)
+        losses = []
+        for i in range(15):
+            b = shard_batch((Xl, Yl), mesh)  # LOCAL slice in, global out
+            p, s, loss = step(p, s, b, jnp.int32(i))
+            losses.append(float(loss))
+        assert losses[-1] < 0.5 * losses[0], losses
+        # the local replica equals every process's (loss already proves the
+        # sync ran; check the param bytes round-trip a psum unchanged)
+        w = np.asarray(p["w"].addressable_shards[0].data)
+        mx = jax.jit(
+            shard_map(lambda v: jax.lax.pmax(v, "dp"), mesh=mesh,
+                      in_specs=P(), out_specs=P(), check_vma=False)
+        )(p["w"])
+        np.testing.assert_array_equal(
+            w, np.asarray(mx.addressable_shards[0].data)
+        )
+        q.put((rank, None))
+    except Exception:
+        q.put((rank, traceback.format_exc()))
+
+
+def _run_once(ws: int):
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    port = _free_port()
+    procs = [
+        ctx.Process(target=_proc_main, args=(r, ws, port, q), daemon=True)
+        for r in range(ws)
+    ]
+    for p in procs:
+        p.start()
+    errors = []
+    try:
+        for _ in range(ws):
+            rank, err = q.get(timeout=240)
+            if err is not None:
+                errors.append(f"rank {rank}:\n{err}")
+    except Exception:
+        errors.append("timed out waiting for ranks")
+    finally:
+        for p in procs:
+            p.join(timeout=10)
+            if p.is_alive():
+                p.terminate()
+    return errors
+
+
+@pytest.mark.torch_bridge  # same spawn-cost class as the bridge tests
+def test_two_process_jax_distributed():
+    errors = _run_once(2)
+    if errors and all("in use" in e or "bind" in e.lower() for e in errors):
+        # the probe socket closed before the coordinator bound the port and
+        # something else claimed it — retry once on a fresh port
+        errors = _run_once(2)
+    assert not errors, "\n".join(errors)
