@@ -153,6 +153,13 @@ def powersgd_transform(
 
             _warn_ef_placement_once()
         leaves, treedef = jax.tree_util.tree_flatten(updates)
+        if len(leaves) != len(state.qs):
+            raise ValueError(
+                "PowerSGD state was initialised from a different "
+                f"parameter tree: got {len(leaves)} gradient leaves but "
+                f"state holds {len(state.qs)} factors. Re-run "
+                "init_powersgd on the tree actually being optimised."
+            )
         out_scale = np.float32(1 if average else ws)
         out, qs_new, es_new = [], [], []
         for leaf, q, e in zip(leaves, state.qs, state.es):
