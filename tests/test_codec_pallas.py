@@ -193,8 +193,70 @@ def test_pallas_add_fusion():
 def test_supports_gating():
     assert codec_pallas.supports(4096, 4, 512, False)
     assert not codec_pallas.supports(4096, 4, 100, False)  # bucket % 32 != 0
-    assert not codec_pallas.supports(4096, 4, 512, True)  # residual mode
+    assert codec_pallas.supports(4096, 4, 512, True)  # residual mode rides
+    assert codec_pallas.supports(4096 + 17, 4, 512, True)
+    # residual mode with < 1 whole bucket left after the slice: XLA path
+    assert not codec_pallas.supports(100, 4, 512, True)
     assert not codec_pallas.supports(100, 4, 512, False)  # tiny tensor
+
+
+@pytest.mark.parametrize("m", [4096 + 17, 33 * 64 + 63])
+def test_pallas_skip_incomplete_matches_xla(m):
+    # Residual mode (compressor.cc:315-339): incomplete final bucket rides
+    # raw; packed/meta/residual must all match the XLA oracle byte-for-byte
+    # and the roundtrip must reproduce the tail exactly.
+    rows, bits, bucket = 2, 4, 64
+    xs = jnp.asarray(
+        np.random.default_rng(m).normal(size=(rows, m)), jnp.float32
+    )
+    q_p = codec_pallas.quantize_batch(
+        xs, bits, bucket, interpret=True, skip_incomplete_buckets=True
+    )
+    q_x = jax.vmap(
+        lambda r: codec.quantize(r, bits, bucket, skip_incomplete_buckets=True)
+    )(xs)
+    assert q_p.packed.shape == q_x.packed.shape
+    np.testing.assert_array_equal(np.asarray(q_p.packed), np.asarray(q_x.packed))
+    np.testing.assert_array_equal(np.asarray(q_p.meta), np.asarray(q_x.meta))
+    np.testing.assert_array_equal(
+        np.asarray(q_p.residual), np.asarray(q_x.residual)
+    )
+    assert q_p.residual.shape == (rows, m % bucket)
+    y = codec_pallas.dequantize_batch(q_p, interpret=True, out_dtype=jnp.float32)
+    y_ref = jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=jnp.float32))(q_x)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=2e-6, atol=5e-7
+    )
+    # the raw tail is exact
+    np.testing.assert_array_equal(
+        np.asarray(y)[:, m - m % bucket:], np.asarray(xs)[:, m - m % bucket:]
+    )
+    # add_to fusion with a residual present
+    acc = jnp.ones_like(xs)
+    y_acc = codec_pallas.dequantize_batch(q_p, add_to=acc, interpret=True)
+    np.testing.assert_allclose(
+        np.asarray(y_acc), np.asarray(y) + 1.0, rtol=2e-6, atol=5e-7
+    )
+
+
+def test_dispatch_skip_incomplete_pallas(monkeypatch):
+    # Forced-pallas dispatch honors the residual config end-to-end and the
+    # flat fast path (bucket % 128 == 0) emits XLA-identical bytes.
+    monkeypatch.setenv(cgx_config.CODEC_IMPL, "pallas")
+    cc = CompressionConfig(bits=4, bucket_size=128, skip_incomplete_buckets=True)
+    m = 32 * 128 + 50
+    xs = jnp.asarray(np.random.default_rng(3).normal(size=(2, m)), jnp.float32)
+    q = dispatch.quantize_batch(xs, cc)
+    assert q.residual.shape == (2, 50)
+    q_ref = jax.vmap(
+        lambda r: codec.quantize(r, 4, 128, skip_incomplete_buckets=True)
+    )(xs)
+    np.testing.assert_array_equal(np.asarray(q.packed), np.asarray(q_ref.packed))
+    y = dispatch.dequantize_batch(q)
+    y_ref = jax.vmap(lambda qq: codec.dequantize(qq))(q_ref)
+    np.testing.assert_allclose(
+        np.asarray(y), np.asarray(y_ref), rtol=2e-6, atol=5e-7
+    )
 
 
 def test_dispatch_forced_pallas_on_cpu(monkeypatch):

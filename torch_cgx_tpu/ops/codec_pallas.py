@@ -56,7 +56,9 @@ the same divide (not reciprocal-multiply) as the XLA/host codecs so
 deterministic payloads are byte-identical across all four implementations.
 
 Constraints for the kernel path (callers fall back to the XLA codec
-otherwise — see ``dispatch.py``): bucket_size % 32 == 0, no residual mode.
+otherwise — see ``dispatch.py``): bucket_size % 32 == 0. The
+``skip_incomplete_buckets`` residual mode rides the kernels too — the raw
+final-bucket tail is sliced off outside the kernel (compressor.cc:315-339).
 """
 
 from __future__ import annotations
@@ -79,12 +81,16 @@ MAX_BUCKET_ELEMS = 16384  # VMEM guard for the (32, bucket) chunk tile
 
 
 def supports(n: int, bits: int, bucket_size: int, skip_incomplete: bool) -> bool:
+    # skip_incomplete_buckets (the reference's residual mode,
+    # compressor.cc:315-339) keeps the fast path: the incomplete final
+    # bucket is sliced off before the kernels and carried raw (see
+    # quantize_batch), so only the whole-bucket prefix length matters.
+    main_n = n - (n % bucket_size) if skip_incomplete else n
     return (
         1 <= bits <= 8
         and bucket_size % LANE_GROUP == 0
         and bucket_size <= MAX_BUCKET_ELEMS
-        and not skip_incomplete
-        and n >= bucket_size  # tiny tensors: XLA path is cheaper than a grid
+        and main_n >= bucket_size  # tiny tensors: XLA path beats a grid
     )
 
 
@@ -514,18 +520,26 @@ def quantize_batch(
     stochastic: bool = False,
     key: Optional[jax.Array] = None,
     interpret: bool = False,
+    skip_incomplete_buckets: bool = False,
 ) -> codec.QTensor:
     """Quantize each row of ``xs (rows, m)`` independently; returns a QTensor
     with leading ``rows`` dim on packed/meta/residual (same pytree shape as
     ``jax.vmap(codec.quantize)``). The kernel covers each row's full
-    32-bucket chunks; tail buckets go through the XLA codec (same wire)."""
+    32-bucket chunks; tail buckets go through the XLA codec (same wire).
+    ``skip_incomplete_buckets`` carries each row's incomplete final bucket
+    raw in ``residual`` (compressor.cc:315-339), exactly like
+    ``codec.quantize``; the whole-bucket prefix still rides the kernels."""
     rows, m = xs.shape
     dtype = xs.dtype
     b = bucket_size
-    nb_r = codec.num_buckets(m, b)
+    main_n, res_n = codec._split_residual(m, b, skip_incomplete_buckets)
+    residual = xs[:, main_n:] if res_n else jnp.zeros((rows, 0), dtype)
+    if res_n:
+        xs = xs[:, :main_n]
+    nb_r = codec.num_buckets(main_n, b)
     m_pad = nb_r * b
-    if m_pad != m:
-        xs = jnp.pad(xs, ((0, 0), (0, m_pad - m)), mode="edge")
+    if m_pad != main_n:
+        xs = jnp.pad(xs, ((0, 0), (0, m_pad - main_n)), mode="edge")
     c_r, t_r = _row_split(nb_r)
     if t_r == 0 and b % 128 == 0:
         # Fast path: whole rows are full chunks and buckets are whole
@@ -549,7 +563,7 @@ def quantize_batch(
                 rows, c_r * bits * b
             ),
             meta=meta.reshape(rows, nb_r, 2).astype(dtype),
-            residual=jnp.zeros((rows, 0), dtype),
+            residual=residual,
             numel=m,
             bits=bits,
             bucket_size=b,
@@ -605,7 +619,7 @@ def quantize_batch(
     return codec.QTensor(
         packed=words,
         meta=meta,
-        residual=jnp.zeros((rows, 0), dtype),
+        residual=residual,
         numel=m,
         bits=bits,
         bucket_size=b,
@@ -620,7 +634,9 @@ def dequantize_batch(
     out_dtype=None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Decode a batched QTensor -> (rows, numel)."""
+    """Decode a batched QTensor -> (rows, numel). A raw residual tail
+    (skip_incomplete_buckets mode) is re-appended after the kernel decode,
+    mirroring ``codec.dequantize``."""
     if out_dtype is None:
         out_dtype = add_to.dtype if add_to is not None else q.dtype
     rows = q.packed.shape[0]
@@ -637,36 +653,37 @@ def dequantize_batch(
             bucket_size=b,
             interpret=interpret,
             tc=_pipe_tc(rows * c_r, b),
-        )[:, : q.numel]
-        if add_to is not None:
-            return (add_to.astype(jnp.float32) + vals).astype(out_dtype)
-        return vals.astype(out_dtype)
-
-    parts = []
-    head_words = c_r * q.bits * b
-    if c_r:
-        w3 = q.packed[:, :head_words].reshape(rows * c_r * q.bits, b)
-        m2 = meta[:, : c_r * CHUNK_BUCKETS].reshape(-1, 2)
-        vals = _dequantize_chunks_impl(
-            w3,
-            m2,
-            bits=q.bits,
-            bucket_size=b,
-            interpret=interpret,
-            tc=_tile_chunks(rows * c_r, b, q.bits),
+        )[:, : q.numel_main]
+    else:
+        parts = []
+        head_words = c_r * q.bits * b
+        if c_r:
+            w3 = q.packed[:, :head_words].reshape(rows * c_r * q.bits, b)
+            m2 = meta[:, : c_r * CHUNK_BUCKETS].reshape(-1, 2)
+            vals = _dequantize_chunks_impl(
+                w3,
+                m2,
+                bits=q.bits,
+                bucket_size=b,
+                interpret=interpret,
+                tc=_tile_chunks(rows * c_r, b, q.bits),
+            )
+            parts.append(vals.reshape(rows, c_r * CHUNK_BUCKETS * b))
+        if t_r:
+            tw = q.packed[:, head_words:]
+            lvl = jax.vmap(
+                lambda w: codec.unpack_levels(w, q.bits, t_r * b)
+            )(tw).reshape(rows * t_r, b)
+            unit = meta[:, c_r * CHUNK_BUCKETS :, 0].reshape(-1)
+            bmin = meta[:, c_r * CHUNK_BUCKETS :, 1].reshape(-1)
+            vals = codec.decode_levels(lvl, unit, bmin)
+            parts.append(vals.reshape(rows, t_r * b))
+        vals = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
+        vals = vals[:, : q.numel_main]
+    if q.residual.shape[-1]:
+        vals = jnp.concatenate(
+            [vals, q.residual.astype(jnp.float32)], axis=1
         )
-        parts.append(vals.reshape(rows, c_r * CHUNK_BUCKETS * b))
-    if t_r:
-        tw = q.packed[:, head_words:]
-        lvl = jax.vmap(
-            lambda w: codec.unpack_levels(w, q.bits, t_r * b)
-        )(tw).reshape(rows * t_r, b)
-        unit = meta[:, c_r * CHUNK_BUCKETS :, 0].reshape(-1)
-        bmin = meta[:, c_r * CHUNK_BUCKETS :, 1].reshape(-1)
-        vals = codec.decode_levels(lvl, unit, bmin)
-        parts.append(vals.reshape(rows, t_r * b))
-    vals = parts[0] if len(parts) == 1 else jnp.concatenate(parts, axis=1)
-    vals = vals[:, : q.numel]
     if add_to is not None:
         return (add_to.astype(jnp.float32) + vals).astype(out_dtype)
     return vals.astype(out_dtype)

@@ -51,6 +51,7 @@ def quantize_batch(
             stochastic=stochastic,
             key=key,
             interpret=not _on_tpu(),
+            skip_incomplete_buckets=cc.skip_incomplete_buckets,
         )
     if stochastic:
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(
@@ -80,12 +81,12 @@ def dequantize_batch(
     q: codec.QTensor, *, add_to: Optional[jax.Array] = None, out_dtype=None
 ) -> jax.Array:
     """Decode a batched QTensor (leading rows dim) -> (rows, numel)."""
-    cc = CompressionConfig(bits=q.bits or 32, bucket_size=q.bucket_size or 512)
-    if (
-        q.bits
-        and q.residual.shape[-1] == 0
-        and _pick(q.numel, cc) == "pallas"
-    ):
+    cc = CompressionConfig(
+        bits=q.bits or 32,
+        bucket_size=q.bucket_size or 512,
+        skip_incomplete_buckets=bool(q.residual.shape[-1]),
+    )
+    if q.bits and _pick(q.numel, cc) == "pallas":
         return codec_pallas.dequantize_batch(
             q, add_to=add_to, out_dtype=out_dtype, interpret=not _on_tpu()
         )
