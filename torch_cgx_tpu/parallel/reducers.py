@@ -190,6 +190,17 @@ def ring_allreduce(
     hop (compounding quantization like ring.cc:170-188); the allgather phase
     circulates each owner's once-quantized payload so all devices decode
     identical bytes (ring.cc:190-224).
+
+    Both phases are ``lax.scan`` loops — the reference rings are runtime
+    loops too (ring.cc:170-224), and an unrolled form would trace a
+    quantize/dequantize pair per hop, growing trace+compile cost O(ws)
+    (126 codec traces per fusion slice on a v5p-64 cross ring). The scan
+    traces each phase's codec exactly once; program size is O(1) in ws
+    (regression-guarded in test_reducers.py). Wire bytes and outputs are
+    bit-identical to the unrolled form (:func:`_ring_allreduce_unrolled`,
+    kept as the suite's oracle): the hop index enters only modular index
+    arithmetic and ``fold_in`` salts, both value-deterministic whether the
+    index is a Python int or a scan-carried scalar.
     """
     n = x.shape[0]
     dtype = x.dtype
@@ -198,17 +209,75 @@ def ring_allreduce(
     seg = _chunk_size(n, ws)
     rank = lax.axis_index(axis_name)
     acc = _pad_rows(x.astype(jnp.float32), ws, seg)
+    use_key = key is not None and cc.stochastic
 
     def row(a, idx):
         return lax.dynamic_slice(a, (idx, 0), (1, seg))[0]
 
     # Phase 1: scatter-reduce. Device r sends segment (r - step) mod ws and
     # accumulates incoming segment (r - step - 1) mod ws.
+    def scatter_step(acc, step):
+        send_idx = (rank - step) % ws
+        seg_out = row(acc, send_idx).astype(dtype)
+        k = jax.random.fold_in(jax.random.fold_in(key, step), rank) if (
+            use_key
+        ) else None
+        q = _quantize_1d(seg_out, cc, k)
+        q_in = _shift_right(q, axis_name, ws)
+        recv_idx = (rank - step - 1) % ws
+        updated = _dequantize_1d(q_in, add_to=row(acc, recv_idx))
+        return lax.dynamic_update_slice(acc, updated[None], (recv_idx, 0)), None
+
+    acc, _ = lax.scan(scatter_step, acc, jnp.arange(ws - 1))
+
+    # Phase 2: allgather. Device r owns fully-reduced segment (r + 1) mod ws;
+    # quantize once (+ self-decode) and circulate the payload ws-1 times.
+    own_idx = (rank + 1) % ws
+    k = jax.random.fold_in(jax.random.fold_in(key, ws), rank) if (
+        use_key
+    ) else None
+    q_own = _quantize_1d(row(acc, own_idx).astype(dtype), cc, k)
+    out = jnp.zeros((ws, seg), jnp.float32)
+    out = lax.dynamic_update_slice(out, _dequantize_1d(q_own)[None], (own_idx, 0))
+
+    def gather_step(carry, step):
+        out, cur = carry
+        cur = _shift_right(cur, axis_name, ws)
+        idx = (rank - step) % ws
+        out = lax.dynamic_update_slice(out, _dequantize_1d(cur)[None], (idx, 0))
+        return (out, cur), None
+
+    (out, _), _ = lax.scan(gather_step, (out, q_own), jnp.arange(ws - 1))
+    return out.reshape(-1)[:n].astype(dtype)
+
+
+def _ring_allreduce_unrolled(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Python-unrolled ring (the pre-scan form) — the suite's oracle that
+    :func:`ring_allreduce`'s scan emits identical bytes hop for hop. Not a
+    production path: trace cost grows O(ws)."""
+    n = x.shape[0]
+    dtype = x.dtype
+    if ws == 1:
+        return x
+    seg = _chunk_size(n, ws)
+    rank = lax.axis_index(axis_name)
+    acc = _pad_rows(x.astype(jnp.float32), ws, seg)
+    use_key = key is not None and cc.stochastic
+
+    def row(a, idx):
+        return lax.dynamic_slice(a, (idx, 0), (1, seg))[0]
+
     for step in range(ws - 1):
         send_idx = (rank - step) % ws
         seg_out = row(acc, send_idx).astype(dtype)
         k = jax.random.fold_in(jax.random.fold_in(key, step), rank) if (
-            key is not None and cc.stochastic
+            use_key
         ) else None
         q = _quantize_1d(seg_out, cc, k)
         q_in = _shift_right(q, axis_name, ws)
@@ -216,11 +285,9 @@ def ring_allreduce(
         updated = _dequantize_1d(q_in, add_to=row(acc, recv_idx))
         acc = lax.dynamic_update_slice(acc, updated[None], (recv_idx, 0))
 
-    # Phase 2: allgather. Device r owns fully-reduced segment (r + 1) mod ws;
-    # quantize once (+ self-decode) and circulate the payload ws-1 times.
     own_idx = (rank + 1) % ws
     k = jax.random.fold_in(jax.random.fold_in(key, ws), rank) if (
-        key is not None and cc.stochastic
+        use_key
     ) else None
     q_own = _quantize_1d(row(acc, own_idx).astype(dtype), cc, k)
     out = jnp.zeros((ws, seg), jnp.float32)
