@@ -49,6 +49,10 @@ DEBUG_ALL_TO_ALL_REDUCTION = "CGX_DEBUG_ALL_TO_ALL_REDUCTION"
 DEBUG_FORCE_CODEC = "CGX_DEBUG_FORCE_CODEC"
 STANDALONE_LAYER_ELEMS = "CGX_STANDALONE_LAYER_ELEMS"
 # TPU-only additions (no reference analogue):
+SHM = "CGX_SHM"  # bridge same-host data plane (shm_communicator.cc role)
+LAYER_ALIGNED_SPLIT = "CGX_LAYER_ALIGNED_SPLIT"  # greedy split, .cc:265-299
+SHM_DIR = "CGX_SHM_DIR"  # override /dev/shm
+SHM_HOST_ID = "CGX_SHM_HOST_ID"  # override host fingerprint (test hook)
 FSDP_ALLGATHER_BITS = "CGX_FSDP_ALLGATHER_BITS"  # 0 (off, default) | 2..8
 STOCHASTIC_ROUNDING = "CGX_STOCHASTIC_ROUNDING"  # QSGD_DETERMENISTIC inverse
 CODEC_IMPL = "CGX_CODEC_IMPL"  # "xla" | "pallas" | "auto"
@@ -207,6 +211,21 @@ def fake_ratio() -> Optional[float]:
     if v <= 0.0 or v >= 1.0:
         return None
     return v
+
+
+def layer_aligned_split() -> bool:
+    """CGX_LAYER_ALIGNED_SPLIT: opt-in greedy chunk split that keeps layers
+    whole within a rank's chunk (Quantizer::GetSizesAndOffsets semantics,
+    compressor.cc:265-299) instead of the equal 8-aligned split. Bridge
+    only: the SPMD path needs equal static chunk shapes for all_to_all."""
+    return _env.get_bool_env_or_default(LAYER_ALIGNED_SPLIT, False)
+
+
+def shm_enabled() -> bool:
+    """CGX_SHM: the bridge's same-host shared-memory byte plane (the
+    reference's default intra-node transport, shm_communicator.cc:116-177).
+    On by default; rendezvous/creation failures fall back to the store."""
+    return _env.get_bool_env_or_default(SHM, True)
 
 
 def dummy_compression() -> bool:

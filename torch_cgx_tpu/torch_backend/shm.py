@@ -1,0 +1,378 @@
+"""Same-host shared-memory byte plane for the ``"cgx"`` bridge.
+
+The reference's default intra-node transport is a zero-copy POSIX SHM data
+plane with IPC-event signalling (/root/reference/src/common/
+shm_communicator.cc:116-177, shm_utils.cc:24-48): each pair of node-local
+ranks exchanges payloads through ``shm_open``'d windows instead of the
+network stack. The bridge's portable transport is the c10d Store, which
+ships every byte through TCP/file puts — fine across hosts, a throughput
+class below SHM between processes that share RAM.
+
+This module is the TPU-host re-expression: the **Store stays the control
+plane** (tiny per-message headers, ordering, refcounted acks — replacing
+the reference's IPC events and MPI_Barrier'd window setup), while payload
+bytes ride mmap'd files under ``/dev/shm``:
+
+* :class:`ShmArena` — the writer side. One rank owns a generation-numbered
+  ring of mmap'd files (``shm_open``/``ftruncate``/``mmap`` analogue, done
+  with plain ``os.open`` + ``mmap`` so no ``multiprocessing`` resource
+  tracker interferes). Allocation is a circular bump allocator; regions
+  are reclaimed when every reader has acked through the Store. When the
+  ring can't satisfy a request the arena *grows* a new generation instead
+  of blocking — a put can therefore never deadlock against a slow reader;
+  drained generations are unlinked.
+* :class:`ShmChannel` — put/take with Store-get semantics: ``put`` copies
+  the payload into the arena and publishes a 24-byte header under the
+  message key; ``take`` resolves the header, maps the writer's file
+  (attachments are cached per path), copies the payload out and bumps the
+  ack counter. One memcpy per side versus the Store's
+  serialize→socket→deserialize of the full payload.
+
+Host identity for the rendezvous is hostname + kernel ``boot_id`` (two
+containers with the same hostname on different machines must not try to
+share ``/dev/shm``). ``CGX_SHM_HOST_ID`` overrides the fingerprint — the
+test hook that simulates a multi-host topology on one box, and an escape
+hatch for containers that share hostname+boot_id but not ``/dev/shm``
+(set distinct ids to force the Store path).
+"""
+
+from __future__ import annotations
+
+import atexit
+import mmap
+import os
+import socket
+import threading
+import uuid
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+_ALIGN = 64  # region alignment (cache line)
+
+
+def host_fingerprint() -> str:
+    """Identity of "a host whose processes can share /dev/shm"."""
+    override = os.environ.get("CGX_SHM_HOST_ID")
+    if override:
+        return override
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        boot = "noboot"
+    return f"{socket.gethostname()}:{boot}"
+
+
+def default_dir() -> str:
+    d = os.environ.get("CGX_SHM_DIR")
+    if d:
+        return d
+    return "/dev/shm" if os.path.isdir("/dev/shm") else "/tmp"
+
+
+def _round_up(n: int, a: int) -> int:
+    return -(-n // a) * a
+
+
+class _Region:
+    __slots__ = ("gen", "off", "size", "ack_key", "readers", "freed")
+
+    def __init__(self, gen: int, off: int, size: int, ack_key: str, readers: int):
+        self.gen = gen
+        self.off = off
+        self.size = size
+        self.ack_key = ack_key
+        self.readers = readers
+        self.freed = False
+
+
+class _GenFile:
+    """One mmap'd backing file: a circular bump allocator."""
+
+    def __init__(self, path: str, capacity: int):
+        self.path = path
+        self.capacity = capacity
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, capacity)
+            self.mm = mmap.mmap(fd, capacity)
+        finally:
+            os.close(fd)
+        self.head = 0  # next write offset
+        self.tail = 0  # oldest live byte
+        self.live = 0  # bytes in flight (incl. wrap gaps)
+
+    def space_at_head(self) -> Tuple[int, int]:
+        """(contiguous bytes at head, gap-to-end if a wrap would be needed)."""
+        if self.head >= self.tail and self.live < self.capacity:
+            return self.capacity - self.head, self.tail
+        if self.live >= self.capacity:
+            return 0, 0
+        return self.tail - self.head, 0
+
+    def close(self, unlink: bool = True) -> None:
+        try:
+            self.mm.close()
+        except Exception:
+            pass
+        if unlink:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+class ShmArena:
+    """Writer-owned payload ring (grow-don't-block reclaim policy)."""
+
+    def __init__(
+        self,
+        directory: str,
+        name: str,
+        poll_ack: Callable[[str], int],
+        drop_keys: Callable[[List[str]], None],
+        min_capacity: int = 1 << 23,  # 8 MB
+    ):
+        self._dir = directory
+        self._name = name
+        self._poll_ack = poll_ack  # ack_key -> acks so far (non-blocking)
+        self._drop_keys = drop_keys  # best-effort control-key GC
+        self._gens: Dict[int, _GenFile] = {}
+        self._gen = 0
+        self._pending: List[_Region] = []  # allocation order
+        self._lock = threading.Lock()
+        self._new_gen(min_capacity)
+
+    def path_of(self, gen: int) -> str:
+        return os.path.join(self._dir, f"{self._name}-g{gen}")
+
+    def _new_gen(self, capacity: int) -> None:
+        self._gen += 1
+        self._gens[self._gen] = _GenFile(self.path_of(self._gen), capacity)
+
+    def _reclaim(self) -> None:
+        """Free acked pending regions; advance ring tails over freed
+        prefixes; unlink fully-drained non-current generations.
+
+        Called only when an allocation cannot be satisfied (write() tries
+        the free ring first), and polls only each generation's FIFO *head*
+        run: the tail cannot advance past the first un-acked region, so
+        polling regions behind it is pure Store-RPC waste — this keeps a
+        ws-wide collective at O(1) ack polls per pressure event instead of
+        O(ws) per put."""
+        drop: List[str] = []
+        blocked_gens = set()
+        for r in self._pending:
+            if r.gen in blocked_gens:
+                continue
+            if not r.freed and self._poll_ack(r.ack_key) >= r.readers:
+                r.freed = True
+                drop.append(r.ack_key)
+                drop.append(r.ack_key[: -len("/ack")])
+            if not r.freed:
+                blocked_gens.add(r.gen)
+        # Pop the freed prefix per generation (regions are FIFO per gen).
+        still: List[_Region] = []
+        for r in self._pending:
+            gf = self._gens.get(r.gen)
+            if r.freed and gf is not None and r.off == gf.tail % gf.capacity:
+                gf.tail = (gf.tail + r.size) % gf.capacity
+                gf.live -= r.size
+                if gf.live == 0:
+                    gf.head = gf.tail = 0
+            elif r.freed and gf is None:
+                pass
+            else:
+                still.append(r)
+        # Out-of-order acks: a freed region behind an unfreed one stays in
+        # `still` (its bytes aren't reusable yet) — keep it for next pass.
+        self._pending = [r for r in still]
+        for g, gf in list(self._gens.items()):
+            if g != self._gen and gf.live == 0 and not any(
+                r.gen == g for r in self._pending
+            ):
+                gf.close()
+                del self._gens[g]
+        if drop:
+            self._drop_keys(drop)
+
+    def _try_alloc(self, size: int) -> int:
+        """Offset in the current generation's ring, or -1 (caller holds the
+        lock)."""
+        gf = self._gens[self._gen]
+        if size > gf.capacity:
+            return -1
+        at_head, wrap_tail = gf.space_at_head()
+        if at_head >= size:
+            off = gf.head
+            gf.head = (gf.head + size) % gf.capacity
+            gf.live += size
+            return off
+        if gf.head > gf.tail and wrap_tail >= size:
+            # wrap: burn the gap [head, capacity) as a freed filler
+            gap = gf.capacity - gf.head
+            filler = _Region(self._gen, gf.head, gap, "", 0)
+            filler.freed = True
+            self._pending.append(filler)
+            gf.live += gap
+            gf.head = size % gf.capacity
+            gf.live += size
+            return 0
+        return -1
+
+    def write(self, data, ack_key: str, readers: int) -> Tuple[int, int, int]:
+        """Copy ``data`` (any C-contiguous buffer) into the ring; returns
+        (gen, offset, size) for the header. Never blocks: grows a new
+        generation when the ring is full."""
+        data = memoryview(data).cast("B")
+        size = max(_round_up(len(data), _ALIGN), _ALIGN)
+        with self._lock:
+            off = self._try_alloc(size)
+            if off < 0:
+                # Pressure path only: poll acks, then retry once.
+                self._reclaim()
+                off = self._try_alloc(size)
+            if off < 0:
+                self._new_gen(max(2 * self._gens[self._gen].capacity, 4 * size))
+                gf = self._gens[self._gen]
+                off = 0
+                gf.head = size % gf.capacity
+                gf.live += size
+            gen = self._gen
+            gf = self._gens[gen]
+            gf.mm[off : off + len(data)] = data
+            self._pending.append(_Region(gen, off, size, ack_key, readers))
+            return gen, off, len(data)
+
+    def close(self) -> None:
+        with self._lock:
+            for gf in self._gens.values():
+                gf.close()
+            self._gens.clear()
+            self._pending.clear()
+
+
+class ShmChannel:
+    """Store-controlled same-host byte channel (put/take semantics of the
+    bridge's Store transport, payloads via :class:`ShmArena`)."""
+
+    HDR = "cgxshm/"
+
+    def __init__(
+        self,
+        store,
+        rank: int,
+        directory: Optional[str] = None,
+        wait_key: Optional[Callable[[str], None]] = None,
+    ):
+        self._store = store
+        self._rank = rank
+        self._dir = directory or default_dir()
+        self._wait_key = wait_key  # blocking "key exists" (abort-aware)
+        # Every writer coins its own arena name and ships it in each
+        # message header — no group-wide session rendezvous (which would
+        # need an elected coiner and deadlock if that rank had no local
+        # peers of its own).
+        name = f"cgx-{uuid.uuid4().hex[:12]}-r{rank}"
+        self._arena = ShmArena(
+            self._dir, name, self._ack_count, self._drop_keys
+        )
+        self._attached: Dict[str, mmap.mmap] = {}
+        self._attach_lock = threading.Lock()
+        # Safety net: unlink /dev/shm files even when the owner never calls
+        # ProcessGroup.shutdown() (crash/KeyboardInterrupt paths). close()
+        # is idempotent.
+        atexit.register(self.close)
+
+    # -- store helpers ----------------------------------------------------
+
+    def _ack_count(self, ack_key: str) -> int:
+        try:
+            return int(self._store.add(ack_key, 0))
+        except Exception:
+            return 0
+
+    def _drop_keys(self, keys: List[str]) -> None:
+        for k in keys:
+            if not k:
+                continue
+            try:
+                self._store.delete_key(k)
+            except Exception:
+                return  # store without delete support: keys persist
+
+    # -- data plane -------------------------------------------------------
+
+    def put(self, key: str, data, readers: int = 1) -> None:
+        """``data``: bytes or any C-contiguous buffer (uint8 ndarray views
+        included — one memcpy into the arena, no staging copy)."""
+        hkey = self.HDR + key
+        gen, off, size = self._arena.write(data, hkey + "/ack", readers)
+        path = self._arena.path_of(gen)
+        self._store.set(hkey, f"{path}:{gen}:{off}:{size}".encode())
+
+    def take(self, key: str) -> np.ndarray:
+        hkey = self.HDR + key
+        if self._wait_key is not None:
+            self._wait_key(hkey)
+        hdr = bytes(self._store.get(hkey)).decode()
+        path, _gen, off_s, size_s = hdr.rsplit(":", 3)
+        off, size = int(off_s), int(size_s)
+        out = self._read(path, off, size)
+        self._store.add(hkey + "/ack", 1)
+        return out
+
+    @staticmethod
+    def _split_gen(path: str) -> Tuple[str, int]:
+        """(writer prefix, generation) of an arena file path."""
+        prefix, g = path.rsplit("-g", 1)
+        return prefix, int(g)
+
+    def _read(self, path: str, off: int, size: int) -> np.ndarray:
+        """Copy a payload out of a writer's arena file. The copy runs under
+        the attach lock so generation eviction can never close a map that a
+        concurrent take is still reading (the memcpy is fast; only this
+        process's own reader threads serialize)."""
+        with self._attach_lock:
+            mm = self._attached.get(path)
+            if mm is None:
+                fd = os.open(path, os.O_RDONLY)
+                try:
+                    mm = mmap.mmap(fd, 0, prot=mmap.PROT_READ)
+                finally:
+                    os.close(fd)
+                self._attached[path] = mm
+                # Evict this writer's OLDER generations: once the writer
+                # grows, drained old files get unlinked — a cached reader
+                # map would pin their tmpfs pages for the process lifetime.
+                # A straggler message still in an old gen re-attaches by
+                # path (the writer keeps the file until that message acks).
+                writer, gen = self._split_gen(path)
+                for p in [
+                    q for q in self._attached
+                    if q != path and self._split_gen(q)[0] == writer
+                    and self._split_gen(q)[1] < gen
+                ]:
+                    self._attached[p].close()
+                    del self._attached[p]
+            return np.frombuffer(mm, np.uint8, count=size, offset=off).copy()
+
+    def close(self) -> None:
+        try:  # drop the crash-path safety net: a closed channel must not
+            # be pinned (store handle + mmap cache) for the process life
+            atexit.unregister(self.close)
+        except Exception:
+            pass
+        self._arena.close()
+        with self._attach_lock:
+            for mm in self._attached.values():
+                try:
+                    mm.close()
+                except Exception:
+                    pass
+            self._attached.clear()
