@@ -198,6 +198,9 @@ def main():
         )
 
     losses = []
+    import time as _time
+
+    t0 = steady0 = _time.time()
     for i in range(args.steps):
         lo = (i * args.batch) % (len(data) - args.batch)
         batch = shard_batch(
@@ -211,20 +214,24 @@ def main():
         else:
             params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
         losses.append(float(loss))
+        if i == 0:
+            steady0 = _time.time()  # exclude the compile from the step rate
         if (i + 1) % max(1, args.steps // 5) == 0:
             print(f"step {i + 1}/{args.steps}: loss={losses[-1]:.4f}")
 
-    print(
-        json.dumps(
-            {
-                "example": "gpt2_train",
-                "mesh": {a: int(mesh.shape[a]) for a in axis_names},
-                "bits": args.bits,
-                "first_loss": losses[0],
-                "final_loss": losses[-1],
-            }
+    summary = {
+        "example": "gpt2_train",
+        "mesh": {a: int(mesh.shape[a]) for a in axis_names},
+        "bits": args.bits,
+        "first_loss": losses[0],
+        "final_loss": losses[-1],
+        "compile_s": round(steady0 - t0, 2),
+    }
+    if args.steps > 1:  # steady window needs at least one post-compile step
+        summary["steps_per_s"] = round(
+            (args.steps - 1) / max(_time.time() - steady0, 1e-9), 3
         )
-    )
+    print(json.dumps(summary))
 
 
 if __name__ == "__main__":

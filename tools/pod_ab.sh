@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# One-command BASELINE.json A/B on a real pod slice (v4-8 / v5e-8 / v5p).
+#
+# Produces the north-star measurement BASELINE.md calls for: fp32-psum DP
+# step rate vs quantized DP step rate on the SAME slice, for the CIFAR
+# (ResNet-18) and GPT-2 configs, appending each run's JSON summary line to
+# BENCH_LOG.jsonl tagged with the mode.
+#
+# Run from the repo root on a TPU VM that sees the slice's chips
+# (jax.devices() == the slice). Multi-host slices: launch on every host
+# (e.g. `gcloud compute tpus tpu-vm ssh --worker=all --command=...`);
+# jax.distributed initializes from the TPU runtime automatically.
+#
+#   bash tools/pod_ab.sh            # 4-bit vs fp32, both models
+#   STEPS=200 BITS=2 bash tools/pod_ab.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+STEPS="${STEPS:-100}"
+BITS="${BITS:-4}"
+
+append_summary() { # mode name  <- stdin: full example output
+  local mode="$1" name="$2" out line
+  out="$(cat)"
+  echo "$out"
+  line="$(printf '%s\n' "$out" | grep -E '^\{' | tail -1)"
+  if [ -n "$line" ]; then
+    printf '%s\n' "$line" \
+      | python -c "import json,sys; d=json.load(sys.stdin); d['ab_mode']='$mode'; d['tool']='pod_ab'; print(json.dumps(d))" \
+      >> BENCH_LOG.jsonl
+  else
+    echo "{\"tool\": \"pod_ab\", \"ab_mode\": \"$mode\", \"metric\": \"${name}_failed\"}" >> BENCH_LOG.jsonl
+  fi
+}
+
+echo "== cifar / fp32 (PSUM) =="
+python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
+  --reduction PSUM ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} \
+  | append_summary fp32 cifar
+
+echo "== cifar / ${BITS}-bit SRA =="
+python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
+  --quantization-bits "$BITS" ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} \
+  | append_summary "q${BITS}" cifar
+
+echo "== gpt2 / fp32 =="
+python examples/gpt2_train.py --steps "$STEPS" --bits 32 \
+  --layers 12 --d-model 768 --heads 12 --seq 512 \
+  | append_summary fp32 gpt2
+
+echo "== gpt2 / ${BITS}-bit =="
+python examples/gpt2_train.py --steps "$STEPS" --bits "$BITS" \
+  --layers 12 --d-model 768 --heads 12 --seq 512 \
+  | append_summary "q${BITS}" gpt2
+
+python - <<'EOF'
+import json
+rows = [json.loads(l) for l in open("BENCH_LOG.jsonl") if l.strip()]
+ab = [r for r in rows if r.get("tool") == "pod_ab"]
+print("\n== A/B summary (newest last) ==")
+for r in ab[-8:]:
+    print(json.dumps(r))
+pairs = {}
+for r in ab:
+    pairs.setdefault(r.get("example"), {})[r.get("ab_mode")] = r
+for name, modes in pairs.items():
+    f, qs = modes.get("fp32"), [v for k, v in modes.items() if k != "fp32"]
+    if f and qs and "steps_per_s" in f and "steps_per_s" in qs[-1]:
+        print(f"{name}: quantized/fp32 step rate = "
+              f"{qs[-1]['steps_per_s'] / f['steps_per_s']:.2f}x "
+              f"(north star: >= 2x on DCN-connected slices)")
+EOF

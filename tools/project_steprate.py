@@ -1,0 +1,169 @@
+#!/usr/bin/env python3
+"""Projected fp32-vs-quantized DP step rates from measured codec numbers.
+
+Turns the BASELINE.json north star ("ResNet/GPT DDP at >=2x the
+fp32-allreduce step rate at 4-bit") from an argument into a table: for a
+grid of interconnect regimes, combine
+
+* the measured single-chip numbers (compute step time, codec throughput —
+  newest matching entries in ``BENCH_LOG.jsonl``, falling back to the
+  BASELINE.md round-3 table when the log has none), and
+* the exact wire-byte formulas the runtime wire counters meter
+  (``codec.wire_bytes`` — meta + bit-plane payload; the counters count
+  elems per executed step, the formula maps elems to wire bytes),
+
+into projected per-step times under the standard ring/SRA allreduce cost
+model: ``t_wire = 2 * (ws-1)/ws * bytes_on_wire / link_bw`` per rank
+(send+receive of every byte but your own chunk's — both SRA and ring move
+exactly this much per rank, scatter_reduce_allgather.cc:94-202).
+
+Per-rank codec work per step, from the SRA accounting used by
+``CGX_DEBUG_FORCE_CODEC`` (reducers.py:quantized_allreduce): quantize
+``n*(1 + 1/ws)`` elems, dequantize ``n*(2 - 1/ws)`` elems.
+
+This is a PROJECTION, not a measurement: single-chip codec times are real
+hardware numbers, link bandwidths are the regime labels in the table, and
+no network contention/overlap is modeled (no overlap = conservative for
+compressed, which pipelines better). The A/B measurement procedure for a
+real pod slice is ``tools/pod_ab.sh``.
+
+Usage::
+
+    python tools/project_steprate.py                 # GPT-2 proxy defaults
+    python tools/project_steprate.py --grad-mb 97 --compute-ms 30 --ws 32
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from torch_cgx_tpu.ops import codec  # noqa: E402
+
+# BASELINE.md round-3 measured fallbacks (one v5e chip, scan-slope timing).
+R3 = {
+    "quantize_GBps_in": 122.0,
+    "dequantize_GBps_out": 638.0,
+    "compute_ms": 41.85,  # GPT-2 124M b8 x s512 train step, single chip
+    "grad_mb": 473.0,  # its fp32 gradient bytes
+    "provenance": "BASELINE.md round-3 table (mid-round-3 v5e session)",
+}
+
+# Interconnect regimes: per-rank effective link bandwidth for the
+# allreduce cost model. DCN figures are per-host NIC classes; ICI figures
+# are the per-chip aggregate class of recent TPU fabrics (order of
+# magnitude labels, not vendor specs).
+REGIMES = [
+    ("DCN 100 Gb/s host NIC", 12.5e9),
+    ("DCN 200 Gb/s host NIC", 25.0e9),
+    ("ICI-class 100 GB/s", 100.0e9),
+    ("ICI-class 300 GB/s", 300.0e9),
+]
+
+
+def newest_codec_numbers(log_path: str):
+    """Latest measured codec throughputs from BENCH_LOG.jsonl, if any."""
+    out = dict(R3)
+    if not os.path.exists(log_path):
+        return out
+    with open(log_path) as f:
+        for line in f:
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            det = rec.get("detail") or {}
+            if "quantize_GBps" in det:
+                out["quantize_GBps_in"] = float(det["quantize_GBps"])
+                out["dequantize_GBps_out"] = float(det["dequantize_GBps"])
+                out["provenance"] = f"BENCH_LOG.jsonl {rec.get('ts', '?')}"
+            ts = det.get("train_step") or {}
+            if "t_plain_ms" in ts:
+                out["compute_ms"] = float(ts["t_plain_ms"])
+            if rec.get("tool") == "qbench" and rec.get("variant") == "current":
+                gb = rec["mb"] / 1024  # input GB
+                out["quantize_GBps_in"] = round(gb / (rec["t_ms"] / 1e3), 1)
+                out["provenance"] = f"BENCH_LOG.jsonl qbench {rec.get('ts', '?')}"
+    return out
+
+
+def project(grad_bytes: float, ws: int, bits: int, bucket: int, m) -> list:
+    n = int(grad_bytes // 4)
+    wire_q = codec.wire_bytes(n, bits, bucket, 4)
+    wire_f = grad_bytes
+    # Per-rank codec seconds (SRA accounting; throughput is per input byte
+    # for quantize, per output byte for dequantize).
+    t_codec = (
+        grad_bytes * (1 + 1 / ws) / (m["quantize_GBps_in"] * 1e9)
+        + grad_bytes * (2 - 1 / ws) / (m["dequantize_GBps_out"] * 1e9)
+    )
+    t_comp = m["compute_ms"] / 1e3
+    rows = []
+    for name, bw in REGIMES:
+        factor = 2 * (ws - 1) / ws
+        t_f = t_comp + factor * wire_f / bw
+        t_q = t_comp + t_codec + factor * wire_q / bw
+        rows.append(
+            {
+                "regime": name,
+                "fp32_step_ms": round(t_f * 1e3, 2),
+                "q_step_ms": round(t_q * 1e3, 2),
+                "speedup": round(t_f / t_q, 2),
+            }
+        )
+    return rows
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--grad-mb", type=float, default=None)
+    ap.add_argument("--compute-ms", type=float, default=None)
+    ap.add_argument("--ws", type=int, default=8)
+    ap.add_argument("--bits", type=int, default=4)
+    ap.add_argument("--bucket", type=int, default=512)
+    ap.add_argument(
+        "--log", default=os.path.join(os.path.dirname(__file__), "..",
+                                      "BENCH_LOG.jsonl")
+    )
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args()
+
+    m = newest_codec_numbers(args.log)
+    if args.compute_ms is not None:
+        m["compute_ms"] = args.compute_ms
+    grad_mb = args.grad_mb if args.grad_mb is not None else m["grad_mb"]
+    rows = project(grad_mb * 2**20, args.ws, args.bits, args.bucket, m)
+    header = {
+        "model": f"{grad_mb:.0f} MB fp32 grads, compute {m['compute_ms']:.2f} ms",
+        "ws": args.ws,
+        "bits": args.bits,
+        "bucket": args.bucket,
+        "codec": (
+            f"quantize {m['quantize_GBps_in']:.0f} GB/s(in), "
+            f"dequantize {m['dequantize_GBps_out']:.0f} GB/s(out)"
+        ),
+        "provenance": m["provenance"],
+    }
+    if args.json:
+        print(json.dumps({"config": header, "rows": rows}))
+        return
+    print(f"# Projected DP step rate — {header['model']}")
+    print(
+        f"# ws={args.ws} bits={args.bits} bucket={args.bucket}; "
+        f"codec: {header['codec']}\n# provenance: {header['provenance']}\n"
+    )
+    print(f"| {'regime':<24} | fp32 step | {args.bits}-bit step | speedup |")
+    print("|" + "-" * 26 + "|-----------|------------|---------|")
+    for r in rows:
+        print(
+            f"| {r['regime']:<24} | {r['fp32_step_ms']:>7.2f}ms "
+            f"| {r['q_step_ms']:>8.2f}ms | {r['speedup']:>6.2f}x |"
+        )
+
+
+if __name__ == "__main__":
+    main()
