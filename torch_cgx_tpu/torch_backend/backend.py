@@ -33,14 +33,17 @@ MPI/CUDA: the *architecture* is preserved —
 * NotImplementedError on ``allreduce_coalesced`` like the reference
   (ProcessGroupCGX.cc:422-428).
 
-What is *not* preserved (deliberately — SURVEY.md §7 stance): the transport.
-MPI point-to-point + SHM/CUDA-IPC (L2/L0) collapse into the c10d **Store**
-the process group is constructed with: puts/gets of compressed byte
-payloads, with refcounted key GC. On a TPU host the heavy compute path is
-the JAX-native front end; this bridge exists for drop-in
-``torch.distributed`` compatibility, so its transport favors portability
-(any Store: TCP, file) over raw bandwidth, while the codec — the actual
-CPU work — runs in the native C++ core when built.
+The transport re-expresses the reference's two-plane split (SURVEY.md §7):
+the c10d **Store** the group is constructed with is the portable control
+plane (ordering, rendezvous, refcounted key GC, cross-host payloads), and
+same-host ranks additionally carry payload bytes over an mmap'd **/dev/shm
+data plane** (``shm.py`` — the shm_communicator.cc role; headers + acks
+stay in the store). Groups spanning hosts run the reference's two-level
+leader reduction (intra SHM reduce → leader cross-reduce → intra
+broadcast, mpi_allreduce_operations.cc:139-185). ``abort()`` poisons the
+group through the store so peers fail fast (ProcessGroupCGX.cc:295-298),
+and every blocking wait is bounded by the group timeout. The codec — the
+actual CPU work — runs in the native C++ core when built.
 
 The codec math and wire format are byte-identical to the JAX/Pallas codec
 (``ops/codec_host.py``), so a payload compressed here decodes on the TPU
