@@ -57,9 +57,53 @@ def parse_args():
     p.add_argument("--d-model", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
     p.add_argument("--vocab", type=int, default=512)
+    p.add_argument("--data", choices=["synthetic", "text"], default="synthetic",
+                   help="'text' = REAL byte-level LM on this repo's own "
+                        "documentation (genuine English prose, zero "
+                        "egress); vocab forced to 256, 90/10 val split, "
+                        "val_loss reported")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--cpu", action="store_true", help="force the virtual CPU mesh")
     return p.parse_args()
+
+
+def load_text_corpus(seq: int):
+    """Byte-level windows over the repo's Markdown docs — real English
+    text available with zero network egress. Returns (train, val) int32
+    arrays of (n, seq) token rows (next-token targets are the shifted
+    row, as for the synthetic stream).
+
+    The 90/10 split is on CONTIGUOUS BYTES, before any windowing: train
+    windows overlap (stride seq/2) for more rows, val windows are
+    disjoint (stride seq) and share no bytes with any train window — so
+    val_loss is genuinely held out, not memorizable from overlapping
+    neighbors."""
+    import glob
+
+    import numpy as np
+
+    paths = sorted(
+        glob.glob(os.path.join(_REPO, "*.md"))
+        + glob.glob(os.path.join(_REPO, "docs", "*.md"))
+    )
+    blob = b"\n\n".join(open(p, "rb").read() for p in paths)
+    tokens = np.frombuffer(blob, np.uint8).astype(np.int32)
+
+    def windows(t, stride):
+        n = (len(t) - seq - 1) // stride
+        if n <= 0:
+            raise SystemExit(
+                f"gpt2_train.py: text corpus too small ({len(tokens)} "
+                f"bytes across {len(paths)} .md files) for seq {seq} — "
+                "run from a repo checkout or shrink --seq"
+            )
+        return np.stack([t[i * stride : i * stride + seq] for i in range(n)])
+
+    cut = int(0.9 * len(tokens))
+    train = windows(tokens[:cut], seq // 2)
+    val = windows(tokens[cut:], seq)
+    rng = np.random.default_rng(0)
+    return train[rng.permutation(len(train))], val
 
 
 def main():
@@ -114,6 +158,8 @@ def main():
 
     if args.sp > 1 and args.cross > 1:
         raise SystemExit("--sp composes with flat --dp only (not --cross)")
+    if args.data == "text":
+        args.vocab = 256  # byte-level LM
     attn = make_sp_attention("sp", impl="ring") if args.sp > 1 else None
     cfg = GPT2Config.tiny(
         vocab_size=args.vocab,
@@ -125,15 +171,24 @@ def main():
     model = GPT2(cfg, attn_fn=attn) if attn else GPT2(cfg)
     init_model = GPT2(cfg)  # init outside shard_map: plain attention
 
-    # Synthetic learnable stream: shifted token patterns.
-    # Size the synthetic corpus off the batch so any --batch works: the
-    # window below needs len(data) > batch, and len(data) - batch must not
-    # divide batch or the rotation collapses to one repeated window
-    # (2048 and 2049 are coprime, so one of them never divides batch).
-    window = 2048 if args.batch % 2048 else 2049
-    n_rows = args.batch + window
-    data = (np.arange(args.seq)[None, :] + np.arange(n_rows)[:, None]) % args.vocab
-    data = data.astype(np.int32)
+    val_data = None
+    if args.data == "text":
+        data, val_data = load_text_corpus(args.seq)
+        if len(data) <= args.batch:
+            raise SystemExit(
+                f"text corpus too small: {len(data)} rows for batch "
+                f"{args.batch} at seq {args.seq}"
+            )
+    else:
+        # Synthetic learnable stream: shifted token patterns.
+        # Size the synthetic corpus off the batch so any --batch works: the
+        # window below needs len(data) > batch, and len(data) - batch must
+        # not divide batch or the rotation collapses to one repeated window
+        # (2048 and 2049 are coprime, so one of them never divides batch).
+        window = 2048 if args.batch % 2048 else 2049
+        n_rows = args.batch + window
+        data = (np.arange(args.seq)[None, :] + np.arange(n_rows)[:, None]) % args.vocab
+        data = data.astype(np.int32)
 
     tokens0 = jnp.asarray(data[: max(2, args.batch)])
     params = init_model.init(jax.random.PRNGKey(0), tokens0)["params"]
@@ -222,6 +277,7 @@ def main():
     summary = {
         "example": "gpt2_train",
         "mesh": {a: int(mesh.shape[a]) for a in axis_names},
+        "data": args.data,
         "bits": args.bits,
         "first_loss": losses[0],
         "final_loss": losses[-1],
@@ -231,6 +287,27 @@ def main():
         summary["steps_per_s"] = round(
             (args.steps - 1) / max(_time.time() - steady0, 1e-9), 3
         )
+    if val_data is not None and args.sp == 1:
+        # Held-out loss on real text: one fixed-shape plain jit (loss_fn
+        # has no collectives outside sp mode; sharded/replicated params
+        # are ordinary jit inputs). sp mode skips val (its loss_fn uses
+        # axis_index and must run inside shard_map).
+        rows = val_data
+        if len(rows) < args.batch:  # tiny corpora: tile up to one batch
+            reps = -(-args.batch // len(rows))
+            rows = np.concatenate([rows] * reps)
+        n_batches = max(1, min(4, len(rows) // args.batch))
+        eval_loss = jax.jit(loss_fn)
+        vals = [
+            float(
+                eval_loss(
+                    params,
+                    jnp.asarray(rows[b * args.batch : (b + 1) * args.batch]),
+                )
+            )
+            for b in range(n_batches)
+        ]
+        summary["val_loss"] = round(sum(vals) / len(vals), 4)
     print(json.dumps(summary))
 
 
