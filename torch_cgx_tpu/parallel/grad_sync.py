@@ -136,6 +136,15 @@ def compressed_allreduce_transform(
     :func:`make_train_step`'s ``error_feedback`` plumbing or manage the
     state placement yourself); declaring it replicated silently corrupts
     the residuals.
+
+    **Ring-transport caveat** (applies to ``make_train_step`` too): with
+    ``CGX_INNER_REDUCTION_TYPE=RING`` the measured residual covers the
+    FIRST scatter-reduce hop only — later hops requantize accumulated
+    partial sums on other devices and are treated as exact, so Ring EF is
+    an approximation (it under-counts compounded hop error). SRA (the
+    default) measures its wire residual exactly, byte-for-byte against
+    the actual fused/chunked stage-1 layout (tested). Prefer SRA when
+    running EF.
     """
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
 
@@ -212,7 +221,10 @@ def make_train_step(
     opt_state, ef, loss)`` where ``ef`` comes from
     :func:`init_error_feedback` — leaves are ``(ws, *param.shape)``
     f32 sharded over the sync axes on the leading device dim, so every
-    device keeps its own residual.
+    device keeps its own residual. NOTE: exact for the default SRA
+    transport; with ``CGX_INNER_REDUCTION_TYPE=RING`` the residual
+    covers the first scatter-reduce hop only (later hops' compounding
+    requantization is treated as exact) — prefer SRA when running EF.
 
     ``powersgd_rank=r`` replaces the quantized allreduce with PowerSGD
     low-rank compression (:mod:`.powersgd`) at that rank — the SAFE
