@@ -1,0 +1,108 @@
+#!/usr/bin/env python3
+"""Host-side A/B of the bridge's two byte planes: SHM arena vs raw Store.
+
+Spawns 2 local ranks over a FileStore, times N broadcasts of a --mb
+payload through (a) the same-host /dev/shm data plane and (b) the
+store-only transport (CGX_SHM=0), and appends one JSON line to
+BENCH_LOG.jsonl. No TPU needed — this measures the torch bridge's
+transport, the role the reference's shm_communicator.cc plays
+(/root/reference/src/common/shm_communicator.cc:116-177).
+
+    python tools/shm_bench.py --mb 64 --iters 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import multiprocessing as mp
+import os
+import sys
+import tempfile
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+
+def _rank_main(rank: int, ws: int, initfile: str, mb: int, iters: int, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import torch
+    import torch.distributed as dist
+
+    import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+
+    results = {}
+    n = mb * 1024 * 1024 // 4
+    for mode in ("shm", "store"):
+        os.environ["CGX_SHM"] = "1" if mode == "shm" else "0"
+        dist.init_process_group(
+            "cgx", init_method=f"file://{initfile}.{mode}", rank=rank,
+            world_size=ws,
+        )
+        t = torch.ones(n)
+        dist.broadcast(t, src=0)  # warm: arena growth, store probe
+        dist.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dist.broadcast(t, src=0)
+        dist.barrier()
+        results[mode] = (time.perf_counter() - t0) / iters
+        dist.destroy_process_group()
+    q.put((rank, results))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64)
+    ap.add_argument("--iters", type=int, default=5)
+    args = ap.parse_args()
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    tmp = tempfile.TemporaryDirectory(prefix="cgx_shm_bench_")
+    initfile = os.path.join(tmp.name, "store")
+    procs = [
+        ctx.Process(
+            target=_rank_main, args=(r, 2, initfile, args.mb, args.iters, q),
+            daemon=True,
+        )
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    try:
+        res = dict(q.get(timeout=600) for _ in procs)
+    finally:
+        # A crashed rank leaves its peer parked in a collective — don't
+        # hang the interpreter on a live child at exit.
+        for p in procs:
+            p.join(timeout=5)
+            if p.is_alive():
+                p.terminate()
+        tmp.cleanup()
+    # The receiver (rank 1) sees the transport cost end to end.
+    t_shm, t_store = res[1]["shm"], res[1]["store"]
+    rec = {
+        "tool": "shm_bench",
+        "metric": f"bridge_broadcast_{args.mb}MB",
+        "value": round(args.mb / 1024 / t_shm, 3),
+        "unit": "GB/s (shm)",
+        "vs_baseline": round(t_store / t_shm, 2),
+        "detail": {
+            "t_shm_ms": round(t_shm * 1e3, 1),
+            "t_store_ms": round(t_store * 1e3, 1),
+            "iters": args.iters,
+            "store": "FileStore",
+            "note": "vs_baseline = speedup of the shm data plane over "
+                    "the store-only transport on the same payload",
+        },
+        "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+    }
+    with open(os.path.join(_REPO, "BENCH_LOG.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()
