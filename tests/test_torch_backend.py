@@ -1511,3 +1511,42 @@ def _worker_layer_aligned(rank: int, ws: int) -> None:
 @pytest.mark.torch_bridge
 def test_layer_aligned_allreduce_ws4():
     _launch(_worker_layer_aligned, ws=4)
+
+
+def _worker_p2p_mixed_routing(rank: int, ws: int) -> None:
+    """Per-peer p2p channel routing in a mixed-host topology (simulated
+    hosts h0={0,1}, h1={2}): a same-host send/recv rides the SHM plane,
+    a cross-host one rides the store, and BOTH sides pick the same
+    channel (a mismatch deadlocks). The lone rank has no channel at all
+    yet interoperates."""
+    import torch
+    import torch.distributed as dist
+
+    os.environ["CGX_SHM_HOST_ID"] = f"testhost{min(rank // 2, 1)}"
+    sub = dist.new_group(ranks=list(range(ws)))
+    be = _backend_of(sub)
+    if rank in (0, 1):
+        assert be._shm is not None and not be._all_local
+    else:
+        assert be._shm is None  # alone on its host
+    n = 4096
+    if rank == 0:
+        dist.send(torch.full((n,), 1.0), dst=1, group=sub)
+        dist.send(torch.full((n,), 2.0), dst=2, group=sub)
+        # exactly ONE p2p payload took the shm plane (the local peer's)
+        assert be._shm.n_puts == 1, be._shm.n_puts
+    elif rank == 1:
+        t = torch.zeros(n)
+        dist.recv(t, src=0, group=sub)
+        assert torch.equal(t, torch.full((n,), 1.0))
+        assert be._shm.n_takes == 1, be._shm.n_takes
+    else:
+        t = torch.zeros(n)
+        dist.recv(t, src=0, group=sub)
+        assert torch.equal(t, torch.full((n,), 2.0))
+    os.environ.pop("CGX_SHM_HOST_ID")
+
+
+@pytest.mark.torch_bridge
+def test_p2p_mixed_routing_ws3():
+    _launch(_worker_p2p_mixed_routing, ws=3)

@@ -40,6 +40,15 @@ def _rank_main(rank: int, ws: int, initfile: str, mb: int, iters: int, q):
             "cgx", init_method=f"file://{initfile}.{mode}", rank=rank,
             world_size=ws,
         )
+        pg = dist.distributed_c10d._get_default_group()
+        if mode == "shm" and getattr(pg, "_shm", None) is None:
+            # A silent store fallback (unwritable /dev/shm, failed
+            # rendezvous) would let us record store-vs-store as an "shm"
+            # number — refuse instead.
+            raise RuntimeError(
+                "shm plane did not engage (store fallback?) — refusing "
+                "to record a bogus shm measurement"
+            )
         t = torch.ones(n)
         dist.broadcast(t, src=0)  # warm: arena growth, store probe
         dist.barrier()

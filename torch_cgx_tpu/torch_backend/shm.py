@@ -284,6 +284,9 @@ class ShmChannel:
         )
         self._attached: Dict[str, mmap.mmap] = {}
         self._attach_lock = threading.Lock()
+        # Plane-usage counters (observability + routing tests).
+        self.n_puts = 0
+        self.n_takes = 0
         # Safety net: unlink /dev/shm files even when the owner never calls
         # ProcessGroup.shutdown() (crash/KeyboardInterrupt paths). close()
         # is idempotent.
@@ -315,6 +318,8 @@ class ShmChannel:
         gen, off, size = self._arena.write(data, hkey + "/ack", readers)
         path = self._arena.path_of(gen)
         self._store.set(hkey, f"{path}:{gen}:{off}:{size}".encode())
+        with self._attach_lock:  # worker + p2p pool threads share us
+            self.n_puts += 1
 
     def take(self, key: str) -> np.ndarray:
         hkey = self.HDR + key
@@ -325,6 +330,8 @@ class ShmChannel:
         off, size = int(off_s), int(size_s)
         out = self._read(path, off, size)
         self._store.add(hkey + "/ack", 1)
+        with self._attach_lock:
+            self.n_takes += 1
         return out
 
     @staticmethod
