@@ -195,6 +195,13 @@ def _check_exact(ws: int, rank: int, algo: str) -> None:
                 dist.all_reduce(t)
                 want = torch.full((n,), _sum_expect(ws), dtype=dtype)
                 assert torch.equal(t, want), (algo, bits, n, dtype, t[:4])
+    # int32 WITH bits set: ints bypass compression and stay bit-exact —
+    # the reference's exactness sweep includes int32 (test_cgx.py:9-19).
+    ti = torch.full((1000,), rank + 1, dtype=torch.int32)
+    dist.all_reduce(ti)
+    assert torch.equal(
+        ti, torch.full((1000,), int(_sum_expect(ws)), dtype=torch.int32)
+    )
     os.environ.pop("CGX_INNER_REDUCTION_TYPE")
     os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS")
 
