@@ -32,12 +32,33 @@ def parse_args():
     p.add_argument("--lr", type=float, default=0.05)
     p.add_argument("--quantization-bits", type=int, default=4)
     p.add_argument("--quantization-bucket-size", type=int, default=1024)
+    p.add_argument("--simulate-hosts", type=int, default=1,
+                   help="split ranks over N simulated hosts "
+                        "(CGX_SHM_HOST_ID override): >1 exercises the "
+                        "two-level leader reduction exactly as a real "
+                        "multi-host launch would")
     p.add_argument("--seed", type=int, default=0)
     return p.parse_args()
 
 
 def train(rank: int, ws: int, init_method: str, args) -> None:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")  # codec runs on host
+    if args.simulate_hosts > 1:
+        if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
+            # External (torchrun) launch may span REAL machines: a shared
+            # simhost id would engage /dev/shm between processes that
+            # share no memory. Only the self-spawned single-machine mode
+            # may simulate hosts.
+            raise SystemExit(
+                "--simulate-hosts requires the self-spawned launcher; "
+                "under torchrun the real host topology applies"
+            )
+        # Balanced contiguous split yielding exactly min(hosts, ws)
+        # non-empty groups (ceil-division could merge two requested
+        # hosts when ws % hosts != 0).
+        os.environ["CGX_SHM_HOST_ID"] = (
+            f"simhost{rank * args.simulate_hosts // ws}"
+        )
     import torch
     import torch.distributed as dist
     import torch.nn as nn
@@ -91,10 +112,12 @@ def train(rank: int, ws: int, init_method: str, args) -> None:
             print(f"step {step + 1}/{args.steps}: loss={last:.4f}", flush=True)
 
     if rank == 0:
+        pg = dist.distributed_c10d._get_default_group()
         print(json.dumps({
             "example": "torch_ddp_train",
             "world_size": ws,
             "bits": args.quantization_bits,
+            "hosts": len(set(getattr(pg, "_host_by_rank", []) or ["one"])),
             "first_loss": first,
             "final_loss": last,
         }), flush=True)

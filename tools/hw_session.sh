@@ -50,6 +50,17 @@ run 900 "tpu-marked tests" env CGX_TEST_TPU=1 python -m pytest tests/ -m tpu -q 
 # --- the driver's headline line (also appended to BENCH_LOG) ------------
 run 1800 "bench.py" python bench.py
 
+# --- round-5 additions ---------------------------------------------------
+# Host-side bridge transport A/B (no chip needed, but record it alongside).
+run 600 "shm_bench" env -u PYTHONPATH python tools/shm_bench.py --mb 64 --iters 5
+# Re-project the step-rate table from whatever this session just measured
+# (project_steprate reads the freshest codec numbers out of BENCH_LOG).
+# CPU-pinned: it only does arithmetic, and must not touch the (possibly
+# re-wedged) device transport this late in the session.
+run 120 "projection refresh" env JAX_PLATFORMS=cpu python tools/project_steprate.py
+run 120 "projection ws=32 -> log" bash -c \
+  "env JAX_PLATFORMS=cpu python tools/project_steprate.py --ws 32 --json >> BENCH_LOG.jsonl"
+
 echo
 echo "=== session complete ($FAILED step(s) failed); tail of BENCH_LOG.jsonl ==="
 tail -n 20 BENCH_LOG.jsonl 2>/dev/null
