@@ -620,7 +620,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             msg = "unknown"
         raise RuntimeError(f"cgx: process group aborted ({msg})")
 
-    def _wait_key(self, key: str) -> None:
+    def _wait_key(self, key: str, bounded: bool = True) -> None:
         """Block until ``key`` exists OR the group is aborted.
 
         The reference's runLoop drains the queue and calls MPI_Abort on
@@ -644,17 +644,36 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
         slice_ = _dt.timedelta(milliseconds=200)
         deadline = _time.monotonic() + self._timeout_s
+        fast_fails = 0
         while True:
+            t0 = _time.monotonic()
             try:
                 self._store.wait([key], slice_)
                 return
-            except Exception:
-                pass  # timeout slice elapsed (or transient store hiccup)
+            except Exception as e:
+                # A wait that fails in well under its slice did not time
+                # out — it's a store error. Tolerate transients, but a
+                # BROKEN store (deleted backing file, dead server) must
+                # surface instead of hot-spinning, especially for
+                # bounded=False waiters that have no deadline.
+                if _time.monotonic() - t0 < 0.1:
+                    fast_fails += 1
+                    if fast_fails >= 5:
+                        raise RuntimeError(
+                            f"cgx: store wait failing fast for {key!r} "
+                            f"({e}) — broken store?"
+                        ) from e
+                    _time.sleep(0.05)
+                else:
+                    fast_fails = 0  # a full slice elapsed: normal timeout
             if self._aborted or self._check_store([self._abort_key]):
                 self._raise_abort()
             if self._shutdown.is_set():
                 raise RuntimeError("cgx: process group is shut down")
-            if _time.monotonic() > deadline:
+            # bounded=False: an any-source receiver may legitimately idle
+            # forever (MPI ANY_SOURCE semantics) — only abort/shutdown
+            # break it out.
+            if bounded and _time.monotonic() > deadline:
                 raise RuntimeError(
                     f"cgx: timed out after {self._timeout_s:.0f}s waiting "
                     f"for {key!r} (peer dead or stalled?)"
@@ -1532,24 +1551,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     seq = self._p2p_ann.get(tag, 0) + 1
                     self._p2p_ann[tag] = seq
                 ann_key = f"cgxp2pann/{self._rank}/t{tag}/{seq}"
-                while True:
-                    # Block in the store's own get; retry on its timeout so
-                    # an any-source receiver can idle indefinitely (the old
-                    # poll loop's semantics) without a sleep spin. A get
-                    # failing *quickly* is a real store error, not a
-                    # timeout — re-raise instead of spinning.
-                    import time as _time
-
-                    t0 = _time.monotonic()
-                    try:
-                        src = int(bytes(self._store.get(ann_key)).decode())
-                        break
-                    except Exception:
-                        if (
-                            self._shutdown.is_set()
-                            or _time.monotonic() - t0 < 1.0
-                        ):
-                            raise
+                # Unbounded (MPI ANY_SOURCE may idle forever) but abort-
+                # and shutdown-aware: parks in store.wait slices.
+                self._wait_key(ann_key, bounded=False)
+                src = int(bytes(self._store.get(ann_key)).decode())
                 self._delete_key(ann_key)
                 with self._p2p_claim:
                     used = self._p2p_ann_used.get((src, tag), 0)
