@@ -168,6 +168,26 @@ def test_flat_path_wire_matches_xla_tpu():
         )
 
 
+@pytest.mark.tpu  # compiled-kernel check of the with_add Mosaic lowering
+def test_fused_add_tpu():
+    rows, bits, bucket = 2, 4, 512
+    m = 64 * bucket
+    xs = jnp.asarray(
+        np.random.default_rng(21).normal(size=(rows, m)), jnp.float32
+    )
+    acc = jnp.asarray(
+        np.random.default_rng(22).normal(size=(rows, m)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket)
+    fused = codec_pallas.dequantize_batch(
+        q, add_to=acc, out_dtype=jnp.float32
+    )
+    plain = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32)
+    np.testing.assert_array_equal(
+        np.asarray(fused), np.asarray(acc) + np.asarray(plain)
+    )
+
+
 @pytest.mark.tpu  # pltpu.prng_seed has no CPU-interpret lowering
 def test_pallas_stochastic_envelope():
     xs = jnp.asarray(
@@ -237,6 +257,48 @@ def test_pallas_skip_incomplete_matches_xla(m):
     np.testing.assert_allclose(
         np.asarray(y_acc), np.asarray(y) + 1.0, rtol=2e-6, atol=5e-7
     )
+
+
+def test_fused_add_matches_unfused():
+    """The fused decompress-accumulate (UnpackArray<ADD> parity,
+    cuda_compression_operations.cu:474-544) must be BIT-identical to
+    decode-then-add: same op order (acc + (bmin + unit*lvl)), just one
+    fewer HBM round trip. Engages only on the flat fast path with an
+    exactly-tiling accumulator; a mismatched accumulator width falls back
+    to the unfused add with the same values."""
+    rows, bits, bucket = 2, 4, 128
+    m = 64 * bucket  # nb_r = 64 full chunks per row -> flat path, no pad
+    xs = jnp.asarray(np.random.default_rng(11).normal(size=(rows, m)), jnp.float32)
+    acc = jnp.asarray(np.random.default_rng(12).normal(size=(rows, m)), jnp.float32)
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    fused = codec_pallas.dequantize_batch(
+        q, add_to=acc, interpret=True, out_dtype=jnp.float32
+    )
+    plain = codec_pallas.dequantize_batch(
+        q, interpret=True, out_dtype=jnp.float32
+    )
+    np.testing.assert_array_equal(
+        np.asarray(fused), np.asarray(acc) + np.asarray(plain)
+    )
+    # XLA-oracle agreement: equal up to the documented FMA-vs-mul+add
+    # codegen delta between decode implementations (1 ulp).
+    y_ref = jax.vmap(
+        lambda qq, a: codec.dequantize(qq, add_to=a, out_dtype=jnp.float32)
+    )(q, acc)
+    np.testing.assert_allclose(
+        np.asarray(fused), np.asarray(y_ref), rtol=2e-6, atol=5e-7
+    )
+    # Unaligned numel (edge-padded flat path): falls back, same values.
+    m2 = 64 * bucket - 57
+    xs2, acc2 = xs[:, :m2], acc[:, :m2]
+    q2 = codec_pallas.quantize_batch(xs2, bits, bucket, interpret=True)
+    out2 = codec_pallas.dequantize_batch(
+        q2, add_to=acc2, interpret=True, out_dtype=jnp.float32
+    )
+    want2 = acc2 + codec_pallas.dequantize_batch(
+        q2, interpret=True, out_dtype=jnp.float32
+    )
+    np.testing.assert_array_equal(np.asarray(out2), np.asarray(want2))
 
 
 def test_dispatch_skip_incomplete_pallas(monkeypatch):

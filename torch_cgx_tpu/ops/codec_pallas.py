@@ -11,8 +11,10 @@ in one VMEM pass per direction:
   array, gpu_rand.h:22-58) -> sublane bit-pack into 32-bit words, without
   materializing levels in HBM.
 * ``dequantize``: unpack -> decode in one kernel pass. The accumulate of
-  ``dequantize_batch(add_to=...)`` (``UnpackArray<ADD>`` analogue) is
-  applied as a plain XLA add on the kernel output, not fused in-kernel.
+  ``dequantize_batch(add_to=...)`` (``UnpackArray<ADD>``, .cu:474-544) is
+  FUSED in-kernel on the flat fast path when the accumulator tiles the
+  kernel output exactly (``with_add`` — the decoded floats never round-trip
+  HBM); other shapes take a plain XLA add on the kernel output.
 
 The wire format (codec.py: chunked-sublane layout) was designed around these
 kernels: a chunk is 32 buckets, i.e. a ``(32, bucket_size)`` tile of the
@@ -343,22 +345,33 @@ def _quantize_flat_impl(
 
 
 @functools.partial(
-    jax.jit, static_argnames=("bits", "bucket_size", "interpret", "tc")
+    jax.jit,
+    static_argnames=("bits", "bucket_size", "interpret", "tc", "with_add"),
 )
 def _dequantize_flat_impl(
     words: jax.Array,
     meta: jax.Array,
+    add_to: Optional[jax.Array] = None,
     *,
     bits: int,
     bucket_size: int,
     interpret: bool = False,
     tc: int = 8,
+    with_add: bool = False,
 ):
     """Zero-relayout dequantize: words (rows, W) int32 + meta (rows, nb_r, 2)
     -> (rows, nb_r*B) f32. Word blocks are natural (., 128) flat rows like
     :func:`_quantize_flat_impl`'s output; the decoded values are computed on
     a full-vreg 2-D ``(tc*32*rb, 128)`` shape (measured ~1.4 ms for 512 MB
-    at 4-bit on v5e — near the HBM write floor)."""
+    at 4-bit on v5e — near the HBM write floor).
+
+    ``with_add``: fuse the decompress-accumulate (the reference's
+    ``UnpackArray<ADD>`` kernel mode, cuda_compression_operations.cu:
+    474-544) — ``add_to (rows, nb_r*B) f32`` streams through the same
+    kernel and the output is ``add_to + decoded``, skipping one HBM
+    round trip of the decoded floats that a separate XLA add would pay.
+    Values are bit-identical to the unfused add (same op order:
+    ``acc + (bmin + unit*lvl)``)."""
     rows, w_row = words.shape
     b = bucket_size
     rb = b // 128
@@ -366,7 +379,11 @@ def _dequantize_flat_impl(
     n_chunks = rows * nb_r // CHUNK_BUCKETS
     s_rows = tc * CHUNK_BUCKETS * rb
 
-    def kernel(w_ref, m_ref, out_ref):
+    def kernel(w_ref, m_ref, *rest):
+        if with_add:
+            acc_ref, out_ref = rest
+        else:
+            (out_ref,) = rest
         w4 = w_ref[:].reshape(tc, bits, rb, 128)
         sub = jax.lax.broadcasted_iota(
             jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
@@ -377,28 +394,37 @@ def _dequantize_flat_impl(
         m2 = m_ref[:]
         unit = m2[:, 0:1].reshape(tc, CHUNK_BUCKETS, 1, 1)
         bmin = m2[:, 1:2].reshape(tc, CHUNK_BUCKETS, 1, 1)
-        out_ref[:] = (bmin + unit * lvl.astype(jnp.float32)).reshape(
-            s_rows, 128
-        )
+        vals = (bmin + unit * lvl.astype(jnp.float32)).reshape(s_rows, 128)
+        out_ref[:] = acc_ref[:] + vals if with_add else vals
 
     wv = words.reshape(rows * w_row // 128, 128)
     mv = meta.reshape(rows * nb_r, 2)
+    in_specs = [
+        pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((tc * CHUNK_BUCKETS, 2), lambda i: (i, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [wv, mv]
+    if with_add:
+        in_specs.append(
+            pl.BlockSpec((s_rows, 128), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM)
+        )
+        operands.append(
+            add_to.astype(jnp.float32).reshape(rows * nb_r * b // 128, 128)
+        )
     out = pl.pallas_call(
         kernel,
         grid=(n_chunks // tc,),
-        in_specs=[
-            pl.BlockSpec((tc * bits * rb, 128), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((tc * CHUNK_BUCKETS, 2), lambda i: (i, 0),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((s_rows, 128), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (n_chunks * CHUNK_BUCKETS * rb, 128), jnp.float32
         ),
         interpret=interpret,
-    )(wv, mv)
+    )(*operands)
     return out.reshape(rows, nb_r * b)
 
 
@@ -646,14 +672,28 @@ def dequantize_batch(
     meta = q.meta.astype(jnp.float32)  # (rows, nb_r, 2) pair layout
 
     if t_r == 0 and b % 128 == 0:
+        # Fused decompress-accumulate (UnpackArray<ADD>, .cu:474-544) when
+        # the accumulator tiles the kernel's exact output shape: skips one
+        # HBM round trip of the decoded floats. Bit-identical to the
+        # unfused add (same op order), so no value-level fallback delta.
+        fuse_add = (
+            add_to is not None
+            and q.residual.shape[-1] == 0
+            and q.numel_main == nb_r * b
+            and tuple(add_to.shape) == (rows, q.numel_main)
+        )
         vals = _dequantize_flat_impl(
             jax.lax.bitcast_convert_type(q.packed, jnp.int32),
             meta,
+            add_to if fuse_add else None,
             bits=q.bits,
             bucket_size=b,
             interpret=interpret,
             tc=_pipe_tc(rows * c_r, b),
+            with_add=fuse_add,
         )[:, : q.numel_main]
+        if fuse_add:
+            return vals.astype(out_dtype)
     else:
         parts = []
         head_words = c_r * q.bits * b
