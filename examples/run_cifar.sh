@@ -2,6 +2,11 @@
 # Counterpart of the reference's examples/run_cifar.sh (mpirun -np N ...):
 # on TPU the launch is a single SPMD process over the device mesh.
 # 4-bit gradients, bucket 1024, ResNet-18 — the BASELINE.md north-star run.
+#
+# Real data: pass --data-dir DIR with a cifar10.npz, or use the bundled
+# real handwritten-digit scans (no download): --dataset digits.
+# The fp32-vs-quantized A/B (step rate + held-out top-1) is one command:
+#   bash tools/pod_ab.sh              # CIFAR_DATA=... for the real npz
 set -e
 cd "$(dirname "$0")/.."
 python examples/cifar_train.py \
