@@ -1557,3 +1557,33 @@ def _worker_p2p_mixed_routing(rank: int, ws: int) -> None:
 @pytest.mark.torch_bridge
 def test_p2p_mixed_routing_ws3():
     _launch(_worker_p2p_mixed_routing, ws=3)
+
+
+def test_dead_arena_reaping(tmp_path):
+    """Arenas from a SIGKILLed writer (atexit never ran) are reaped by the
+    next channel creation in the same directory. Ownership = a held flock
+    (namespace-proof; kernel-released on any death): locked and young and
+    untagged files are all spared."""
+    import fcntl
+    import time
+
+    from torch_cgx_tpu.torch_backend import shm as shm_mod
+
+    d = str(tmp_path)
+    old = time.time() - 2 * shm_mod._REAP_GRACE_S
+    dead = tmp_path / "cgx-abc123-p999999999-r0-g1"  # orphan, past grace
+    young = tmp_path / "cgx-bbb999-p999999998-r0-g1"  # orphan, in grace
+    live = tmp_path / f"cgx-def456-p{os.getpid()}-r1-g2"  # flock held
+    legacy = tmp_path / "cgx-oldstyle-r0-g1"  # untagged: never touched
+    for f in (dead, young, live, legacy):
+        f.write_bytes(b"x")
+    for f in (dead, live, legacy):
+        os.utime(f, (old, old))
+    fd = os.open(str(live), os.O_RDWR)
+    try:
+        fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+        shm_mod._reap_dead_arenas(d)
+        assert not dead.exists()
+        assert young.exists() and live.exists() and legacy.exists()
+    finally:
+        os.close(fd)

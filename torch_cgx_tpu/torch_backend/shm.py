@@ -41,6 +41,7 @@ from __future__ import annotations
 import atexit
 import mmap
 import os
+import re
 import socket
 import threading
 import uuid
@@ -79,6 +80,56 @@ def _round_up(n: int, a: int) -> int:
     return -(-n // a) * a
 
 
+_PID_RE = re.compile(r"^cgx-[0-9a-f]+-p(\d+)-r\d+-g\d+$")
+_REAP_GRACE_S = 120.0
+
+
+def _reap_dead_arenas(directory: str) -> None:
+    """Unlink arena files whose owner is gone (shm_utils.cc-style hygiene
+    for the crash path: SIGKILL skips atexit, so files would pin tmpfs
+    forever).
+
+    Ownership is probed with a non-blocking ``flock`` — the writer holds
+    an exclusive lock on every generation file for its lifetime, and the
+    kernel releases it on ANY death including SIGKILL. Unlike a pid
+    liveness check, this is correct across PID namespaces (containers
+    sharing /dev/shm but not a pid namespace). Files younger than a grace
+    window are spared even when orphaned, so a reader racing to complete
+    a just-dead writer's in-flight message usually still can; losers of
+    that race get :class:`RuntimeError` from ``take`` (see ``_read``),
+    not a raw FileNotFoundError."""
+    import fcntl
+    import time as _time
+
+    try:
+        entries = os.listdir(directory)
+    except OSError:
+        return
+    now = _time.time()
+    for name in entries:
+        if not _PID_RE.match(name):
+            continue
+        path = os.path.join(directory, name)
+        try:
+            if now - os.stat(path).st_mtime < _REAP_GRACE_S:
+                continue
+            fd = os.open(path, os.O_RDWR)
+        except OSError:
+            continue
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                continue  # a live writer (any namespace) holds the lock
+            try:
+                os.unlink(path)
+                log.debug("reaped orphaned shm arena %s", name)
+            except OSError:
+                pass
+        finally:
+            os.close(fd)
+
+
 class _Region:
     __slots__ = ("gen", "off", "size", "ack_key", "readers", "freed")
 
@@ -92,17 +143,25 @@ class _Region:
 
 
 class _GenFile:
-    """One mmap'd backing file: a circular bump allocator."""
+    """One mmap'd backing file: a circular bump allocator.
+
+    The creating process holds an exclusive ``flock`` on the fd for the
+    file's lifetime — the liveness signal :func:`_reap_dead_arenas`
+    probes (released by the kernel on any death, SIGKILL included)."""
 
     def __init__(self, path: str, capacity: int):
+        import fcntl
+
         self.path = path
         self.capacity = capacity
-        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
+        self.fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
-            os.ftruncate(fd, capacity)
-            self.mm = mmap.mmap(fd, capacity)
-        finally:
-            os.close(fd)
+            os.ftruncate(self.fd, capacity)
+            fcntl.flock(self.fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            self.mm = mmap.mmap(self.fd, capacity)
+        except Exception:
+            os.close(self.fd)
+            raise
         self.head = 0  # next write offset
         self.tail = 0  # oldest live byte
         self.live = 0  # bytes in flight (incl. wrap gaps)
@@ -119,6 +178,10 @@ class _GenFile:
         try:
             self.mm.close()
         except Exception:
+            pass
+        try:
+            os.close(self.fd)  # releases the ownership flock
+        except OSError:
             pass
         if unlink:
             try:
@@ -277,8 +340,11 @@ class ShmChannel:
         # Every writer coins its own arena name and ships it in each
         # message header — no group-wide session rendezvous (which would
         # need an elected coiner and deadlock if that rank had no local
-        # peers of its own).
-        name = f"cgx-{uuid.uuid4().hex[:12]}-r{rank}"
+        # peers of its own). The owner PID is embedded so a later channel
+        # can reap arenas whose writer died without running atexit
+        # (SIGKILL/OOM — close() never fires there).
+        _reap_dead_arenas(self._dir)
+        name = f"cgx-{uuid.uuid4().hex[:12]}-p{os.getpid()}-r{rank}"
         self._arena = ShmArena(
             self._dir, name, self._ack_count, self._drop_keys
         )
@@ -348,7 +414,14 @@ class ShmChannel:
         with self._attach_lock:
             mm = self._attached.get(path)
             if mm is None:
-                fd = os.open(path, os.O_RDONLY)
+                try:
+                    fd = os.open(path, os.O_RDONLY)
+                except FileNotFoundError:
+                    raise RuntimeError(
+                        f"cgx shm: writer's arena {path!r} is gone — the "
+                        "sending rank died (its orphaned arena may have "
+                        "been reaped past the grace window)"
+                    ) from None
                 try:
                     mm = mmap.mmap(fd, 0, prot=mmap.PROT_READ)
                 finally:
