@@ -1587,3 +1587,29 @@ def test_dead_arena_reaping(tmp_path):
         assert young.exists() and live.exists() and legacy.exists()
     finally:
         os.close(fd)
+
+
+def _worker_shm_dir_override(rank: int, ws: int) -> None:
+    """CGX_SHM_DIR relocates the arena files (containers where /dev/shm is
+    tiny or not shared); the plane still engages and carries payloads."""
+    import glob
+
+    import torch
+    import torch.distributed as dist
+
+    d = os.path.join(tempfile.gettempdir(), f"cgx_shmdir_test_{ws}")
+    os.makedirs(d, exist_ok=True)
+    os.environ["CGX_SHM_DIR"] = d
+    sub = dist.new_group(ranks=list(range(ws)))
+    be = _backend_of(sub)
+    assert be._shm is not None and be._shm._dir == d
+    t = torch.full((65536,), float(rank + 1))
+    dist.all_reduce(t, group=sub)
+    assert t[0].item() == _sum_expect(ws)
+    assert glob.glob(os.path.join(d, "cgx-*")), "no arena files in override dir"
+    os.environ.pop("CGX_SHM_DIR")
+
+
+@pytest.mark.torch_bridge
+def test_shm_dir_override_ws2():
+    _launch(_worker_shm_dir_override, ws=2)
