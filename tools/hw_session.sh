@@ -5,8 +5,18 @@
 # loses only the remaining steps, not the evidence (the round-3 lesson).
 #
 #   bash tools/hw_session.sh            # full program (~15-25 min)
-#   bash tools/hw_session.sh quick      # probe + sweep only, no tests/bench
+#   bash tools/hw_session.sh quick      # sweep only, no tests/bench
 #
+# Round-5 lesson (2026-07-31 session): a step killed MID-DEVICE-OP (the
+# tc=32 Mosaic compile hung past its timeout) wedged the remote transport
+# for every subsequent fresh process — the rest of the session burned
+# 600 s per step learning the same fact, and bench.py never ran. Hence:
+#   * value order: bench.py and the production-path measurements run
+#     FIRST; experimental variant compiles (tc sweep, butterfly, raw mul)
+#     run LAST, where a wedge costs only the experiments;
+#   * after any step times out, a cheap transport probe decides whether
+#     to continue — two consecutive probe failures abort the session to
+#     stop the kill→wedge→kill spiral.
 # One python process per step: a wedged step kills that process, not the
 # session; keep operands <= 128 MB (docs/PERF_NOTES.md incident notes).
 set -u
@@ -14,54 +24,97 @@ cd "$(dirname "$0")/.."
 mode="${1:-full}"
 log() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
 
+probe() {  # cheap transport health check (fresh process, tiny compile)
+  timeout --kill-after=30 180 python -c "
+import jax
+assert float(jax.jit(lambda: jax.numpy.ones((8,8)).sum())()) == 64.0
+print('probe: transport ok')" 2>/dev/null
+}
+
 FAILED=0
-run() {  # run <timeout-s> <desc> <cmd...>
-  log "$2"
-  timeout "$1" "${@:3}"
+run_cpu() {  # run_cpu <timeout-s> <desc> <cmd...> — CPU-pinned steps: never
+  log "$2"   # probe the (possibly wedged) device transport on failure
+  timeout --kill-after=30 "$1" "${@:3}"
   rc=$?
   if [ $rc -ne 0 ]; then echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1)); fi
-  return 0  # keep going: later steps may still work
+  return 0
+}
+run() {  # run <timeout-s> <desc> <cmd...> — device steps
+  log "$2"
+  timeout --kill-after=30 "$1" "${@:3}"
+  rc=$?
+  if [ $rc -ne 0 ]; then
+    echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1))
+    # 124 = timeout TERM, 137 = timeout KILL: the step died mid-device-op.
+    # Other rcs (tracebacks, exec failures) never touched a wedge.
+    if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+      log "post-timeout transport probe"
+      if ! probe; then
+        sleep 60
+        if ! probe; then
+          echo "TRANSPORT WEDGED after '$2' — aborting the device steps"
+          echo "(re-run 'bash tools/hw_session.sh $mode' when the probe answers)"
+          return 1
+        fi
+      fi
+    fi
+  fi
+  return 0
 }
 
 log "transport probe"
-if ! timeout 240 python -c "import jax; print(jax.devices())"; then
+if ! probe; then
   echo "TRANSPORT DOWN — aborting session"; exit 2
 fi
 
-# --- the diagnosis sweep (PERF_NOTES.md) --------------------------------
-run 600 "read floor"            python tools/qbench.py read
-run 600 "nometa"                python tools/qbench.py nometa
-run 600 "metalane"              python tools/qbench.py metalane
-run 600 "current"               python tools/qbench.py current
-run 600 "current tc=4"          python tools/qbench.py current --tc 4
-run 600 "current tc=32"         python tools/qbench.py current --tc 32
-run 600 "current tc=64"         python tools/qbench.py current --tc 64
-run 600 "butterfly pack"        env CGX_PALLAS_PACK=butterfly python tools/qbench.py current
-run 600 "mul variant"           python tools/qbench.py mul
-run 600 "mul production knob"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current
-run 600 "mul + best-guess tc"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current --tc 32
-run 600 "dequant reference"     python tools/qbench.py dequant
+ABORTED=0
+session() {
+  # --- highest-value first: the driver's headline line ------------------
+  if [ "$mode" != quick ]; then
+    run 1800 "bench.py" python bench.py || return 1
+  fi
 
-[ "$mode" = quick ] && { echo "quick mode: done ($FAILED step(s) failed)"; exit $((FAILED > 0)); }
+  # --- production-path measurements (known-good compile shapes) ---------
+  run 600 "current"               python tools/qbench.py current || return 1
+  run 600 "dequant reference"     python tools/qbench.py dequant || return 1
+  run 600 "mul production knob"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current || return 1
+  run 600 "current tc=4"          python tools/qbench.py current --tc 4 || return 1
 
-# --- compiled-kernel correctness on the real chip -----------------------
-run 900 "tpu-marked tests" env CGX_TEST_TPU=1 python -m pytest tests/ -m tpu -q --no-header
+  if [ "$mode" != quick ]; then
+    # --- compiled-kernel correctness on the real chip -------------------
+    run 900 "tpu-marked tests" env CGX_TEST_TPU=1 python -m pytest tests/ -m tpu -q --no-header || return 1
+  fi
 
-# --- the driver's headline line (also appended to BENCH_LOG) ------------
-run 1800 "bench.py" python bench.py
+  # --- experimental sweep: new Mosaic lowerings, wedge-prone — LAST -----
+  run 600 "read floor"            python tools/qbench.py read --k 8 || return 1
+  run 600 "nometa"                python tools/qbench.py nometa --k 8 || return 1
+  run 600 "metalane"              python tools/qbench.py metalane --k 8 || return 1
+  run 600 "mul variant"           python tools/qbench.py mul --k 8 || return 1
+  run 600 "butterfly pack"        env CGX_PALLAS_PACK=butterfly python tools/qbench.py current || return 1
+  run 600 "mul + tc=4"            env CGX_CODEC_ENCODE=mul python tools/qbench.py current --tc 4 || return 1
+  run 600 "current tc=32"         python tools/qbench.py current --tc 32 || return 1
+  run 600 "current tc=64"         python tools/qbench.py current --tc 64 || return 1
+  return 0
+}
+session || ABORTED=1
 
-# --- round-5 additions ---------------------------------------------------
-# Host-side bridge transport A/B (no chip needed, but record it alongside).
-run 600 "shm_bench" env -u PYTHONPATH python tools/shm_bench.py --mb 64 --iters 5
-# Re-project the step-rate table from whatever this session just measured
-# (project_steprate reads the freshest codec numbers out of BENCH_LOG).
-# CPU-pinned: it only does arithmetic, and must not touch the (possibly
-# re-wedged) device transport this late in the session.
-run 120 "projection refresh" env JAX_PLATFORMS=cpu python tools/project_steprate.py
-run 120 "projection ws=32 -> log" bash -c \
-  "env JAX_PLATFORMS=cpu python tools/project_steprate.py --ws 32 --json >> BENCH_LOG.jsonl"
+# --- evidence-preserving epilogue (CPU only; must not touch the device,
+# --- which may be wedged by now) -----------------------------------------
+if [ "$mode" != quick ]; then
+  run_cpu 600 "shm_bench" env -u PYTHONPATH python tools/shm_bench.py --mb 64 --iters 5
+  # Re-project the step-rate table from whatever this session measured
+  # (project_steprate reads the freshest codec numbers out of BENCH_LOG).
+  run_cpu 120 "projection refresh" env JAX_PLATFORMS=cpu python tools/project_steprate.py
+  run_cpu 120 "projection ws=32 -> log" bash -c \
+    "env JAX_PLATFORMS=cpu python tools/project_steprate.py --ws 32 --json >> BENCH_LOG.jsonl"
+fi
 
 echo
-echo "=== session complete ($FAILED step(s) failed); tail of BENCH_LOG.jsonl ==="
+if [ $ABORTED -ne 0 ]; then
+  echo "=== session ABORTED on wedged transport ($FAILED step(s) failed) ==="
+else
+  echo "=== session complete ($FAILED step(s) failed) ==="
+fi
+echo "=== tail of BENCH_LOG.jsonl ==="
 tail -n 20 BENCH_LOG.jsonl 2>/dev/null
 exit $((FAILED > 0))
