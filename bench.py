@@ -403,12 +403,31 @@ def _device_watchdog(seconds: float = 300.0):
             "detail": {
                 "error": f"jax.devices() not ready in {seconds:.0f}s "
                          "(device transport unreachable?)",
-                "escalation": "transport never came up through rounds 4-5 "
-                              "(BASELINE.md round status sections); the "
-                              "full measurement program is one command on "
-                              "a live chip: tools/hw_session.sh",
+                "escalation": "the transport is intermittent (it answered "
+                              "2026-07-31 and the sweep captured live-chip "
+                              "numbers before re-wedging — BASELINE.md "
+                              "round-5 status); the full measurement "
+                              "program is one command on a live chip: "
+                              "tools/hw_session.sh",
             },
         }
+        # Freshest REAL-CHIP measurements already in the log (the transport
+        # is intermittent, not absent): surface them in the failure record
+        # so a wedged round end still reports driver-era hardware evidence.
+        try:
+            chip_recs = []
+            with open(BENCH_LOG) as f:
+                for line in f:
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("backend") == "tpu" and not rec.get("unresolved"):
+                        chip_recs.append(rec)
+            if chip_recs:
+                failure["detail"]["latest_hardware_evidence"] = chip_recs[-3:]
+        except Exception as e:
+            failure["detail"]["hardware_evidence_error"] = str(e)
         # Secondary evidence that needs no chip: the bridge transport A/B
         # (tools/shm_bench.py appends its own BENCH_LOG line). Run it in a
         # fresh CPU-pinned process BEFORE reporting, bounded so a wedged

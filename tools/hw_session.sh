@@ -25,10 +25,19 @@ mode="${1:-full}"
 log() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
 
 probe() {  # cheap transport health check (fresh process, tiny compile)
+  # stderr goes to a file, shown only on failure: a quiet success, but a
+  # local breakage (ImportError, broken venv) is not misreported as a
+  # dead transport.
   timeout --kill-after=30 180 python -c "
 import jax
 assert float(jax.jit(lambda: jax.numpy.ones((8,8)).sum())()) == 64.0
-print('probe: transport ok')" 2>/dev/null
+print('probe: transport ok')" 2>/tmp/cgx_probe_err.$$
+  rc=$?
+  if [ $rc -ne 0 ] && [ -s /tmp/cgx_probe_err.$$ ]; then
+    echo "probe stderr:"; tail -5 /tmp/cgx_probe_err.$$
+  fi
+  rm -f /tmp/cgx_probe_err.$$
+  return $rc
 }
 
 FAILED=0
@@ -46,8 +55,10 @@ run() {  # run <timeout-s> <desc> <cmd...> — device steps
   if [ $rc -ne 0 ]; then
     echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1))
     # 124 = timeout TERM, 137 = timeout KILL: the step died mid-device-op.
+    # 2 = bench.py's own init watchdog (os._exit(2) on a wedged backend
+    # init) — the transport is suspect even though timeout never fired.
     # Other rcs (tracebacks, exec failures) never touched a wedge.
-    if [ $rc -eq 124 ] || [ $rc -eq 137 ]; then
+    if [ $rc -eq 124 ] || [ $rc -eq 137 ] || [ $rc -eq 2 ]; then
       log "post-timeout transport probe"
       if ! probe; then
         sleep 60

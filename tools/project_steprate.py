@@ -98,6 +98,16 @@ def newest_codec_numbers(log_path: str, bits: int = 4, bucket: int = 512):
                 and rec.get("variant") == "current"
                 and rec.get("bits") == bits
                 and rec.get("bucket") == bucket
+                # Only records at the PRODUCTION encode/pack defaults feed
+                # the projection — an experimental-knob record (mul encode,
+                # butterfly pack) must not silently become the headline
+                # number while its adoption decision is pending. The
+                # defaults here track the session env, so adopting a knob
+                # (exporting it) flips the filter with it.
+                and rec.get("encode", "div")
+                == os.environ.get("CGX_CODEC_ENCODE", "div")
+                and rec.get("pack", "sum")
+                == os.environ.get("CGX_PALLAS_PACK", "sum")
                 and "unresolved" not in rec
                 and rec.get("gbps_in")  # noise-clamped slopes log null
             ):
@@ -106,7 +116,9 @@ def newest_codec_numbers(log_path: str, bits: int = 4, bucket: int = 512):
                     best_qbench = gbps
                     out["quantize_GBps_in"] = gbps
                     out["provenance"] = (
-                        f"BENCH_LOG.jsonl qbench {rec.get('ts', '?')}"
+                        f"BENCH_LOG.jsonl qbench {rec.get('ts', '?')} "
+                        f"(tc={rec.get('tc')} encode={rec.get('encode')} "
+                        f"pack={rec.get('pack')})"
                     )
     return out
 
