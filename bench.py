@@ -459,7 +459,10 @@ def _device_watchdog(seconds: float = 300.0):
         # never probes the (wedged) backend.
         log_jsonl({"tool": "bench", "chip": "unreachable",
                    "backend": "unreachable", **failure})
-        os._exit(2)
+        # Sentinel exit code (not 1/2, which python tracebacks and argparse
+        # usage errors use): lets callers (tools/hw_session.sh) distinguish
+        # "transport wedged during init" from ordinary failures.
+        os._exit(97)
 
     threading.Thread(target=fire, daemon=True).start()
     return done

@@ -1,0 +1,118 @@
+"""Selection semantics of the step-rate projection's measurement intake.
+
+`tools/project_steprate.py` turns BASELINE.json's north star into numbers
+by combining measured codec throughputs with the allreduce cost model; the
+record-selection rules decide WHICH measurement becomes the headline, so
+they are locked here:
+
+* bench.py records win by recency, and reset the qbench best-of race;
+* among qbench `current` records, the best throughput at the projection's
+  bits/bucket AND the production encode/pack defaults wins — experimental
+  knob records (mul encode, butterfly pack) and other codec configs never
+  leak into the projection;
+* records marked `unresolved` (noise-clamped scan slopes, null metrics)
+  are skipped.
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import project_steprate as ps  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _production_env(monkeypatch):
+    """The selection filter tracks the session env's encode/pack defaults;
+    pin them so env mutations from other suite tests can't leak in."""
+    monkeypatch.delenv("CGX_CODEC_ENCODE", raising=False)
+    monkeypatch.delenv("CGX_PALLAS_PACK", raising=False)
+
+
+def _write_log(tmp_path, records):
+    p = tmp_path / "BENCH_LOG.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in records))
+    return str(p)
+
+
+def _qrec(gbps, *, variant="current", bits=4, bucket=512, encode="div",
+          pack="sum", ts="t", **extra):
+    rec = {
+        "tool": "qbench", "variant": variant, "tc": 16, "mb": 128,
+        "bits": bits, "bucket": bucket, "pack": pack, "encode": encode,
+        "t_ms": 1.0, "gbps_in": gbps, "ts": ts,
+    }
+    rec.update(extra)
+    return rec
+
+
+def test_missing_log_falls_back_to_round3_table(tmp_path):
+    m = ps.newest_codec_numbers(str(tmp_path / "absent.jsonl"))
+    assert m == ps.R3
+
+
+def test_best_config_matched_record_wins(tmp_path):
+    log = _write_log(tmp_path, [
+        _qrec(130.5, ts="a"),
+        _qrec(93.7, ts="b"),   # worse tile — must not displace the best
+    ])
+    m = ps.newest_codec_numbers(log)
+    assert m["quantize_GBps_in"] == 130.5
+    assert "qbench a" in m["provenance"]
+
+
+def test_experimental_and_mismatched_configs_never_leak(tmp_path):
+    log = _write_log(tmp_path, [
+        _qrec(120.0, ts="prod"),
+        _qrec(999.0, encode="mul", ts="knob"),     # pending-adoption knob
+        _qrec(999.0, pack="butterfly", ts="knob2"),
+        _qrec(999.0, bits=2, ts="otherbits"),      # different codec config
+        _qrec(999.0, bucket=128, ts="otherbucket"),
+        _qrec(999.0, variant="nometa", ts="bound"),  # upper-bound variant
+    ])
+    m = ps.newest_codec_numbers(log, bits=4, bucket=512)
+    assert m["quantize_GBps_in"] == 120.0
+
+
+def test_unresolved_records_skipped(tmp_path):
+    log = _write_log(tmp_path, [
+        _qrec(None, t_ms=None, ts="noise",
+              unresolved="slope <= noise; re-run with a larger --k"),
+    ])
+    m = ps.newest_codec_numbers(log)
+    assert m["quantize_GBps_in"] == ps.R3["quantize_GBps_in"]
+
+
+def test_bench_record_wins_by_recency_and_resets_race(tmp_path):
+    bench = {
+        "tool": "bench",
+        "detail": {"quantize_GBps": 110.0, "dequantize_GBps": 600.0},
+        "ts": "bench-session",
+    }
+    log = _write_log(tmp_path, [_qrec(130.5, ts="old"), bench,
+                                _qrec(125.0, ts="new")])
+    m = ps.newest_codec_numbers(log)
+    # The later bench session superseded the 130.5 race; the freshest
+    # qbench record after it wins again.
+    assert m["quantize_GBps_in"] == 125.0
+    assert m["dequantize_GBps_out"] == 600.0
+    log2 = _write_log(tmp_path, [_qrec(130.5, ts="old"), bench])
+    m2 = ps.newest_codec_numbers(log2)
+    assert m2["quantize_GBps_in"] == 110.0
+
+
+@pytest.mark.parametrize("ws", [2, 8, 32])
+def test_projection_rows_shape_and_monotonicity(ws):
+    rows = ps.project(473 * 2**20, ws, 4, 512, dict(ps.R3))
+    assert len(rows) == len(ps.REGIMES)
+    # fp32 step time strictly decreases as the interconnect gets faster.
+    fp32 = [r["fp32_step_ms"] for r in rows]
+    assert fp32 == sorted(fp32, reverse=True)
+    for r in rows:
+        assert r["speedup"] == pytest.approx(
+            r["fp32_step_ms"] / r["q_step_ms"], abs=0.01
+        )

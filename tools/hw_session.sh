@@ -55,10 +55,10 @@ run() {  # run <timeout-s> <desc> <cmd...> — device steps
   if [ $rc -ne 0 ]; then
     echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1))
     # 124 = timeout TERM, 137 = timeout KILL: the step died mid-device-op.
-    # 2 = bench.py's own init watchdog (os._exit(2) on a wedged backend
-    # init) — the transport is suspect even though timeout never fired.
-    # Other rcs (tracebacks, exec failures) never touched a wedge.
-    if [ $rc -eq 124 ] || [ $rc -eq 137 ] || [ $rc -eq 2 ]; then
+    # 97 = bench.py's init-watchdog sentinel (wedged backend init) — the
+    # transport is suspect even though timeout never fired.
+    # Other rcs (tracebacks, argparse usage errors) never touched a wedge.
+    if [ $rc -eq 124 ] || [ $rc -eq 137 ] || [ $rc -eq 97 ]; then
       log "post-timeout transport probe"
       if ! probe; then
         sleep 60
