@@ -309,7 +309,9 @@ def main():
         "example": "cifar_train",
         "dataset": args.dataset,
         "devices": n_dev,
-        "bits": args.quantization_bits,
+        # Effective wire: a PSUM run moves fp32 regardless of the bits flag.
+        "reduction": args.reduction,
+        "bits": 32 if args.reduction == "PSUM" else args.quantization_bits,
         "first_loss": first_epoch_loss,
         "final_loss": last_loss,
         "final_acc": last_acc,

@@ -13,45 +13,72 @@
 #
 #   bash tools/pod_ab.sh            # 4-bit vs fp32, both models
 #   STEPS=200 BITS=2 bash tools/pod_ab.sh
+#   SIMULATE=8 STEPS=4 bash tools/pod_ab.sh   # dry-run the harness on a
+#                                  # virtual CPU mesh (no pod needed; the
+#                                  # step rates are NOT hardware numbers)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
 STEPS="${STEPS:-100}"
 BITS="${BITS:-4}"
+SIMULATE="${SIMULATE:-0}"
+CIFAR_SIM=()
+GPT2_SIM=()
+GPT2_DIMS=(--layers 12 --d-model 768 --heads 12 --seq 512)
+if [ "$SIMULATE" -gt 0 ]; then
+  CIFAR_SIM=(--simulate-devices "$SIMULATE")
+  GPT2_SIM=(--cpu)  # gpt2_train's virtual mesh is fixed at 8 devices
+  # Harness dry-run, not a measurement: tiny model so the CPU legs finish.
+  GPT2_DIMS=(--layers 2 --d-model 128 --heads 4 --seq 128)
+fi
 
-append_summary() { # mode name  <- stdin: full example output
-  local mode="$1" name="$2" out line
+append_summary() { # mode name simdev  <- stdin: full example output
+  # simdev: the leg's ACTUAL virtual-device count in a dry-run (0 = real
+  # hardware) — gpt2's --cpu mesh is fixed at 8 regardless of $SIMULATE.
+  local mode="$1" name="$2" simdev="$3" out line
   out="$(cat)"
   echo "$out"
-  line="$(printf '%s\n' "$out" | grep -E '^\{' | tail -1)"
+  # `|| true`: under pipefail a no-JSON-output run would otherwise kill
+  # the whole A/B at the grep instead of reaching the failure record.
+  line="$(printf '%s\n' "$out" | { grep -E '^\{' || true; } | tail -1)"
   if [ -n "$line" ]; then
     printf '%s\n' "$line" \
-      | python -c "import json,sys; d=json.load(sys.stdin); d['ab_mode']='$mode'; d['tool']='pod_ab'; print(json.dumps(d))" \
+      | python -c "import json,sys; d=json.load(sys.stdin); d['ab_mode']='$mode'; d['tool']='pod_ab'
+sim = int('$simdev')
+if sim: d['simulated'] = sim  # harness dry-run: rates are NOT hardware
+print(json.dumps(d))" \
       >> BENCH_LOG.jsonl
   else
-    echo "{\"tool\": \"pod_ab\", \"ab_mode\": \"$mode\", \"metric\": \"${name}_failed\"}" >> BENCH_LOG.jsonl
+    sim_field=""
+    if [ "$simdev" -gt 0 ]; then sim_field=", \"simulated\": $simdev"; fi
+    echo "{\"tool\": \"pod_ab\", \"ab_mode\": \"$mode\", \"example\": \"${name}\", \"metric\": \"${name}_failed\"$sim_field}" >> BENCH_LOG.jsonl
   fi
 }
+GPT2_SIMDEV=0
+if [ "$SIMULATE" -gt 0 ]; then GPT2_SIMDEV=8; fi
 
+# `|| true` on each run: a failed leg records its failure line and the
+# remaining legs still measure (evidence over fail-fast — the round-3
+# lesson; the summary below shows exactly which legs produced rates).
 echo "== cifar / fp32 (PSUM) =="
-python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
-  --reduction PSUM ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} \
-  | append_summary fp32 cifar
+{ python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
+  --reduction PSUM "${CIFAR_SIM[@]}" ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} || true; } \
+  | append_summary fp32 cifar "$SIMULATE"
 
 echo "== cifar / ${BITS}-bit SRA =="
-python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
-  --quantization-bits "$BITS" ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} \
-  | append_summary "q${BITS}" cifar
+{ python examples/cifar_train.py --epochs 1 --steps-per-epoch "$STEPS" \
+  --quantization-bits "$BITS" "${CIFAR_SIM[@]}" ${CIFAR_DATA:+--data-dir "$CIFAR_DATA"} || true; } \
+  | append_summary "q${BITS}" cifar "$SIMULATE"
 
 echo "== gpt2 / fp32 =="
-python examples/gpt2_train.py --steps "$STEPS" --bits 32 \
-  --layers 12 --d-model 768 --heads 12 --seq 512 \
-  | append_summary fp32 gpt2
+{ python examples/gpt2_train.py --steps "$STEPS" --bits 32 \
+  "${GPT2_DIMS[@]}" "${GPT2_SIM[@]}" || true; } \
+  | append_summary fp32 gpt2 "$GPT2_SIMDEV"
 
 echo "== gpt2 / ${BITS}-bit =="
-python examples/gpt2_train.py --steps "$STEPS" --bits "$BITS" \
-  --layers 12 --d-model 768 --heads 12 --seq 512 \
-  | append_summary "q${BITS}" gpt2
+{ python examples/gpt2_train.py --steps "$STEPS" --bits "$BITS" \
+  "${GPT2_DIMS[@]}" "${GPT2_SIM[@]}" || true; } \
+  | append_summary "q${BITS}" gpt2 "$GPT2_SIMDEV"
 
 python - <<'EOF'
 import json
@@ -62,11 +89,16 @@ for r in ab[-8:]:
     print(json.dumps(r))
 pairs = {}
 for r in ab:
-    pairs.setdefault(r.get("example"), {})[r.get("ab_mode")] = r
+    # Keep hardware and harness-dry-run pairs separate: a simulated leg
+    # must never pair with (or shadow) a hardware leg.
+    sim = " [SIMULATED]" if r.get("simulated") else ""
+    pairs.setdefault(f'{r.get("example")}{sim}', {})[r.get("ab_mode")] = r
 for name, modes in pairs.items():
     f, qs = modes.get("fp32"), [v for k, v in modes.items() if k != "fp32"]
     if f and qs and "steps_per_s" in f and "steps_per_s" in qs[-1]:
+        note = ("harness dry-run, not a measurement"
+                if "[SIMULATED]" in name
+                else "north star: >= 2x on DCN-connected slices")
         print(f"{name}: quantized/fp32 step rate = "
-              f"{qs[-1]['steps_per_s'] / f['steps_per_s']:.2f}x "
-              f"(north star: >= 2x on DCN-connected slices)")
+              f"{qs[-1]['steps_per_s'] / f['steps_per_s']:.2f}x ({note})")
 EOF
