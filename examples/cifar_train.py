@@ -309,9 +309,15 @@ def main():
         "example": "cifar_train",
         "dataset": args.dataset,
         "devices": n_dev,
-        # Effective wire: a PSUM run moves fp32 regardless of the bits flag.
+        # Effective wire: a flat PSUM run moves fp32 regardless of the bits
+        # flag. In hierarchical mode --reduction only sets the CROSS level;
+        # the intra level still compresses, so the wire stays quantized.
         "reduction": args.reduction,
-        "bits": 32 if args.reduction == "PSUM" else args.quantization_bits,
+        "bits": (
+            32
+            if args.reduction == "PSUM" and not args.hierarchical
+            else args.quantization_bits
+        ),
         "first_loss": first_epoch_loss,
         "final_loss": last_loss,
         "final_acc": last_acc,

@@ -127,7 +127,12 @@ def make_variant_kernel(name: str, bits: int, b: int, tc: int):
     return kernel
 
 
-def run_variant_kernel(name, xs, bits, b, tc):
+def run_variant_kernel(name, xs, bits, b, tc, interpret: bool = False):
+    """``interpret=True`` runs the experiment kernel in Pallas interpret
+    mode (CPU) — the suite smoke-checks every variant's shapes and wire
+    bytes there, so a shape bug can't survive until a live-chip session
+    (the round-5 `read` reshape bug burned a hardware step exactly that
+    way)."""
     rows, m = xs.shape
     rb = b // 128
     n_chunks = rows * m // (CB * b)
@@ -156,6 +161,7 @@ def run_variant_kernel(name, xs, bits, b, tc):
             jax.ShapeDtypeStruct((n_chunks * bits * rb, 128), jnp.int32),
             meta_shape,
         ],
+        interpret=interpret,
     )
     return jax.jit(lambda x: f(x.reshape(-1, 128)))
 
