@@ -109,3 +109,30 @@ def test_gpt2_real_text_val_loss_parity():
     assert f["val_loss"] < 3.6, f
     assert q["val_loss"] < 3.6, q
     assert abs(q["val_loss"] - f["val_loss"]) < 0.1, (q, f)
+
+
+@pytest.mark.slow
+def test_bert_finetune_example():
+    """BASELINE.md config row "BERT fine-tune DDP, 8-bit, layer_min_size
+    filter on LN/bias" as the user runs it: MLM loss must fall and the
+    summary must show the dim<=1 filter actually left LN/bias raw."""
+    out = _run(
+        ["examples/bert_finetune.py", "--cpu", "--steps", "10"],
+        timeout=420,
+    )
+    assert out["bits"] == 8
+    assert out["final_loss"] < out["first_loss"]
+    assert out["leaves_raw_dim_filter"] > 0  # LN scales/biases stayed raw
+    assert out["leaves_compressed"] > 0
+
+
+@pytest.mark.slow
+def test_vit_hierarchical_example():
+    """BASELINE.md config row "ViT multi-host DDP, INTRA_BROADCAST
+    hierarchical allreduce": the cross x intra leader scheme trains."""
+    out = _run(
+        ["examples/vit_train.py", "--cpu", "--steps", "10", "--intra", "4"],
+        timeout=420,
+    )
+    assert out["mesh"] == {"cross": 2, "intra": 4}
+    assert out["final_loss"] < out["first_loss"]
