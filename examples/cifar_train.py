@@ -53,6 +53,11 @@ def parse_args():
     # Reference run_cifar.sh: 8-bit, bucket 1024; BASELINE.md north star: 4-bit.
     p.add_argument("--quantization-bits", type=int, default=4)
     p.add_argument("--quantization-bucket-size", type=int, default=1024)
+    p.add_argument("--arch", choices=["resnet18", "resnet50"],
+                   default="resnet18",
+                   help="resnet50 = the BASELINE.md ResNet-50 DDP config "
+                        "row (pair with --quantization-bucket-size 512 to "
+                        "match that row exactly)")
     p.add_argument("--reduction", choices=["SRA", "RING", "ALLTOALL", "PSUM"],
                    default="SRA")
     p.add_argument("--hierarchical", type=int, default=0, metavar="INTRA",
@@ -145,7 +150,7 @@ def main():
     from torch_cgx_tpu import CompressionConfig, set_layer_pattern_config
     from torch_cgx_tpu import data as cgx_data
     from torch_cgx_tpu.config import TopologyConfig
-    from torch_cgx_tpu.models import ResNet18
+    from torch_cgx_tpu.models import ResNet18, ResNet50
     from torch_cgx_tpu.parallel import mesh as mesh_mod
     from torch_cgx_tpu.parallel.grad_sync import gradient_sync, replicate
     from jax.sharding import PartitionSpec as P
@@ -178,7 +183,8 @@ def main():
         f"global batch {args.batch_size} must divide over {n_dev} devices"
     )
 
-    model = ResNet18(
+    arch = ResNet50 if args.arch == "resnet50" else ResNet18
+    model = arch(
         num_classes=num_classes,
         dtype=jnp.bfloat16 if args.bf16 else jnp.float32,
     )
@@ -307,6 +313,7 @@ def main():
 
     print(json.dumps({
         "example": "cifar_train",
+        "arch": args.arch,
         "dataset": args.dataset,
         "devices": n_dev,
         # Effective wire: a flat PSUM run moves fp32 regardless of the bits

@@ -48,6 +48,8 @@ def parse_args():
 
 def main():
     args = parse_args()
+    if args.steps < 1:
+        raise SystemExit("--steps must be >= 1")
     if args.cpu:
         os.environ["XLA_FLAGS"] = (
             os.environ.get("XLA_FLAGS", "")
