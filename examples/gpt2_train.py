@@ -62,6 +62,23 @@ def parse_args():
                         "documentation (genuine English prose, zero "
                         "egress); vocab forced to 256, 90/10 val split, "
                         "val_loss reported")
+    def _avg_bits(v):
+        v = float(v)
+        if v and not 2 <= v <= 8:  # solve_bit_allocation's bits_range
+            raise argparse.ArgumentTypeError("average bits must be in [2, 8]")
+        return v
+
+    def _every(v):
+        v = int(v)
+        if v < 1:
+            raise argparse.ArgumentTypeError("--adapt-every must be >= 1")
+        return v
+
+    p.add_argument("--adaptive-bits", type=_avg_bits, default=0,
+                   help="adaptive per-layer bit allocation at this AVERAGE "
+                        "bit budget (parallel/adaptive.py, L-GreCo lineage); "
+                        "re-solved every --adapt-every steps; 0 = off")
+    p.add_argument("--adapt-every", type=_every, default=50)
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--cpu", action="store_true", help="force the virtual CPU mesh")
     return p.parse_args()
@@ -252,16 +269,35 @@ def main():
             params, mesh, axes=dp_axes, sp_axis=sp_axis,
         )
 
+    if args.adaptive_bits:
+        if args.sp > 1:
+            raise SystemExit("--adaptive-bits composes with sp=1 only "
+                             "(the measurement grad runs outside shard_map)")
+        if args.powersgd_rank:
+            raise SystemExit("--adaptive-bits has no effect under "
+                             "--powersgd-rank (the low-rank reducer does "
+                             "not consult the quantization registry)")
+        from torch_cgx_tpu.parallel.adaptive import adapt_bits
+
+        grad_for_stats = jax.jit(jax.grad(loss_fn))
+
     losses = []
+    bit_allocs = 0
     import time as _time
 
     t0 = steady0 = _time.time()
     for i in range(args.steps):
         lo = (i * args.batch) % (len(data) - args.batch)
-        batch = shard_batch(
-            jnp.asarray(data[lo : lo + args.batch]), mesh, dp_axes,
-            sp_axis=sp_axis,
-        )
+        raw = jnp.asarray(data[lo : lo + args.batch])
+        if args.adaptive_bits and i % args.adapt_every == 0:
+            # One extra backward every --adapt-every steps; the registry
+            # version bump retraces the train step with the new per-layer
+            # bits (adaptive.py:adapt_bits docstring).
+            g = jax.device_get(grad_for_stats(params, raw))
+            adapt_bits(g, avg_bits=args.adaptive_bits,
+                       bucket_size=args.bucket_size)
+            bit_allocs += 1
+        batch = shard_batch(raw, mesh, dp_axes, sp_axis=sp_axis)
         if state is not None:
             params, opt_state, state, loss = step(
                 params, opt_state, state, batch, jnp.int32(i)
@@ -278,7 +314,11 @@ def main():
         "example": "gpt2_train",
         "mesh": {a: int(mesh.shape[a]) for a in axis_names},
         "data": args.data,
-        "bits": args.bits,
+        "bits": args.adaptive_bits or args.bits,
+        # Each re-allocation bumps the registry version and retraces the
+        # step INSIDE the steady timing window — steps_per_s under
+        # adaptive bits includes that recompile cost.
+        **({"bit_reallocs": bit_allocs} if args.adaptive_bits else {}),
         "first_loss": losses[0],
         "final_loss": losses[-1],
         "compile_s": round(steady0 - t0, 2),
