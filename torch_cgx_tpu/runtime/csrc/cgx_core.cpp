@@ -297,7 +297,13 @@ void cgx_quantize_f32(const float* x, int64_t n, int32_t bits,
                       int64_t bucket, uint32_t* packed, float* meta) {
   const int64_t nb = num_buckets(n, bucket);
   const int64_t padded_n = nb * bucket;
-  std::vector<uint32_t> levels(static_cast<size_t>(padded_n));
+  // Round the level buffer up to a full 32-lane group: pack_range_dense
+  // reads every lane of its final group, and the vector's value-init
+  // zeroes the pad lanes — matching the XLA codec's zero-padded tail
+  // words bit-for-bit (an OOB read here used to leak heap garbage into
+  // the last wire words; caught by test_fuzz_three_way_byte_identity).
+  std::vector<uint32_t> levels(
+      static_cast<size_t>(num_groups(padded_n) * kLaneGroup));
   Executor* ex = default_pool();
   parallel_for(ex, 0, nb, 64, [&](int64_t b0, int64_t b1) {
     quantize_range(x, n, bits, bucket, b0, b1, levels.data(), meta);
