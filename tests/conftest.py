@@ -54,3 +54,20 @@ def _clear_registry():
     torch_cgx_tpu.clear_registry()
     yield
     torch_cgx_tpu.clear_registry()
+
+
+def fuzz_operand(rng, n, kind):
+    """Shared operand recipes for the cross-impl codec fuzz tests
+    (test_codec_host / test_codec_pallas): normal data, extreme magnitudes
+    with denormal-scale spikes, and constant runs with outliers."""
+    import numpy as _np
+
+    if kind == 0:
+        return rng.standard_normal(n).astype(_np.float32)
+    if kind == 1:
+        x = (rng.standard_normal(n) * 1e30).astype(_np.float32)
+        x[:: max(1, n // 7)] = 1e-38
+        return x
+    x = _np.full(n, -7.25, _np.float32)
+    x[:: max(1, n // 5)] = 3.5
+    return x

@@ -446,3 +446,61 @@ def test_mul_encode_tpu(monkeypatch):
     y = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32)
     unit = np.asarray(q.meta, np.float32)[..., 0].max()
     assert np.abs(np.asarray(y) - np.asarray(xs)).max() <= unit / 2 + 1e-6
+
+
+def test_fuzz_pallas_wire_matches_xla():
+    """Seeded fuzz over supported (n, bits, bucket) combos — both kernel
+    families (flat whole-chunk rows and chunk-block tails) must stay
+    byte-identical to the XLA oracle across odd sizes and value extremes
+    (the class of tail bug test_codec_host's fuzz caught in the C++ core).
+    Interpret mode; small operands keep it fast."""
+    rng = np.random.default_rng(0xCA5)
+    # Pinned flat-path combos: nb % 32 == 0 and bucket % 128 == 0 routes
+    # the whole-chunk-row kernels; random draws below essentially always
+    # carry a chunk tail, which would leave that family unfuzzed.
+    combos = [(4096, 4, 128, False), (8192, 2, 128, False)]
+    for bits in (1, 2, 3, 4, 5, 6, 7, 8):
+        n = int(rng.integers(256, 9000))
+        bucket = int(rng.choice([32, 64, 96, 128, 160, 512]))
+        skip = bool(rng.integers(0, 2)) and (n % bucket != 0)
+        if codec_pallas.supports(n, bits, bucket, skip):
+            combos.append((n, bits, bucket, skip))
+    assert len(combos) >= 8  # the seed must keep real coverage
+    for n, bits, bucket, skip in combos:
+        from conftest import fuzz_operand
+
+        kind = rng.integers(0, 3)
+        x = fuzz_operand(rng, n, int(kind))
+        xs = jnp.asarray(x)[None, :]
+        ctx = (n, bits, bucket, skip, int(kind))
+        qp = codec_pallas.quantize_batch(
+            xs, bits, bucket, interpret=True, skip_incomplete_buckets=skip
+        )
+        qx = codec.quantize(
+            jnp.asarray(x), bits, bucket, skip_incomplete_buckets=skip
+        )
+        np.testing.assert_array_equal(
+            np.asarray(qp.packed[0]), np.asarray(qx.packed), err_msg=str(ctx))
+        np.testing.assert_array_equal(
+            np.asarray(qp.meta[0], np.float32),
+            np.asarray(qx.meta, np.float32), err_msg=str(ctx))
+        dp = np.asarray(codec_pallas.dequantize_batch(
+            qp, out_dtype=jnp.float32, interpret=True
+        )[0])
+        dx = np.asarray(codec.dequantize(qx, out_dtype=jnp.float32))
+        # Decode parity is NOT bit-exact: min + lvl*unit rounds once per
+        # op, and orderings differ between kernels, so the two decodes can
+        # differ by a couple of roundings AT THE OPERAND MAGNITUDE — which
+        # is many ulps of the RESULT when min and lvl*unit cancel (decoded
+        # value near zero inside a wide bucket). Bound per element by the
+        # bucket's own magnitude; each implementation stays deterministic
+        # (the byte-equal wire above), which is all error symmetry needs,
+        # and the quantization envelope (unit/2) dwarfs this bound.
+        pad = (-n) % bucket
+        xb = np.concatenate([x, np.repeat(x[-1:], pad)]).reshape(-1, bucket)
+        bound = np.abs(xb).max(axis=1).repeat(bucket)[:n]
+        tol = 4 * np.spacing(np.float32(bound))
+        diff = np.abs(dp - dx)
+        worst = int(np.argmax(diff - tol))
+        assert (diff <= tol).all(), (
+            ctx, worst, dp[worst], dx[worst], float(tol[worst]))
