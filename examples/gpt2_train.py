@@ -79,6 +79,11 @@ def parse_args():
                         "bit budget (parallel/adaptive.py, L-GreCo lineage); "
                         "re-solved every --adapt-every steps; 0 = off")
     p.add_argument("--adapt-every", type=_every, default=50)
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save/resume directory (torch_cgx_tpu.checkpoint): "
+                        "resumes from the latest step if one exists, saves "
+                        "at the end of the run; the per-layer compression "
+                        "registry rides inside the checkpoint")
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--cpu", action="store_true", help="force the virtual CPU mesh")
     return p.parse_args()
@@ -281,12 +286,42 @@ def main():
 
         grad_for_stats = jax.jit(jax.grad(loss_fn))
 
+    # Checkpoint/resume (torch_cgx_tpu.checkpoint): restore picks up the
+    # training pytree AND the per-layer compression registry — a resumed
+    # run compresses from its first step (the restart gap the reference
+    # leaves open, SURVEY.md §5.4).
+    start_step = 0
+    if args.checkpoint_dir:
+        if args.tp > 1:
+            raise SystemExit("--checkpoint-dir in this example composes "
+                             "with tp=1 only (restore re-replicates; tp "
+                             "resharding is left to the checkpoint API)")
+        if args.error_feedback or args.powersgd_rank:
+            raise SystemExit(
+                "--checkpoint-dir in this example does not checkpoint the "
+                "error-feedback residuals / PowerSGD factors; resuming "
+                "would silently reset that state (checkpoint the `state` "
+                "pytree alongside params via torch_cgx_tpu.checkpoint in "
+                "real training loops)")
+        from torch_cgx_tpu import checkpoint as ckpt
+
+        last = ckpt.latest_step(args.checkpoint_dir)
+        if last is not None:
+            tree = ckpt.restore(
+                args.checkpoint_dir, last,
+                target={"params": jax.device_get(params),
+                        "opt_state": jax.device_get(opt_state)},
+            )
+            params = replicate(tree["params"], mesh)
+            opt_state = replicate(tree["opt_state"], mesh)
+            start_step = last
+
     losses = []
     bit_allocs = 0
     import time as _time
 
     t0 = steady0 = _time.time()
-    for i in range(args.steps):
+    for i in range(start_step, start_step + args.steps):
         lo = (i * args.batch) % (len(data) - args.batch)
         raw = jnp.asarray(data[lo : lo + args.batch])
         if args.adaptive_bits and i % args.adapt_every == 0:
@@ -305,10 +340,12 @@ def main():
         else:
             params, opt_state, loss = step(params, opt_state, batch, jnp.int32(i))
         losses.append(float(loss))
-        if i == 0:
+        if i == start_step:
             steady0 = _time.time()  # exclude the compile from the step rate
-        if (i + 1) % max(1, args.steps // 5) == 0:
-            print(f"step {i + 1}/{args.steps}: loss={losses[-1]:.4f}")
+        done = i - start_step + 1
+        if done % max(1, args.steps // 5) == 0:
+            print(f"step {i + 1} ({done}/{args.steps} this run): "
+                  f"loss={losses[-1]:.4f}")
 
     summary = {
         "example": "gpt2_train",
@@ -322,11 +359,17 @@ def main():
         "first_loss": losses[0],
         "final_loss": losses[-1],
         "compile_s": round(steady0 - t0, 2),
+        **({"resumed_from": start_step} if start_step else {}),
     }
     if args.steps > 1:  # steady window needs at least one post-compile step
         summary["steps_per_s"] = round(
             (args.steps - 1) / max(_time.time() - steady0, 1e-9), 3
         )
+    if args.checkpoint_dir:
+        end = start_step + args.steps
+        ckpt.save(args.checkpoint_dir,
+                  {"params": params, "opt_state": opt_state}, end)
+        summary["saved_step"] = end
     if val_data is not None and args.sp == 1:
         # Held-out loss on real text: one fixed-shape plain jit (loss_fn
         # has no collectives outside sp mode; sharded/replicated params

@@ -136,3 +136,19 @@ def test_vit_hierarchical_example():
     )
     assert out["mesh"] == {"cross": 2, "intra": 4}
     assert out["final_loss"] < out["first_loss"]
+
+
+@pytest.mark.slow
+def test_gpt2_checkpoint_resume(tmp_path):
+    """SURVEY.md §5.4 as the user runs it: a second invocation resumes
+    from the saved step (registry included) and continues training
+    rather than restarting."""
+    common = ["examples/gpt2_train.py", "--cpu", "--checkpoint-dir",
+              str(tmp_path)]
+    first = _run(common + ["--steps", "6"], timeout=300)
+    assert first["saved_step"] == 6 and "resumed_from" not in first
+    second = _run(common + ["--steps", "4"], timeout=300)
+    assert second["resumed_from"] == 6 and second["saved_step"] == 10
+    # Continuation, not a restart: the resumed run starts near the first
+    # run's final loss, far below a fresh model's initial loss.
+    assert second["first_loss"] < first["first_loss"] - 0.5
