@@ -1,0 +1,35 @@
+"""Shared launcher for the torch-bridge examples: honor a torchrun-style
+external launch (RANK / WORLD_SIZE in the env) or self-spawn ``nproc``
+ranks rendezvousing over a file store."""
+
+from __future__ import annotations
+
+import os
+import tempfile
+
+
+def run_ranks(train, nproc: int, args, *, prefix: str) -> int:
+    """``train(rank, ws, init_method, args)`` per rank; returns exit code."""
+    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
+        train(
+            int(os.environ["RANK"]),
+            int(os.environ["WORLD_SIZE"]),
+            "env://",
+            args,
+        )
+        return 0
+    import multiprocessing as mp
+
+    initfile = tempfile.mktemp(prefix=prefix)
+    ctx = mp.get_context("spawn")
+    procs = [
+        ctx.Process(target=train, args=(r, nproc, f"file://{initfile}", args))
+        for r in range(nproc)
+    ]
+    for p in procs:
+        p.start()
+    for p in procs:
+        p.join()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    return 0 if all(p.exitcode == 0 for p in procs) else 1

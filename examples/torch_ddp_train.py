@@ -17,7 +17,6 @@ import argparse
 import json
 import os
 import sys
-import tempfile
 
 # Allow `python examples/torch_ddp_train.py` from a source checkout.
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -128,33 +127,10 @@ def train(rank: int, ws: int, init_method: str, args) -> None:
 
 
 def main():
-    args = parse_args()
-    if "RANK" in os.environ and "WORLD_SIZE" in os.environ:
-        # torchrun-style external launch.
-        train(
-            int(os.environ["RANK"]),
-            int(os.environ["WORLD_SIZE"]),
-            "env://",
-            args,
-        )
-        return 0
-    import multiprocessing as mp
+    from _launch import run_ranks
 
-    initfile = tempfile.mktemp(prefix="cgx_ddp_example_")
-    ctx = mp.get_context("spawn")
-    procs = [
-        ctx.Process(
-            target=train, args=(r, args.nproc, f"file://{initfile}", args)
-        )
-        for r in range(args.nproc)
-    ]
-    for p in procs:
-        p.start()
-    for p in procs:
-        p.join()
-    if os.path.exists(initfile):
-        os.unlink(initfile)
-    return 0 if all(p.exitcode == 0 for p in procs) else 1
+    args = parse_args()
+    return run_ranks(train, args.nproc, args, prefix="cgx_ddp_example_")
 
 
 if __name__ == "__main__":

@@ -152,3 +152,18 @@ def test_gpt2_checkpoint_resume(tmp_path):
     # Continuation, not a restart: the resumed run starts near the first
     # run's final loss, far below a fresh model's initial loss.
     assert second["first_loss"] < first["first_loss"] - 0.5
+
+
+@pytest.mark.slow
+@pytest.mark.torch_bridge
+def test_torch_fsdp_example():
+    """ZeRO-3 through the bridge as the user runs it (the reference throws
+    on both collectives this workflow needs): quantized reduce-scatter +
+    compressed parameter all-gather, loss must fall."""
+    out = _run(
+        ["examples/torch_fsdp_train.py", "--nproc", "2", "--steps", "40",
+         "--bits", "8", "--allgather-bits", "8"],
+        timeout=300,
+    )
+    assert out["world_size"] == 2 and out["allgather_bits"] == 8
+    assert out["final_loss"] < 0.5 * out["first_loss"]
