@@ -167,3 +167,16 @@ def test_torch_fsdp_example():
     )
     assert out["world_size"] == 2 and out["allgather_bits"] == 8
     assert out["final_loss"] < 0.5 * out["first_loss"]
+
+
+@pytest.mark.slow
+def test_gpt2_long_context_sp():
+    """Long-context ring attention as the user runs it: seq 1024 sharded
+    8 ways (128 tokens per device), quantized DP off-axis, loss falls."""
+    out = _run(
+        ["examples/gpt2_train.py", "--cpu", "--sp", "8", "--dp", "1",
+         "--seq", "1024", "--batch", "8", "--steps", "4", "--bits", "4"],
+        timeout=500,
+    )
+    assert out["mesh"]["sp"] == 8
+    assert out["final_loss"] < out["first_loss"]
