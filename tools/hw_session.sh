@@ -97,10 +97,17 @@ session() {
   fi
 
   # --- experimental sweep: new Mosaic lowerings, wedge-prone — LAST -----
-  run 600 "read floor"            python tools/qbench.py read --k 8 || return 1
-  run 600 "nometa"                python tools/qbench.py nometa --k 8 || return 1
-  run 600 "metalane"              python tools/qbench.py metalane --k 8 || return 1
-  run 600 "mul variant"           python tools/qbench.py mul --k 8 || return 1
+  # (qbench's default --k is 8 since the 2026-07-31 noise lesson; every
+  # step rides the default so the whole session shares one k.)
+  run 600 "read floor"            python tools/qbench.py read || return 1
+  run 600 "nometa"                python tools/qbench.py nometa || return 1
+  # metalane wedged the transport in BOTH 2026-07-31 sessions (03:47 in
+  # compile, 11:50 in the measurement scan after its byte-check passed).
+  # Opt back in with CGX_HW_METALANE=1 once the lowering is reworked.
+  if [ "${CGX_HW_METALANE:-0}" = 1 ]; then
+    run 600 "metalane"            python tools/qbench.py metalane || return 1
+  fi
+  run 600 "mul variant"           python tools/qbench.py mul || return 1
   run 600 "butterfly pack"        env CGX_PALLAS_PACK=butterfly python tools/qbench.py current || return 1
   run 600 "mul + tc=4"            env CGX_CODEC_ENCODE=mul python tools/qbench.py current --tc 4 || return 1
   run 600 "current tc=32"         python tools/qbench.py current --tc 32 || return 1

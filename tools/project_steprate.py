@@ -36,6 +36,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import os
 import sys
@@ -91,8 +92,13 @@ def newest_codec_numbers(log_path: str, bits: int = 4, bucket: int = 512):
                 out["provenance"] = f"BENCH_LOG.jsonl {rec.get('ts', '?')}"
                 best_qbench = 0.0  # a fresh bench.py session resets the race
             ts = det.get("train_step") or {}
-            if "t_plain_ms" in ts:
-                out["compute_ms"] = float(ts["t_plain_ms"])
+            # bench.py logs the plain-step time as step_plain_ms (the
+            # t_plain_ms spelling never shipped — reading only it left
+            # the projection on the stale R3 fallback).
+            for key in ("step_plain_ms", "t_plain_ms"):
+                if key in ts:
+                    out["compute_ms"] = float(ts[key])
+                    break
             if (
                 rec.get("tool") == "qbench"
                 and rec.get("variant") == "current"
@@ -181,7 +187,14 @@ def main() -> None:
         "provenance": m["provenance"],
     }
     if args.json:
-        print(json.dumps({"config": header, "rows": rows}))
+        # tool/ts match the rest of BENCH_LOG.jsonl's record schema so log
+        # consumers can select projection rows by tool and recency.
+        print(json.dumps({
+            "tool": "project_steprate",
+            "config": header,
+            "rows": rows,
+            "ts": datetime.datetime.now().isoformat(timespec="seconds"),
+        }))
         return
     print(f"# Projected DP step rate — {header['model']}")
     print(

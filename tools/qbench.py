@@ -175,7 +175,10 @@ def main():
     ap.add_argument("--mb", type=int, default=128, help="payload MB (fp32)")
     ap.add_argument("--bits", type=int, default=4)
     ap.add_argument("--bucket", type=int, default=512)
-    ap.add_argument("--k", type=int, default=3, help="scan slots (>= 2)")
+    # Default raised 3 -> 8 after the 2026-07-31 session: every k=3
+    # production-path run on the busier shared chip was noise-unresolved
+    # while the --k 8 runs resolved cleanly.
+    ap.add_argument("--k", type=int, default=8, help="scan slots (>= 2)")
     args = ap.parse_args()
     if args.k < 2:
         ap.error("--k must be >= 2 (slope timing needs two scan lengths)")
