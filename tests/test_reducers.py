@@ -501,3 +501,77 @@ def test_quantized_all_to_all_matches_plain_within_envelope():
                           check_vma=False))(x)
     )
     np.testing.assert_array_equal(g, np.ones_like(g))
+
+
+# ---------------------------------------------------------------------------
+# Shared-wire (quantize-once) EF variants: bit-identical to reducer + mirror.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("red", ["SRA", "ALLTOALL", "RING", "PSUM"])
+def test_allreduce_with_wire_matches_reducer_and_mirror(red):
+    """quantized_allreduce_with_wire must return (a) exactly the reducer's
+    output and (b) exactly the wire decode the old stand-alone mirror
+    computed — under STOCHASTIC rounding, so any drift in key derivation
+    (the bug class the shared-payload design removes) changes bytes and
+    fails loudly. PSUM: exact wire, rt == x."""
+    cc = CompressionConfig(
+        bits=4, bucket_size=128, stochastic=(red != "PSUM")
+    )
+    n = 1000
+    xs = arange_inputs(n)
+    key = jax.random.PRNGKey(3)
+
+    def with_wire(x):
+        out, rt = reducers.quantized_allreduce_with_wire(
+            x, "dp", WS, cc, red, key
+        )
+        return jnp.stack([out, rt.astype(out.dtype)])
+
+    both = run_flat(xs, with_wire)  # (ws, 2, n)
+    out, rt = both[:, 0], both[:, 1]
+
+    plain = run_flat(
+        xs, lambda x: reducers.quantized_allreduce(x, "dp", WS, cc, red, key)
+    )
+    np.testing.assert_array_equal(out, plain)
+
+    if red == "PSUM":
+        np.testing.assert_array_equal(rt, xs)
+        return
+
+    # The mirror the shared-payload path replaced: quantize this device's
+    # stage-1 contribution with the wire's exact key derivation, decode.
+    def mirror(x):
+        if red == "ALLTOALL":
+            k = jax.random.fold_in(key, jax.lax.axis_index("dp"))
+            q = reducers._quantize_1d(x, cc, k)
+            return reducers._dequantize_1d(q).astype(x.dtype)
+        if red == "RING":
+            # Re-derive the hop-0 decode independently (NOT via
+            # _ring_hop0_wire, which the implementation itself returns):
+            # own outgoing segment = row `rank`, keyed like
+            # ring_allreduce's first scatter step.
+            rank = jax.lax.axis_index("dp")
+            chunk = reducers._chunk_size(n, WS)
+            rows = reducers._pad_rows(x, WS, chunk)
+            seg = jax.lax.dynamic_slice(rows, (rank, 0), (1, chunk))
+            k = jax.random.fold_in(jax.random.fold_in(key, 0), rank)
+            q = reducers._quantize_rows(seg, cc, k)
+            dec = reducers._dequantize_rows(q).astype(x.dtype)
+            rows = jax.lax.dynamic_update_slice(rows, dec, (rank, 0))
+            return rows.reshape(-1)[:n]
+        chunk = reducers._chunk_size(n, WS)
+        rows = reducers._pad_rows(x, WS, chunk)
+        q = reducers._quantize_rows(
+            rows, cc, reducers._phase_key(key, 1, "dp")
+        )
+        vals = reducers._dequantize_rows(q)
+        own = (jnp.arange(WS) == jax.lax.axis_index("dp"))[:, None]
+        vals = jnp.where(own, rows.astype(vals.dtype), vals)
+        return vals.reshape(-1)[:n].astype(x.dtype)
+
+    rt_mirror = run_flat(xs, mirror)
+    np.testing.assert_array_equal(rt, rt_mirror)
+    # and the residual is genuinely nonzero for quantized wires
+    assert np.abs(rt - xs).max() > 0

@@ -35,7 +35,11 @@ from ..utils.logging import metrics
 from ..utils.tracing import named_scope
 from ..utils.tree import path_str
 from . import mesh as mesh_mod
-from .reducers import hierarchical_allreduce, quantized_allreduce
+from .reducers import (
+    hierarchical_allreduce,
+    quantized_allreduce,
+    quantized_allreduce_with_wire,
+)
 
 _FLOAT_DTYPES = (jnp.float32, jnp.bfloat16, jnp.float16)
 
@@ -148,10 +152,19 @@ def allreduce_flat(
     axes: Sequence[str],
     topology: Optional[TopologyConfig] = None,
     key: Optional[jax.Array] = None,
-) -> jax.Array:
+    return_roundtrip: bool = False,
+):
     """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
     shard_map). Slicing by the fusion threshold happens here so oversized
-    buffers are chunked like performOperationSingle (.cc:187-199)."""
+    buffers are chunked like performOperationSingle (.cc:187-199).
+
+    ``return_roundtrip=True`` also returns this device's wire decode (the
+    error-feedback residual base) as a second array. On the single-axis
+    SRA/all-to-all paths it is computed from the SAME stage-1 payload the
+    wire sends (``reducers.quantized_allreduce_with_wire`` — quantize-once
+    by construction); Ring uses the hop-0 mirror, the hierarchical paths
+    the per-level mirror (:func:`_stage1_roundtrip_piece`), and exact
+    wires (PSUM / compression off / fake-ratio tail) round-trip unchanged."""
     topo = topology or cfg_mod.topology_from_env()
     n = flat.shape[0]
     ratio = cfg_mod.fake_ratio()
@@ -163,6 +176,7 @@ def allreduce_flat(
         tail = lax.slice(flat, (m,), (n,))
         flat, n = lax.slice(flat, (0,), (m,)), m
     pieces = []
+    rt_pieces = []
     for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
         piece = lax.slice(flat, (off,), (off + ln,))
         k = jax.random.fold_in(key, off) if key is not None else None
@@ -173,7 +187,16 @@ def allreduce_flat(
                 if axes[0] != mesh_mod.CROSS_AXIS
                 else topo.cross_reduction
             )
-            pieces.append(quantized_allreduce(piece, axes[0], ws, cc, red, k))
+            if return_roundtrip:
+                red_piece, rt_piece = quantized_allreduce_with_wire(
+                    piece, axes[0], ws, cc, red, k
+                )
+                pieces.append(red_piece)
+                rt_pieces.append(rt_piece)
+            else:
+                pieces.append(
+                    quantized_allreduce(piece, axes[0], ws, cc, red, k)
+                )
         elif len(axes) == 2:
             cross_axis, intra_axis = axes
             pieces.append(
@@ -188,11 +211,22 @@ def allreduce_flat(
                     key=k,
                 )
             )
+            if return_roundtrip:
+                rt_pieces.append(
+                    _stage1_roundtrip_piece(
+                        piece, cc, mesh=mesh, axes=axes, topo=topo, key=k
+                    )
+                )
         else:
             raise ValueError(f"axes must have 1 or 2 names, got {axes!r}")
     if tail is not None:
         pieces.append(tail)
-    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        rt_pieces.append(tail)  # never travels: exact
+    out = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+    if not return_roundtrip:
+        return out
+    rt = rt_pieces[0] if len(rt_pieces) == 1 else jnp.concatenate(rt_pieces)
+    return out, rt
 
 
 def _roundtrip_wire_1axis(
@@ -209,9 +243,14 @@ def _roundtrip_wire_1axis(
     to on the wire — per-algorithm mirror of ``quantized_allreduce``'s (or,
     with ``leader_rs``, ``reduce_scatter_quantized``'s) stage-1 layout AND
     stochastic key derivation, so the EF residual measures the same random
-    draw the wire used."""
+    draw the wire used. Only reachable from the hierarchical EF path
+    (:func:`_stage1_roundtrip_piece`) — the wire itself runs inside
+    ``hierarchical_allreduce`` where its payload cannot be threaded out;
+    single-axis EF shares the payload via
+    ``reducers.quantized_allreduce_with_wire`` instead. The per-algorithm
+    mirror bodies live in ``reducers`` next to the wires they mirror."""
     from ..ops import dispatch
-    from .reducers import _chunk_size, _pad_rows, _phase_key, quantized_allreduce
+    from .reducers import _ring_hop0_wire, quantized_allreduce, sra_stage1_wire
 
     if ws == 1:
         # ws==1 runs no collective: identity, or the force-codec proxy
@@ -228,8 +267,6 @@ def _roundtrip_wire_1axis(
         red = cfg_mod.REDUCTION_SRA
     if red == cfg_mod.REDUCTION_PSUM:
         return piece
-    n = piece.shape[0]
-    chunk = _chunk_size(n, ws)
     if red == cfg_mod.REDUCTION_ALLTOALL:
         # alltoall_allreduce quantizes the whole buffer as ONE row keyed
         # fold_in(key, axis_index), and every peer decodes exactly those
@@ -242,34 +279,13 @@ def _roundtrip_wire_1axis(
         q = dispatch.quantize_batch(piece[None], cc, k)
         return dispatch.dequantize_batch(q, out_dtype=piece.dtype)[0]
     if red == cfg_mod.REDUCTION_RING:
-        # ring_allreduce's only per-device-attributable quantization of RAW
-        # data is the step-0 hop: the own outgoing segment (row index =
-        # rank) keyed fold_in(fold_in(key, 0), rank). Later hops requantize
-        # accumulated sums — treated exact for EF purposes.
-        rank = lax.axis_index(axis)
-        rows = _pad_rows(piece, ws, chunk)
-        own = lax.dynamic_slice(rows, (rank, 0), (1, chunk))
-        k = (
-            jax.random.fold_in(jax.random.fold_in(key, 0), rank)
-            if key is not None and cc.stochastic
-            else None
-        )
-        q = dispatch.quantize_batch(own, cc, k)
-        rt_own = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
-        rows = lax.dynamic_update_slice(rows, rt_own, (rank, 0))
-        return rows.reshape(-1)[:n]
+        return _ring_hop0_wire(piece, axis, ws, cc, key)
     # SRA: stage-1 quantizes the (ws, chunk) rows with the phase-1 key
     # (reduce_scatter_quantized) — except the own row, whose quantized copy
     # the reducer discards in favor of the raw chunk (exact round trip).
     # The allgather-phase requantization acts on the reduced chunk — not
     # per-device-attributable, treated exact.
-    k = _phase_key(key, 1, axis)
-    rows = _pad_rows(piece, ws, chunk)
-    q = dispatch.quantize_batch(rows, cc, k if cc.stochastic else None)
-    rt = dispatch.dequantize_batch(q, out_dtype=piece.dtype)
-    own = (jnp.arange(ws) == lax.axis_index(axis))[:, None]
-    rt = jnp.where(own, rows.astype(rt.dtype), rt)
-    return rt.reshape(-1)[:n]
+    return sra_stage1_wire(piece, axis, ws, cc, key)
 
 
 def _stage1_roundtrip_piece(
@@ -281,10 +297,12 @@ def _stage1_roundtrip_piece(
     topo: TopologyConfig,
     key: Optional[jax.Array],
 ) -> jax.Array:
-    """One fusion slice's wire decode, mirroring the reducers' decision tree
-    (quantized_allreduce / hierarchical_allreduce prologues): exact wires
+    """One HIERARCHICAL fusion slice's wire decode, mirroring
+    ``hierarchical_allreduce``'s prologue decision tree: exact wires
     (PSUM reduction, compression off for the stage, dummy codec, ws == 1
-    without the force-codec knob) round-trip unchanged — zero residual."""
+    without the force-codec knob) round-trip unchanged — zero residual.
+    Single-axis slices never come here (``allreduce_flat`` shares their
+    wire payload via ``quantized_allreduce_with_wire``)."""
     if cfg_mod.dummy_compression():
         return piece  # pass-through codec decodes exactly
 
@@ -323,51 +341,9 @@ def _stage1_roundtrip_piece(
             piece, intra_cc, axis=intra_axis, ws=ws_intra,
             red=topo.intra_reduction, key=key_intra, leader_rs=True,
         )
-    axis = axes[0]
-    red = (
-        topo.intra_reduction
-        if axis != mesh_mod.CROSS_AXIS
-        else topo.cross_reduction
+    raise AssertionError(
+        f"_stage1_roundtrip_piece is the hierarchical mirror; got axes={axes!r}"
     )
-    return _roundtrip_wire_1axis(
-        piece, cc, axis=axis, ws=mesh.shape[axis], red=red, key=key
-    )
-
-
-def _local_roundtrip_flat(
-    flat: jax.Array,
-    cc: CompressionConfig,
-    *,
-    mesh,
-    axes: Sequence[str],
-    topology: Optional[TopologyConfig],
-    key: Optional[jax.Array],
-) -> jax.Array:
-    """What this device's contribution decodes to on the wire: mirrors
-    :func:`allreduce_flat`'s fusion slicing, fake-ratio head/tail split and
-    the reducers' stage-1 quantization (layout, bucket restarts, stochastic
-    keys). Exact for the default SRA path; for Ring (per-hop
-    requantization) it measures the first hop only."""
-    topo = topology or cfg_mod.topology_from_env()
-    n = flat.shape[0]
-    ratio = cfg_mod.fake_ratio()
-    tail = None
-    if ratio is not None and cc.enabled and n > 1:
-        m = max(1, int(np.ceil(ratio * n)))
-        tail = lax.slice(flat, (m,), (n,))  # never travels: exact
-        flat, n = lax.slice(flat, (0,), (m,)), m
-    pieces = []
-    for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
-        piece = lax.slice(flat, (off,), (off + ln,))
-        k = jax.random.fold_in(key, off) if key is not None else None
-        pieces.append(
-            _stage1_roundtrip_piece(
-                piece, cc, mesh=mesh, axes=axes, topo=topo, key=k
-            )
-        )
-    if tail is not None:
-        pieces.append(tail)
-    return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
 
 
 def allreduce_tree(
@@ -388,9 +364,11 @@ def allreduce_tree(
     Python, backend sums; allreduce_hooks.py:53-54, SURVEY.md §8.12).
 
     ``return_roundtrip=True`` additionally returns a tree of this device's
-    contribution as it decodes on the wire (:func:`_local_roundtrip_flat`
-    over the same fused layout) — the error-feedback residual base.
-    Uncompressed leaves round-trip unchanged (zero residual).
+    contribution as it decodes on the wire (``allreduce_flat(...,
+    return_roundtrip=True)`` over the same fused layout — the single-axis
+    SRA/all-to-all decode shares the wire's own stage-1 payload,
+    quantize-once) — the error-feedback residual base. Uncompressed leaves
+    round-trip unchanged (zero residual).
     """
     axes = tuple(axes)
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
@@ -427,14 +405,15 @@ def allreduce_tree(
             if g.cc.enabled:
                 metrics.add("trace.allreduce.compressed_elems", float(fused.shape[0]))
                 _runtime_count("runtime.allreduce.compressed_elems", fused.shape[0])
-                reduced = allreduce_flat(
-                    fused, g.cc, mesh=mesh, axes=axes, topology=topology,
-                    key=g_key,
-                )
                 if return_roundtrip:
-                    rt_flat = _local_roundtrip_flat(
-                        fused, g.cc, mesh=mesh, axes=axes,
-                        topology=topology, key=g_key,
+                    reduced, rt_flat = allreduce_flat(
+                        fused, g.cc, mesh=mesh, axes=axes, topology=topology,
+                        key=g_key, return_roundtrip=True,
+                    )
+                else:
+                    reduced = allreduce_flat(
+                        fused, g.cc, mesh=mesh, axes=axes, topology=topology,
+                        key=g_key,
                     )
             else:
                 metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))

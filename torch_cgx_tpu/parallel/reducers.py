@@ -111,6 +111,23 @@ def _phase_key(key, salt: int, axis_name: str):
     return jax.random.fold_in(jax.random.fold_in(key, salt), lax.axis_index(axis_name))
 
 
+def _sra_stage1(x, axis_name: str, ws: int, cc, key):
+    """Shared SRA stage-1 body: quantize the padded (ws, chunk) rows with
+    the phase-1 key, all_to_all, decompress-accumulate into the RAW own
+    chunk. Returns ``(reduced_chunk, q, xs, own)`` so the EF variant can
+    decode the SAME payload ``q`` the wire sent (one implementation — the
+    reducer and its wire mirror cannot drift)."""
+    xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
+    q = _quantize_rows(xs, cc, _phase_key(key, 1, axis_name))
+    q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
+    vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
+    # The row arriving from oneself is one's own quantized chunk — swap in
+    # the raw values instead (free accuracy the SPMD form doesn't forfeit).
+    own = (jnp.arange(ws) == lax.axis_index(axis_name))[:, None]
+    vals = jnp.where(own, xs.astype(jnp.float32), vals)
+    return jnp.sum(vals, axis=0), q, xs, own
+
+
 def reduce_scatter_quantized(
     x: jax.Array,
     axis_name: str,
@@ -126,16 +143,7 @@ def reduce_scatter_quantized(
 
     Returns this device's reduced chunk, float32[chunk_size(n, ws)].
     """
-    xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
-    key = _phase_key(key, 1, axis_name)
-    q = _quantize_rows(xs, cc, key)
-    q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
-    vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
-    # The row arriving from oneself is one's own quantized chunk — swap in
-    # the raw values instead (free accuracy the SPMD form doesn't forfeit).
-    own = (jnp.arange(ws) == lax.axis_index(axis_name))[:, None]
-    vals = jnp.where(own, xs.astype(jnp.float32), vals)
-    return jnp.sum(vals, axis=0)
+    return _sra_stage1(x, axis_name, ws, cc, key)[0]
 
 
 def allgather_quantized(
@@ -314,14 +322,151 @@ def alltoall_allreduce(
 ) -> jax.Array:
     """Compress once, broadcast to all, decompress-accumulate everywhere
     (AllReduceAlltoAllCompressed, scatter_reduce_allgather.cc:269-306).
-    O(ws * n) traffic — debug/small-tensor path only."""
+    O(ws * n) traffic — debug/small-tensor path only. (One body with the
+    EF variant; XLA dead-code-eliminates the unused wire decode.)"""
+    return alltoall_allreduce_with_wire(x, axis_name, ws, cc, key)[0]
+
+
+def sra_allreduce_with_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array] = None,
+):
+    """SRA allreduce that ALSO returns this device's wire decode (the
+    error-feedback residual base): ``(reduced, rt)`` where ``rt`` is what
+    the peers decode from this device's stage-1 payload, own chunk raw
+    (reduce_scatter discards the own quantized row for the raw slice).
+
+    The decode comes from the SAME stage-1 ``QTensor`` the wire sends —
+    quantize-once *by construction*. The previous EF path re-quantized
+    the identical rows in a separate mirror (``_roundtrip_wire_1axis``)
+    and relied on XLA to CSE the duplicate; plain-XLA codec ops do CSE,
+    but Pallas kernels lower to custom calls XLA treats conservatively,
+    so on TPU the mirror could cost a full extra quantize pass per step.
+    Sharing the tensor also removes the mirror's key-derivation fragility
+    (the mirror had to replicate ``_phase_key`` exactly or the residual
+    measured a different random draw than the wire's)."""
+    n = x.shape[0]
+    reduced, q, xs, own = _sra_stage1(x, axis_name, ws, cc, key)
+    rt_rows = _dequantize_rows(q)
+    rt = (
+        jnp.where(own, xs.astype(rt_rows.dtype), rt_rows)
+        .reshape(-1)[:n]
+        .astype(x.dtype)
+    )
+    return allgather_quantized(reduced, axis_name, ws, cc, n, x.dtype, key), rt
+
+
+def alltoall_allreduce_with_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array] = None,
+):
+    """:func:`alltoall_allreduce` + this device's wire decode from the same
+    payload (every peer decodes exactly these bytes — the whole buffer is
+    one quantized row)."""
     k = None
     if key is not None and cc.stochastic:
         k = jax.random.fold_in(key, lax.axis_index(axis_name))
     q = _quantize_1d(x, cc, k)
+    rt = _dequantize_1d(q).astype(x.dtype)
     gathered = _gather_rows(q, axis_name)
     vals = _dequantize_rows(gathered)
-    return jnp.sum(vals, axis=0).astype(x.dtype)
+    return jnp.sum(vals, axis=0).astype(x.dtype), rt
+
+
+def sra_stage1_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Mirror of SRA's stage-1 wire decode WITHOUT running the collective:
+    what the peers decode from this device's payload, own row raw. Used by
+    the hierarchical EF path, where the wire itself runs inside
+    :func:`hierarchical_allreduce` and the payload cannot be threaded out;
+    single-axis callers should prefer :func:`sra_allreduce_with_wire`
+    (shares the payload, quantize-once)."""
+    n = x.shape[0]
+    rows = _pad_rows(x, ws, _chunk_size(n, ws))
+    q = _quantize_rows(rows, cc, _phase_key(key, 1, axis_name))
+    vals = _dequantize_rows(q)
+    own = (jnp.arange(ws) == lax.axis_index(axis_name))[:, None]
+    return (
+        jnp.where(own, rows.astype(vals.dtype), vals)
+        .reshape(-1)[:n]
+        .astype(x.dtype)
+    )
+
+
+def _ring_hop0_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array],
+) -> jax.Array:
+    """Ring's EF residual base: the only per-device-attributable
+    quantization of RAW data is the step-0 hop of the own outgoing segment
+    (row index = rank), keyed ``fold_in(fold_in(key, 0), rank)`` like
+    ``ring_allreduce``'s first scatter step. Later hops requantize
+    accumulated sums — treated exact for EF purposes (documented
+    approximation). This is a mirror (the hop lives inside a ``lax.scan``
+    the payload cannot be threaded out of); it re-quantizes 1/ws of the
+    buffer."""
+    n = x.shape[0]
+    chunk = _chunk_size(n, ws)
+    rank = lax.axis_index(axis_name)
+    rows = _pad_rows(x, ws, chunk)
+    own = lax.dynamic_slice(rows, (rank, 0), (1, chunk))
+    k = (
+        jax.random.fold_in(jax.random.fold_in(key, 0), rank)
+        if key is not None and cc.stochastic
+        else None
+    )
+    q = dispatch.quantize_batch(own, cc, k)
+    rt_own = dispatch.dequantize_batch(q, out_dtype=x.dtype)
+    rows = lax.dynamic_update_slice(rows, rt_own, (rank, 0))
+    return rows.reshape(-1)[:n]
+
+
+def quantized_allreduce_with_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    reduction: str = cfg_mod.REDUCTION_SRA,
+    key: Optional[jax.Array] = None,
+):
+    """:func:`quantized_allreduce` + this device's wire decode ``rt``
+    (``(reduced, rt)``) for the error-feedback residual. Exact wires
+    (PSUM, compression off, dummy codec, ws == 1 without the force-codec
+    knob) round-trip unchanged: ``rt = x``. SRA and all-to-all share the
+    wire payload (quantize-once); Ring uses the hop-0 mirror."""
+    if ws == 1:
+        out = quantized_allreduce(x, axis_name, ws, cc, reduction, key)
+        # force-codec proxy: the single-rank "wire" decode IS the output;
+        # plain ws==1 is the identity (zero residual) either way.
+        return out, out
+    if cfg_mod.dummy_compression() or not cc.enabled or (
+        reduction == cfg_mod.REDUCTION_PSUM
+    ):
+        return quantized_allreduce(x, axis_name, ws, cc, reduction, key), x
+    if reduction == cfg_mod.REDUCTION_SRA:
+        return sra_allreduce_with_wire(x, axis_name, ws, cc, key)
+    if reduction == cfg_mod.REDUCTION_ALLTOALL:
+        return alltoall_allreduce_with_wire(x, axis_name, ws, cc, key)
+    if reduction == cfg_mod.REDUCTION_RING:
+        return (
+            ring_allreduce(x, axis_name, ws, cc, key),
+            _ring_hop0_wire(x, axis_name, ws, cc, key),
+        )
+    raise ValueError(f"unknown reduction {reduction!r}")
 
 
 def quantized_allreduce(
