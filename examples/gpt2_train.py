@@ -50,6 +50,16 @@ def parse_args():
     p.add_argument("--powersgd-rank", type=_rank, default=0,
                    help="replace the quantized allreduce with PowerSGD "
                         "low-rank compression at this rank (0 = off)")
+    def _ratio(v):
+        v = float(v)
+        if v and not 0 < v < 1:
+            raise argparse.ArgumentTypeError("topk ratio must be in (0, 1)")
+        return v
+
+    p.add_argument("--topk-ratio", type=_ratio, default=0,
+                   help="replace the quantized allreduce with top-k "
+                        "sparsification shipping this fraction of each "
+                        "gradient's coordinates (0 = off)")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--batch", type=int, default=8, help="global batch (sequences)")
     p.add_argument("--seq", type=int, default=128)
@@ -130,10 +140,16 @@ def load_text_corpus(seq: int):
 
 def main():
     args = parse_args()
-    if args.powersgd_rank and args.error_feedback:
+    picked = [
+        f for f, on in (("--powersgd-rank", args.powersgd_rank),
+                        ("--topk-ratio", args.topk_ratio),
+                        ("--error-feedback", args.error_feedback))
+        if on
+    ]
+    if len(picked) > 1:
         raise SystemExit(
-            "gpt2_train.py: error: --powersgd-rank and --error-feedback "
-            "are mutually exclusive"
+            f"gpt2_train.py: error: {' and '.join(picked)} are mutually "
+            "exclusive (each compressor carries its own error feedback)"
         )
     if args.cpu:
         # Force, don't setdefault: append to whatever XLA_FLAGS exists.
@@ -258,6 +274,7 @@ def main():
         donate=False,
         error_feedback=args.error_feedback,
         powersgd_rank=args.powersgd_rank or None,
+        topk_ratio=args.topk_ratio or None,
     )
     state = None
     if args.powersgd_rank:
@@ -266,6 +283,12 @@ def main():
         state = init_powersgd_state(
             params, mesh, rank=args.powersgd_rank, axes=dp_axes,
             sp_axis=sp_axis,
+        )
+    elif args.topk_ratio:
+        from torch_cgx_tpu.parallel import init_topk_state
+
+        state = init_topk_state(
+            params, mesh, args.topk_ratio, axes=dp_axes, sp_axis=sp_axis,
         )
     elif args.error_feedback:
         from torch_cgx_tpu.parallel import init_error_feedback
@@ -278,10 +301,11 @@ def main():
         if args.sp > 1:
             raise SystemExit("--adaptive-bits composes with sp=1 only "
                              "(the measurement grad runs outside shard_map)")
-        if args.powersgd_rank:
+        if args.powersgd_rank or args.topk_ratio:
             raise SystemExit("--adaptive-bits has no effect under "
-                             "--powersgd-rank (the low-rank reducer does "
-                             "not consult the quantization registry)")
+                             "--powersgd-rank / --topk-ratio (those "
+                             "reducers do not consult the quantization "
+                             "registry)")
         from torch_cgx_tpu.parallel.adaptive import adapt_bits
 
         grad_for_stats = jax.jit(jax.grad(loss_fn))
@@ -296,13 +320,13 @@ def main():
             raise SystemExit("--checkpoint-dir in this example composes "
                              "with tp=1 only (restore re-replicates; tp "
                              "resharding is left to the checkpoint API)")
-        if args.error_feedback or args.powersgd_rank:
+        if args.error_feedback or args.powersgd_rank or args.topk_ratio:
             raise SystemExit(
                 "--checkpoint-dir in this example does not checkpoint the "
-                "error-feedback residuals / PowerSGD factors; resuming "
-                "would silently reset that state (checkpoint the `state` "
-                "pytree alongside params via torch_cgx_tpu.checkpoint in "
-                "real training loops)")
+                "error-feedback residuals / PowerSGD factors / top-k "
+                "residuals; resuming would silently reset that state "
+                "(checkpoint the `state` pytree alongside params via "
+                "torch_cgx_tpu.checkpoint in real training loops)")
         from torch_cgx_tpu import checkpoint as ckpt
 
         last = ckpt.latest_step(args.checkpoint_dir)
@@ -356,6 +380,8 @@ def main():
         # step INSIDE the steady timing window — steps_per_s under
         # adaptive bits includes that recompile cost.
         **({"bit_reallocs": bit_allocs} if args.adaptive_bits else {}),
+        **({"powersgd_rank": args.powersgd_rank} if args.powersgd_rank else {}),
+        **({"topk_ratio": args.topk_ratio} if args.topk_ratio else {}),
         "first_loss": losses[0],
         "final_loss": losses[-1],
         "compile_s": round(steady0 - t0, 2),

@@ -190,6 +190,7 @@ def make_train_step(
     donate: bool = True,
     error_feedback: bool = False,
     powersgd_rank: Optional[int] = None,
+    topk_ratio: Optional[float] = None,
 ):
     """Build a jitted compressed-DP train step.
 
@@ -234,13 +235,29 @@ def make_train_step(
     :func:`.powersgd.init_powersgd_state` (warm-start factors replicated,
     per-device residuals on a leading device axis). Mutually exclusive
     with ``error_feedback`` (PowerSGD carries its own EF).
+
+    ``topk_ratio=r`` replaces the quantized allreduce with top-k
+    sparsification (:mod:`.topk`) shipping the ``ceil(r * n)`` largest-
+    magnitude coordinates per leaf: ``step(params, opt_state, tk, batch,
+    step_idx) -> (params, opt_state, tk, loss)`` with ``tk`` from
+    :func:`.topk.init_topk_state`. Mutually exclusive with
+    ``error_feedback`` and ``powersgd_rank`` (top-k carries its own EF).
     """
     import inspect
 
-    if powersgd_rank is not None and error_feedback:
+    exclusive = [
+        name
+        for name, on in (
+            ("error_feedback", error_feedback),
+            ("powersgd_rank", powersgd_rank is not None),
+            ("topk_ratio", topk_ratio is not None),
+        )
+        if on
+    ]
+    if len(exclusive) > 1:
         raise ValueError(
-            "make_train_step: powersgd_rank and error_feedback are "
-            "mutually exclusive — PowerSGD carries its own error feedback"
+            f"make_train_step: {' and '.join(exclusive)} are mutually "
+            "exclusive — each compressor carries its own error feedback"
         )
     axes = tuple(axes)
     sync_axes = axes if sp_axis is None else axes + (sp_axis,)
@@ -295,6 +312,28 @@ def make_train_step(
             mesh=mesh, axes=sync_axes, rank=powersgd_rank, average=True,
             placement_warning=False,
         )
+
+    if topk_ratio is not None:
+        from .topk import TopKState, topk_transform
+
+        topk_tx = topk_transform(
+            mesh=mesh, axes=sync_axes, ratio=topk_ratio, average=True,
+            placement_warning=False,
+        )
+
+    def _step_topk(params, opt_state, tk, batch, step_idx):
+        loss, grads, _ = _grads_and_key(params, batch, step_idx)
+        local = TopKState(
+            es=tuple(None if e is None else jnp.squeeze(e, 0) for e in tk.es)
+        )
+        reduced, st = topk_tx.update(grads, local)
+        updates, opt_state = optimizer.update(reduced, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        loss = jax.lax.psum(loss, sync_axes) / ws_total
+        out_state = TopKState(
+            es=tuple(None if e is None else e[None] for e in st.es)
+        )
+        return params, opt_state, out_state, loss
 
     def _step_psgd(params, opt_state, psgd, batch, step_idx):
         loss, grads, _ = _grads_and_key(params, batch, step_idx)
@@ -363,14 +402,23 @@ def make_train_step(
                 # pytree-prefix spec: replicated warm-start factors,
                 # per-device residual rows on the leading device dim
                 state_spec = PowerSGDState(qs=P(), es=P(sync_axes))
+            elif topk_ratio is not None:
+                state_spec = TopKState(es=P(sync_axes))
             else:
                 state_spec = P(sync_axes)  # EF residual leaves
-            with_state = error_feedback or powersgd_rank is not None
-            body = (
-                _step_psgd
-                if powersgd_rank is not None
-                else (_step_ef if error_feedback else _step)
+            with_state = (
+                error_feedback
+                or powersgd_rank is not None
+                or topk_ratio is not None
             )
+            if powersgd_rank is not None:
+                body = _step_psgd
+            elif topk_ratio is not None:
+                body = _step_topk
+            elif error_feedback:
+                body = _step_ef
+            else:
+                body = _step
             sharded = jax.shard_map(
                 body,
                 mesh=mesh,
@@ -405,7 +453,7 @@ def make_train_step(
             built[cache_key] = fn
         return fn
 
-    if error_feedback or powersgd_rank is not None:
+    if error_feedback or powersgd_rank is not None or topk_ratio is not None:
 
         def step(params, opt_state, state, batch, step_idx):
             return _build(batch)(params, opt_state, state, batch, step_idx)

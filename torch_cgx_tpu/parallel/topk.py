@@ -1,0 +1,215 @@
+"""Top-K gradient sparsification (Deep Gradient Compression lineage).
+
+Beyond the reference: its compressor hierarchy is max-min quantization
+plus a debug pass-through (compressor.h:130,145). Together with PowerSGD
+(:mod:`.powersgd`, low-rank) and adaptive per-layer bits
+(:mod:`.adaptive`), this module completes the standard gradient-
+compression taxonomy — quantization / low-rank / sparsification — behind
+the same optax-transform surface.
+
+Per eligible leaf g (flattened to n values, per-device EF residual e):
+
+    M    = g + e                  # error feedback (mandatory: top-k drops
+                                  # almost everything; the complement must
+                                  # be carried, not lost)
+    idx  = top_k(|M|, k)          # this device's k largest coordinates
+    val  = M[idx]                 # signed values at those coordinates
+    # sparse allreduce: all_gather the (idx, val) pairs over the sync
+    # axes and scatter-add into a dense buffer — every device sees every
+    # pair, so the scatter runs on identical data and the output is
+    # bit-identical across devices by construction.
+    S    = scatter_add(all pairs) # sum over devices of their sparse picks
+    out  = S / ws                 # (average=True)
+    e'   = M - densify(idx, val)  # keep everything THIS device didn't ship
+
+TPU-first shape discipline: ``k`` is static at trace time (a ratio of
+``n``), ``lax.top_k`` and one ``.at[].add`` scatter are the only
+non-matmul ops, and the gathered ``(ws, k)`` index/value blocks ride the
+ordinary all_gather path (no sparse formats on the wire).
+
+Traffic per step and rank: ``k * 8`` bytes sent / ``ws * k * 8``
+received (int32 index + f32 value) instead of ``4n`` dense — e.g. at
+ratio 1% the wire is ~50x smaller than fp32, ~6x smaller than 4-bit
+max-min quantization (which keeps every coordinate at low precision;
+top-k keeps few coordinates at full precision — complementary regimes:
+quantization for dense-information gradients, sparsification for
+peaky ones).
+
+Ineligible leaves (tiny, or k would not shrink the wire) ride an exact
+``lax.psum``.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from .. import config as cfg_mod
+from ..utils.logging import metrics
+from . import mesh as mesh_mod
+
+
+class TopKState(NamedTuple):
+    """es: per-device EF residuals, one flat f32 buffer per eligible leaf
+    (``None`` for psum leaves). Same placement hazard as
+    :class:`ErrorFeedbackState`: NEVER declare them replicated under
+    shard_map — each device must keep its own residual."""
+
+    es: tuple
+
+
+def _k_for(n: int, ratio: float) -> int:
+    return max(1, int(np.ceil(ratio * n)))
+
+
+def eligible(leaf, ratio: float) -> bool:
+    """Sparsification pays off: float, above the minimal size, and the
+    (index, value) pairs are smaller IN BYTES than the dense leaf — a
+    pair costs 8 bytes (int32 + f32) regardless of the leaf's dtype, so
+    bf16 leaves need a smaller ratio than f32 ones to qualify."""
+    if not jnp.issubdtype(leaf.dtype, jnp.floating):
+        return False
+    n = int(leaf.size)
+    if n < cfg_mod.minimal_size():
+        return False
+    return 8 * _k_for(n, ratio) < n * jnp.dtype(leaf.dtype).itemsize
+
+
+def init_topk(params, ratio: float) -> TopKState:
+    """Zero EF residuals per eligible leaf. Placement under ``jax.jit`` +
+    ``shard_map``: give each ``es`` leaf a leading device axis sharded
+    over the sync axes (the :func:`init_error_feedback` pattern) and
+    strip it inside the mapped function, or use :func:`init_topk_state`."""
+    return TopKState(
+        es=tuple(
+            jnp.zeros((leaf.size,), jnp.float32)
+            if eligible(leaf, ratio)
+            else None
+            for leaf in jax.tree_util.tree_leaves(params)
+        )
+    )
+
+
+def topk_transform(
+    *,
+    mesh,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    ratio: float = 0.01,
+    average: bool = True,
+    placement_warning: bool = True,
+) -> optax.GradientTransformation:
+    """optax transformation: top-k-sparsified gradient allreduce.
+
+    Prepend to an optimizer chain running inside ``shard_map``::
+
+        tx = optax.chain(
+            cgx.topk_transform(mesh=mesh, ratio=0.01), optax.adam(1e-3)
+        )
+
+    The state (:class:`TopKState`) carries per-device EF residuals —
+    under shard_map, shard the ``es`` leaves or manage placement via
+    :func:`init_topk_state`. Ineligible leaves take an exact ``psum``.
+    Outputs are bit-identical across devices (the dense reconstruction
+    is computed from all_gathered pairs every device sees identically).
+    """
+    if not 0.0 < ratio < 1.0:
+        raise ValueError(f"topk ratio must be in (0, 1), got {ratio!r}")
+    axes = tuple(axes)
+    ws = int(np.prod([mesh.shape[a] for a in axes]))
+
+    def _psum(x):
+        for a in axes:
+            if mesh.shape[a] > 1:
+                x = lax.psum(x, a)
+        return x
+
+    def _gather(x):
+        for a in axes:
+            if mesh.shape[a] > 1:
+                x = lax.all_gather(x, a, axis=0, tiled=True)
+        return x
+
+    def init_fn(params):
+        return init_topk(params, ratio)
+
+    def update_fn(updates, state, params=None):
+        del params
+        if placement_warning:  # es is per-device, like EF state;
+            # make_train_step(topk_ratio=...) wires placement itself
+            # and passes False
+            from .grad_sync import _warn_ef_placement_once
+
+            _warn_ef_placement_once()
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        if len(leaves) != len(state.es):
+            raise ValueError(
+                "TopK state was initialised from a different parameter "
+                f"tree: got {len(leaves)} gradient leaves but state holds "
+                f"{len(state.es)} residuals. Re-run init_topk on the tree "
+                "actually being optimised."
+            )
+        out_scale = np.float32(1.0 / ws if average else 1.0)
+        out, es_new = [], []
+        for leaf, e in zip(leaves, state.es):
+            if e is None:
+                g = leaf.astype(jnp.float32)
+                red = _psum(g) * out_scale
+                metrics.add("trace.topk.raw_elems", float(leaf.size))
+                out.append(red.astype(leaf.dtype))
+                es_new.append(None)
+                continue
+            n = leaf.size
+            k = _k_for(n, ratio)
+            m = leaf.astype(jnp.float32).reshape(-1) + e
+            _, idx = lax.top_k(jnp.abs(m), k)
+            val = jnp.take(m, idx)
+            # (ws*k,) after tiled gathers; identical on every device.
+            all_idx = _gather(idx)
+            all_val = _gather(val)
+            dense = (
+                jnp.zeros((n,), jnp.float32).at[all_idx].add(all_val)
+            )
+            metrics.add("trace.topk.wire_elems", float(2 * k))
+            metrics.add("trace.topk.grad_elems", float(n))
+            out.append(
+                (dense * out_scale).reshape(leaf.shape).astype(leaf.dtype)
+            )
+            # residual = m minus what this device shipped; m[i] - m[i] is
+            # exactly 0.0 in float, so one in-place scatter replaces the
+            # dense own_dense buffer + subtraction bit-identically.
+            es_new.append(m.at[idx].set(0.0))
+        return (
+            jax.tree_util.tree_unflatten(treedef, out),
+            TopKState(es=tuple(es_new)),
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def init_topk_state(
+    params,
+    mesh,
+    ratio: float,
+    axes: Sequence[str] = (mesh_mod.DP_AXIS,),
+    sp_axis=None,
+) -> TopKState:
+    """Placement-ready state for ``make_train_step(topk_ratio=...)``:
+    each ``es`` leaf stacked to ``(ws, n)`` and sharded over the sync
+    axes on the leading device dim (the :func:`init_error_feedback`
+    pattern), so every device owns exactly its own residual row."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sync_axes = tuple(axes) if sp_axis is None else tuple(axes) + (sp_axis,)
+    ws = int(np.prod([mesh.shape[a] for a in sync_axes]))
+    es = tuple(
+        jnp.zeros((ws, leaf.size), jnp.float32)
+        if eligible(leaf, ratio)
+        else None
+        for leaf in jax.tree_util.tree_leaves(params)
+    )
+    return TopKState(es=jax.device_put(es, NamedSharding(mesh, P(sync_axes))))
