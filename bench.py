@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from torch_cgx_tpu.utils.compat import shard_map
 
 # Persistent compile cache: the GPT-2 proxy's scans are the bulk of bench
 # wall time on a cold process; cache them across runs.
@@ -286,7 +287,7 @@ def _bench_train_step_inner(on_tpu: bool, mesh1) -> dict:
         # pays, including the framework's own glue.
         p, s = carry
         loss, grads = jax.value_and_grad(loss_fn)(p)
-        grads = jax.shard_map(
+        grads = shard_map(
             lambda g: gradient_sync(g, mesh=mesh1, average=False),
             mesh=mesh1,
             in_specs=P(),
@@ -353,8 +354,8 @@ def bench_allreduce(devices) -> dict:
         return jax.lax.psum(x, "dp")
 
     shard = dict(mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
-    q = jax.jit(jax.shard_map(q_allreduce, **shard))
-    f = jax.jit(jax.shard_map(f32_allreduce, **shard))
+    q = jax.jit(shard_map(q_allreduce, **shard))
+    f = jax.jit(shard_map(f32_allreduce, **shard))
 
     def fetch(out):
         for leaf in jax.tree.leaves(out):

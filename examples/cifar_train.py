@@ -37,6 +37,8 @@ import time
 # Allow `python examples/cifar_train.py` from a source checkout.
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from torch_cgx_tpu.utils.compat import shard_map  # noqa: E402
+
 
 def parse_args():
     p = argparse.ArgumentParser(description="CGX-TPU CIFAR training")
@@ -235,7 +237,7 @@ def main():
         return params, batch_stats, opt_state, loss, acc
 
     step = jax.jit(
-        jax.shard_map(
+        shard_map(
             _step,
             mesh=mesh,
             in_specs=(P(), P(), P(), P(axes)),

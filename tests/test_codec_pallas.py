@@ -210,8 +210,13 @@ def test_pallas_stochastic_envelope():
     assert (err.max(axis=1) <= units * 1.01).all()
     # And the rounding is genuinely stochastic: strictly inside-the-grid
     # values must land on BOTH adjacent levels somewhere in 32k draws
-    # (deterministic rounding would give err <= unit/2 everywhere).
-    assert err.max() > units.max() * 0.5
+    # (deterministic rounding would give err <= unit/2 everywhere). The
+    # bound is PER BUCKET here too (advisor r5 low #3): the global max
+    # error may come from a small-unit bucket, so comparing it against the
+    # global max unit can fail spuriously when the widest bucket happens
+    # to round near its levels — assert some bucket exceeds its OWN
+    # deterministic bound instead.
+    assert (err.max(axis=1) > units * 0.5).any()
 
 
 def test_pallas_add_fusion():

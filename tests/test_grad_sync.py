@@ -5,7 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from torch_cgx_tpu.utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 import torch_cgx_tpu
@@ -246,7 +246,7 @@ def test_force_codec_ws1(monkeypatch):
         return gradient_sync(g, mesh=mesh, average=False)
 
     run = jax.jit(
-        jax.shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
+        shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
                       check_vma=False)
     )
     # Without the flag: ws==1 is the identity.
@@ -256,7 +256,7 @@ def test_force_codec_ws1(monkeypatch):
     # (config is read at trace time, so build a fresh jit for the new env)
     monkeypatch.setenv(cgx_config.DEBUG_FORCE_CODEC, "1")
     run2 = jax.jit(
-        jax.shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
+        shard_map(sync, mesh=mesh, in_specs=P(), out_specs=P(),
                       check_vma=False)
     )
     y = run2({"w": x})["w"]

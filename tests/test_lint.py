@@ -36,3 +36,64 @@ def test_linter_detects_undefined_name(tmp_path):
     )
     assert proc.returncode == 1
     assert "_renamed_away_impl" in proc.stdout
+
+
+def _run_lint(*paths):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "lint.py"), *map(str, paths)],
+        capture_output=True,
+        text=True,
+        cwd=ROOT,
+    )
+
+
+def test_linter_flags_unbounded_wait_in_torch_backend(tmp_path):
+    # The robustness gate (ISSUE 1 satellite): a bare `while True` polling
+    # loop without a deadline in the bridge transport is a hang waiting to
+    # happen — it must be a lint failure.
+    bdir = tmp_path / "torch_backend"
+    bdir.mkdir()
+    bad = bdir / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def poll(store, key):\n"
+        "    while True:\n"
+        "        if store.check([key]):\n"
+        "            return\n"
+        "        time.sleep(0.05)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "unbounded wait" in proc.stdout
+
+
+def test_linter_accepts_bounded_wait(tmp_path):
+    bdir = tmp_path / "torch_backend"
+    bdir.mkdir()
+    good = bdir / "good.py"
+    good.write_text(
+        "import time\n"
+        "def poll(store, key, deadline):\n"
+        "    while True:\n"
+        "        if store.check([key]):\n"
+        "            return\n"
+        "        if time.monotonic() > deadline:\n"
+        "            raise RuntimeError('timed out')\n"
+        "        time.sleep(0.05)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_wait_gate_scoped_to_transport_dirs(tmp_path):
+    # Outside torch_backend/robustness the same loop is fine (e.g. a
+    # benchmark driver polling a subprocess) — the gate must not fire.
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "import time\n"
+        "def poll(q):\n"
+        "    while True:\n"
+        "        time.sleep(0.05)\n"
+    )
+    proc = _run_lint(other)
+    assert proc.returncode == 0, proc.stdout

@@ -8,6 +8,7 @@ import pytest
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torch_cgx_tpu.models import GPT2, GPT2Config, lm_loss
+from torch_cgx_tpu.utils.compat import set_mesh
 from torch_cgx_tpu.parallel.moe import MoEMlp, aux_loss, moe_param_spec
 
 
@@ -97,7 +98,7 @@ def test_ep_sharded_matches_unsharded():
 
     sharded_params = jax.tree_util.tree_map_with_path(shard_leaf, params)
     x_sh = jax.device_put(x, NamedSharding(mesh, P()))
-    with jax.set_mesh(mesh):
+    with set_mesh(mesh):
         got = jax.jit(m.apply)(sharded_params, x_sh)
     np.testing.assert_allclose(
         np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5

@@ -42,7 +42,7 @@ def _proc_main(rank: int, ws: int, port: int, q) -> None:
         import jax.numpy as jnp
         import numpy as np
         import optax
-        from jax import shard_map
+        from torch_cgx_tpu.utils.compat import shard_map
         from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
         from torch_cgx_tpu.config import CompressionConfig
@@ -128,7 +128,7 @@ def _hier_main(rank: int, ws: int, port: int, q) -> None:
 
         jax.config.update("jax_platforms", "cpu")
         import numpy as np
-        from jax import shard_map
+        from torch_cgx_tpu.utils.compat import shard_map
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from torch_cgx_tpu.config import CompressionConfig, TopologyConfig

@@ -14,6 +14,7 @@ from torch_cgx_tpu.parallel.pipeline import (
     stack_stage_params,
     unstack_stage_params,
 )
+from torch_cgx_tpu.utils.compat import shard_map
 
 D = 16
 
@@ -49,7 +50,7 @@ def _pipelined(mesh, n_stages, n_micro, stacked, x):
         return merge_microbatches(out)
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
             check_vma=False,
         )
@@ -84,7 +85,7 @@ def test_pipeline_grads_match_sequential():
             )
             return jnp.sum(merge_microbatches(out) ** 2)
 
-        return jax.shard_map(
+        return shard_map(
             run, mesh=mesh, in_specs=(P("pp"), P()),
             out_specs=P(), check_vma=False,
         )(stacked_p, x)
@@ -126,7 +127,7 @@ def _run_1f1b(mesh, n_stages, n_micro, stacked, micro, targets):
         )
 
     return jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh,
             in_specs=(P("pp"), P("pp"), P()),
             out_specs=(P(), P("pp")),
@@ -190,7 +191,7 @@ def test_1f1b_loss_replicated_and_feed_sharded():
         return loss[None]
 
     per_device = jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
             out_specs=P("pp"), check_vma=False,
         )
@@ -230,7 +231,7 @@ def test_1f1b_stash_bound():
         t = jnp.zeros((n_micro, 2, D), jnp.float32)
         return str(
             jax.make_jaxpr(
-                jax.shard_map(
+                shard_map(
                     run, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
                     out_specs=(P(), P("pp")), check_vma=False,
                 )
@@ -281,7 +282,7 @@ def test_1f1b_composes_with_quantized_dp(monkeypatch):
         return loss, grads
 
     loss, grads = jax.jit(
-        jax.shard_map(
+        shard_map(
             run, mesh=mesh,
             in_specs=(P("pp"), P("dp", "pp"), P("dp")),
             out_specs=(P(), P("pp")),
@@ -337,7 +338,7 @@ def test_interleaved_matches_sequential(n_virtual, n_micro):
         return merge_microbatches(out)
 
     got = jax.jit(
-        jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
+        shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
                       out_specs=P(), check_vma=False)
     )(stacked, x)
     want = _sequential(chunks, x)
@@ -366,7 +367,7 @@ def test_interleaved_grads_match_sequential():
             )
             return jnp.sum(merge_microbatches(out) ** 2)
 
-        return jax.shard_map(
+        return shard_map(
             run, mesh=mesh, in_specs=(P("pp"), P()),
             out_specs=P(), check_vma=False,
         )(stacked_p, x)
@@ -410,7 +411,7 @@ def test_interleaved_rejects_ragged_microbatches():
 
     with pytest.raises(AssertionError, match="microbatches % n_stages"):
         jax.jit(
-            jax.shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
+            shard_map(run, mesh=mesh, in_specs=(P("pp"), P()),
                           out_specs=P(), check_vma=False)
         )(stacked, x)
 
@@ -437,7 +438,7 @@ def test_pipeline_compressed_hops():
             return merge_microbatches(out)
 
         return jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+            shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
                           out_specs=P(), check_vma=False)
         )(stacked, x)
 
@@ -457,7 +458,7 @@ def test_pipeline_compressed_hops():
             )
             return jnp.sum(merge_microbatches(out) ** 2)
 
-        return jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+        return shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
                              out_specs=P(), check_vma=False)(stacked_p, x)
 
     g = jax.jit(jax.grad(loss))(stacked)
@@ -492,7 +493,7 @@ def test_interleaved_compressed_hops():
             return merge_microbatches(out)
 
         return np.asarray(jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
+            shard_map(body, mesh=mesh, in_specs=(P("pp"), P()),
                           out_specs=P(), check_vma=False)
         )(stacked, x))
 
@@ -525,7 +526,7 @@ def test_1f1b_compressed_hops():
             )
 
         loss, grads = jax.jit(
-            jax.shard_map(body, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
+            shard_map(body, mesh=mesh, in_specs=(P("pp"), P("pp"), P()),
                           out_specs=(P(), P("pp")), check_vma=False)
         )(stacked, micro, tgts)
         return float(loss), jax.tree.map(np.asarray, grads)

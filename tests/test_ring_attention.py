@@ -18,6 +18,7 @@ from torch_cgx_tpu.parallel.ring_attention import (
     ring_attention,
     ulysses_attention,
 )
+from torch_cgx_tpu.utils.compat import shard_map
 
 
 def _mesh(ws):
@@ -41,7 +42,7 @@ def _run_sharded(fn, mesh, q, k, v, mask=None):
         in_specs = in_specs + (mspec,)
         args.append(jax.device_put(mask, NamedSharding(mesh, mspec)))
     sharded = jax.jit(
-        jax.shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)
+        shard_map(fn, mesh=mesh, in_specs=in_specs, out_specs=spec)
     )
     return np.asarray(sharded(*args))
 
@@ -167,7 +168,7 @@ def test_gpt2_with_ring_attention_matches_dense():
     tok_spec = P(None, "sp")
     positions = jnp.broadcast_to(jnp.arange(64)[None, :], tokens.shape)
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             fwd,
             mesh=mesh,
             in_specs=(P(), tok_spec, tok_spec),
@@ -212,7 +213,7 @@ def test_gpt2_with_sp_padding_mask_matches_dense():
     tok_spec = P(None, "sp")
     positions = jnp.broadcast_to(jnp.arange(s)[None, :], tokens.shape)
     sharded = jax.jit(
-        jax.shard_map(
+        shard_map(
             fwd,
             mesh=mesh,
             in_specs=(P(), tok_spec, tok_spec, tok_spec),
@@ -249,7 +250,7 @@ def test_sp_lm_loss_matches_dense():
     mesh = Mesh(np.asarray(jax.devices()[:sp]), ("sp",))
 
     got = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda lg, tk: sp_lm_loss(lg, tk, "sp"),
             mesh=mesh,
             in_specs=(P(None, "sp"), P(None, "sp")),
@@ -338,7 +339,7 @@ def test_ulysses_compressed_hops_close_to_plain():
                                      hop_cc=hop_cc)
 
         return np.asarray(
-            jax.jit(jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+            jax.jit(shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                                   out_specs=spec, check_vma=False))(q, k, v)
         )
 
@@ -352,7 +353,7 @@ def test_ulysses_compressed_hops_close_to_plain():
         def fn(x, kk, vv):
             return ulysses_attention(x, kk, vv, axis_name="sp", hop_cc=cc)
 
-        out = jax.shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
+        out = shard_map(fn, mesh=mesh, in_specs=(spec,) * 3,
                             out_specs=spec, check_vma=False)(qq, k, v)
         return jnp.sum(out**2)
 

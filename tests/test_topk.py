@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import optax
 import pytest
-from jax import shard_map
+from torch_cgx_tpu.utils.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from torch_cgx_tpu.parallel import (
@@ -70,7 +70,9 @@ def test_exact_when_k_covers_support():
     """Every device's gradient has <= k nonzeros: the sparse allreduce is
     the exact mean (extra picks ship zeros, which add nothing) and every
     residual is exactly zero."""
-    n, ratio = 512, 0.125  # k = 64
+    # ratio under the ws-aware receive gate (8*k*ws < 2*n*4*(ws-1)/ws
+    # at ws=8 needs k/n < ~0.109): 0.0625 keeps the leaf eligible.
+    n, ratio = 512, 0.0625  # k = 32
     k = _k_for(n, ratio)
     rng = np.random.default_rng(0)
     trees = []
@@ -171,6 +173,25 @@ def test_eligibility_and_validation():
         tx.update({"a": jnp.zeros((512,)), "b": jnp.zeros((512,))}, state)
 
 
+def test_eligibility_world_size_aware():
+    """The receive-side gate (advisor r5 low #1): the all_gather delivers
+    ws*k pairs per rank, so a ratio that passes the send gate can still
+    move more traffic than the ~2*n*itemsize dense allreduce receive at
+    large world sizes — eligibility must tighten with ws."""
+    leaf = jnp.zeros((4096,), jnp.float32)
+    ratio = 0.2  # k = 820: send 8k < 4n passes the ws-blind gate
+    assert eligible(leaf, ratio)  # ws=1 default: old behavior preserved
+    assert eligible(leaf, ratio, ws=2)  # rx 2*8k=13k < 2*4n*(1/2)=16k
+    # ws=8: rx = 8*8*820 = 52k bytes vs dense 2*4n*(7/8) = 28k — sparse
+    # would RECEIVE ~2x the dense traffic; the gate must refuse.
+    assert not eligible(leaf, ratio, ws=8)
+    # a genuinely sparse ratio stays eligible at any realistic ws
+    assert eligible(leaf, 0.01, ws=64)
+    # init plumbs ws through: the same leaf flips from eligible to psum
+    assert init_topk({"w": leaf}, ratio, ws=2).es[0] is not None
+    assert init_topk({"w": leaf}, ratio, ws=8).es[0] is None
+
+
 def test_make_train_step_topk_converges():
     """End-to-end: make_train_step(topk_ratio=...) trains the toy problem
     to a large loss reduction with bit-identical replicas."""
@@ -188,10 +209,12 @@ def test_make_train_step_topk_converges():
         return jnp.mean((jnp.tanh(x @ p["w1"]) @ p["w2"] - y) ** 2)
 
     opt = optax.adam(3e-3)
-    step = make_train_step(loss_fn, opt, mesh=mesh, topk_ratio=0.25)
+    # 0.1 stays under the ws-aware receive gate at ws=8 for the 1024-
+    # element w1 (w2 is small enough that it rides the exact psum).
+    step = make_train_step(loss_fn, opt, mesh=mesh, topk_ratio=0.1)
     p = replicate(params, mesh)
     st = replicate(opt.init(params), mesh)
-    tk = init_topk_state(params, mesh, 0.25)
+    tk = init_topk_state(params, mesh, 0.1)
     first = last = None
     for i in range(150):
         p, st, tk, loss = step(
@@ -205,7 +228,7 @@ def test_make_train_step_topk_converges():
         shards = [np.asarray(s.data) for s in leaf.addressable_shards]
         for s in shards[1:]:
             np.testing.assert_array_equal(shards[0], s)
-    # the residual is alive: top-k at 25% genuinely drops mass every step
+    # the residual is alive: top-k at 10% genuinely drops mass every step
     ef_mag = max(
         float(jnp.abs(e).max()) for e in tk.es if e is not None
     )

@@ -31,6 +31,24 @@ def _pool_worker(rank: int, ws: int, task_q, result_q) -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
     os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS", None)
     sys.path.insert(0, _REPO)
+    # Debug hook (name deliberately NOT CGX_-prefixed: the conftest env
+    # isolation fixture strips that prefix before every test): periodic
+    # all-thread stack dumps + a task-receipt trace, per pid, for
+    # diagnosing hung/deadlocked rank pools.
+    trace = None
+    if os.environ.get("CGXTEST_DUMP_STACKS"):
+        import faulthandler
+
+        dump_file = open(f"/tmp/cgx_stacks_r{rank}_{os.getpid()}.txt", "w")
+        faulthandler.dump_traceback_later(
+            int(os.environ["CGXTEST_DUMP_STACKS"]), repeat=True,
+            file=dump_file,
+        )
+
+        def trace(msg):  # noqa: F811
+            with open("/tmp/cgx_pool_trace.log", "a") as f:
+                f.write(f"{os.getpid()} r{rank} ws{ws} {msg}\n")
+
     import torch.distributed as dist
     import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
     from torch_cgx_tpu import config as cgx_config
@@ -40,9 +58,12 @@ def _pool_worker(rank: int, ws: int, task_q, result_q) -> None:
         if item is None:
             return
         target_name, initfile = item
+        if trace is not None:
+            trace(f"GOT {target_name}")
         env_before = {
             k: v for k, v in os.environ.items() if k.startswith("CGX_")
         }
+        err = "task did not complete"  # overwritten by success/except
         try:
             cgx_config.clear_registry()
             dist.init_process_group(
@@ -51,18 +72,29 @@ def _pool_worker(rank: int, ws: int, task_q, result_q) -> None:
             )
             globals()[target_name](rank, ws)
             dist.barrier()
-            result_q.put((rank, None))
+            err = None
+            if trace is not None:
+                trace(f"OK {target_name}")
         except Exception:
-            result_q.put((rank, traceback.format_exc()))
+            err = traceback.format_exc()
+            if trace is not None:
+                trace(f"ERR {target_name}")
         finally:
+            # Destroy BEFORE reporting: the harness unlinks the store's
+            # backing file as soon as both results arrive, and a FileStore
+            # op on a deleted file spins for the full store timeout — the
+            # report must therefore be the LAST thing a task does.
             try:
                 dist.destroy_process_group()
             except Exception:
                 pass
+            if trace is not None:
+                trace(f"DESTROYED {target_name}")
             for k in [k for k in os.environ if k.startswith("CGX_")]:
                 if k not in env_before:
                     os.environ.pop(k)
             os.environ.update(env_before)
+            result_q.put((rank, err))
 
 
 class _RankPool:

@@ -227,13 +227,72 @@ class Checker:
         self.findings.append((node.lineno, name))
 
 
+_BOUND_MARKERS = ("deadline", "timeout")
+_POLL_CALLS = {"sleep", "wait"}
+_WAIT_SCOPED_DIRS = ("torch_backend", "robustness")
+
+
+def _const_true(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def check_unbounded_waits(path: Path, tree: ast.Module) -> list[str]:
+    """Robustness gate for the bridge transport: a bare ``while True``
+    polling loop (one that sleeps/waits between probes) must carry a
+    deadline — a name/attribute/keyword mentioning deadline/timeout — or
+    raise. An unbounded poll turns a dead peer into a hang; the hardened
+    data plane's contract is that every wait is bounded
+    (docs/ROBUSTNESS.md). Scoped to torch_backend/ and robustness/, where
+    the blocking waits live."""
+    if not any(d in path.parts for d in _WAIT_SCOPED_DIRS):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.While) or not _const_true(node.test):
+            continue
+        polls = bounded = False
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = (
+                    fn.attr
+                    if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if name in _POLL_CALLS:
+                    polls = True
+                for kw in n.keywords:
+                    if kw.arg and any(
+                        m in kw.arg.lower() for m in _BOUND_MARKERS
+                    ):
+                        bounded = True
+            elif isinstance(n, ast.Raise):
+                bounded = True
+            elif isinstance(n, ast.Name) and any(
+                m in n.id.lower() for m in _BOUND_MARKERS
+            ):
+                bounded = True
+            elif isinstance(n, ast.Attribute) and any(
+                m in n.attr.lower() for m in _BOUND_MARKERS
+            ):
+                bounded = True
+        if polls and not bounded:
+            findings.append(
+                f"{path}:{node.lineno}: unbounded wait: 'while True' "
+                "polling loop without a deadline/timeout or raise"
+            )
+    return findings
+
+
 def check_file(path: Path) -> list[str]:
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
     except SyntaxError as e:
         return [f"{path}:{e.lineno}: syntax error: {e.msg}"]
     c = Checker(path, tree)
-    return [f"{path}:{line}: undefined name '{name}'" for line, name in c.findings]
+    out = [f"{path}:{line}: undefined name '{name}'" for line, name in c.findings]
+    out.extend(check_unbounded_waits(path, tree))
+    return out
 
 
 DEFAULT_PATHS = ["torch_cgx_tpu", "examples", "tests", "tools", "bench.py",

@@ -62,6 +62,13 @@ BRIDGE_DEVICE_CODEC = "CGX_BRIDGE_DEVICE_CODEC"  # "auto" | "on" | "off"
 BRIDGE_DEVICE_MIN_NUMEL = "CGX_BRIDGE_DEVICE_MIN_NUMEL"
 SEED = "CGX_SEED"
 LOG_LEVEL = "CGX_LOG_LEVEL"
+# Robustness layer (fault harness + hardened data plane — docs/ROBUSTNESS.md):
+BRIDGE_TIMEOUT_MS = "CGX_BRIDGE_TIMEOUT_MS"  # bounded bridge waits
+WIRE_CHECKSUM = "CGX_WIRE_CHECKSUM"  # shm payload integrity check
+SHM_MAX_MB = "CGX_SHM_MAX_MB"  # arena growth cap before pressure errors
+NONFINITE_GUARD = "CGX_NONFINITE_GUARD"  # off | skip | exact
+FAULTS = "CGX_FAULTS"  # fault-injection spec (robustness/faults.py grammar)
+FAULTS_SEED = "CGX_FAULTS_SEED"
 
 # Defaults — reference values (common.h:24-41, compressor.h:32,
 # mpi_allreduce_operations.h:32).
@@ -312,6 +319,52 @@ def bridge_device_min_numel() -> int:
 
 def global_seed() -> int:
     return _env.get_int_env_or_default(SEED, 0)
+
+
+def bridge_timeout_ms() -> Optional[int]:
+    """CGX_BRIDGE_TIMEOUT_MS: deadline for every blocking bridge wait —
+    collective key waits, standalone shm takes, and the arena pressure
+    path. Unset/0 = keep the group/default timeout (300 s). A peer that
+    dies without reaching ``abort()`` surfaces as a
+    :class:`~.robustness.errors.BridgeTimeoutError` within this budget
+    instead of hanging."""
+    v = _env.get_int_env_or_default(BRIDGE_TIMEOUT_MS, 0)
+    return v if v > 0 else None
+
+
+def wire_checksum() -> bool:
+    """CGX_WIRE_CHECKSUM: carry a crc32 of every shm payload in its header
+    and verify on ``take()`` (mismatch -> one fresh re-read ->
+    :class:`~.robustness.errors.WireCorruptionError`). Default on; set 0
+    to shave the checksum cost off latency-critical benches."""
+    return _env.get_bool_env_or_default(WIRE_CHECKSUM, True)
+
+
+def shm_max_mb() -> int:
+    """CGX_SHM_MAX_MB: total arena capacity cap per writer. The
+    grow-don't-block policy stays, but growth past this cap turns into a
+    bounded backoff-and-reclaim wait, then a pressure error naming the
+    un-acked key — instead of eating tmpfs until the host OOMs under a
+    dead reader."""
+    return _env.get_int_env_or_default(SHM_MAX_MB, 1024)
+
+
+NONFINITE_POLICIES = ("off", "skip", "exact")
+
+
+def nonfinite_guard() -> str:
+    """CGX_NONFINITE_GUARD: what the train step does when any rank's
+    gradients contain NaN/Inf (detected pre-quantization, agreed globally):
+    "off" (default — legacy behavior, the NaN poisons every bucket),
+    "skip" (drop the step: params/optimizer/compressor state keep their
+    pre-step values), or "exact" (fall back to an uncompressed allreduce of
+    the sanitized gradients for that step). See docs/ROBUSTNESS.md."""
+    v = _env.get_str_env_or_default(NONFINITE_GUARD, "off").lower()
+    if v not in NONFINITE_POLICIES:
+        raise ValueError(
+            f"{NONFINITE_GUARD} must be one of {NONFINITE_POLICIES}, got {v!r}"
+        )
+    return v
 
 
 # ---------------------------------------------------------------------------

@@ -45,7 +45,9 @@ def init_distributed(
 
     # NOT jax.process_count(): that initializes the XLA backend, after which
     # jax.distributed.initialize() unconditionally raises.
-    if jax.distributed.is_initialized():
+    from ..utils.compat import distributed_is_initialized
+
+    if distributed_is_initialized():
         return True
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"

@@ -151,7 +151,7 @@ def powersgd_transform(
             # and passes False
             from .grad_sync import _warn_ef_placement_once
 
-            _warn_ef_placement_once()
+            _warn_ef_placement_once("powersgd")
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         if len(leaves) != len(state.qs):
             raise ValueError(

@@ -41,6 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..utils import compat
+
 from .. import config as cfg_mod
 from ..config import CompressionConfig, TopologyConfig
 from ..ops import codec, dispatch
@@ -673,7 +675,7 @@ def quantized_all_to_all(
     tensor is below ``CGX_COMPRESSION_MINIMAL_SIZE``.
     """
     cc = cc or cfg_mod.default_compression_config()
-    ws = lax.axis_size(axis_name)
+    ws = compat.axis_size(axis_name)
     if (
         not cc.enabled
         or cfg_mod.dummy_compression()
@@ -715,3 +717,21 @@ def quantized_all_to_all(
 
     _qa.defvjp(_fwd, _bwd)
     return _qa(x)
+
+
+def psum_tree(tree, axes, mesh=None):
+    """Exact (uncompressed) allreduce of a whole pytree over ``axes`` —
+    the REDUCTION_PSUM fallback applied tree-wide. The graceful-degradation
+    path of the non-finite gradient guard (grad_sync.py) routes a poisoned
+    step through this instead of the quantized wire: a single NaN/Inf
+    otherwise destroys every max-min bucket range it shares a chunk with.
+    Size-1 axes are skipped (a psum there is the identity but still emits
+    a collective)."""
+
+    def red(x):
+        for a in axes:
+            if mesh is None or mesh.shape[a] > 1:
+                x = lax.psum(x, a)
+        return x
+
+    return jax.tree.map(red, tree)

@@ -33,6 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
+from ..utils import compat
+
 NEG_INF = np.float32(-1e30)
 
 
@@ -75,7 +77,7 @@ def ring_attention(
     tokens. It rides the ring alongside its K/V block, so each step masks
     the arriving block's keys with the mask slice of the block's origin.
     """
-    ws = lax.axis_size(axis_name)
+    ws = compat.axis_size(axis_name)
     mask = _check_sp_mask(mask, q)
     if ws == 1:
         from ..models.attention import dense_attention
@@ -160,7 +162,7 @@ def ulysses_attention(
     """
     from ..models.attention import dense_attention
 
-    ws = lax.axis_size(axis_name)
+    ws = compat.axis_size(axis_name)
     mask = _check_sp_mask(mask, q)
     if ws == 1:
         return dense_attention(q, k, v, causal=causal, mask=mask)

@@ -67,28 +67,44 @@ def _k_for(n: int, ratio: float) -> int:
     return max(1, int(np.ceil(ratio * n)))
 
 
-def eligible(leaf, ratio: float) -> bool:
+def eligible(leaf, ratio: float, ws: int = 1) -> bool:
     """Sparsification pays off: float, above the minimal size, and the
     (index, value) pairs are smaller IN BYTES than the dense leaf — a
     pair costs 8 bytes (int32 + f32) regardless of the leaf's dtype, so
-    bf16 leaves need a smaller ratio than f32 ones to qualify."""
+    bf16 leaves need a smaller ratio than f32 ones to qualify.
+
+    ``ws`` adds the receive side of the documented traffic model (the
+    send-bytes-only gate was world-size-blind — advisor r5 low #1): the
+    all_gather delivers ``ws * k`` pairs (``8 * k * ws`` bytes) to every
+    rank, where a dense ring/SRA allreduce receives about
+    ``2 * n * itemsize * (ws - 1) / ws`` bytes — at large world sizes a
+    leaf can pass the send gate yet move MORE total traffic sparse than
+    dense, so the receive gate tightens with ``ws``."""
     if not jnp.issubdtype(leaf.dtype, jnp.floating):
         return False
     n = int(leaf.size)
     if n < cfg_mod.minimal_size():
         return False
-    return 8 * _k_for(n, ratio) < n * jnp.dtype(leaf.dtype).itemsize
+    k = _k_for(n, ratio)
+    itemsize = jnp.dtype(leaf.dtype).itemsize
+    if 8 * k >= n * itemsize:
+        return False
+    if ws > 1 and 8 * k * ws >= 2 * n * itemsize * (ws - 1) / ws:
+        return False
+    return True
 
 
-def init_topk(params, ratio: float) -> TopKState:
-    """Zero EF residuals per eligible leaf. Placement under ``jax.jit`` +
+def init_topk(params, ratio: float, ws: int = 1) -> TopKState:
+    """Zero EF residuals per eligible leaf (``ws`` feeds the
+    world-size-aware traffic gate — pass the product of the sync-axis
+    sizes so init and transform agree). Placement under ``jax.jit`` +
     ``shard_map``: give each ``es`` leaf a leading device axis sharded
     over the sync axes (the :func:`init_error_feedback` pattern) and
     strip it inside the mapped function, or use :func:`init_topk_state`."""
     return TopKState(
         es=tuple(
             jnp.zeros((leaf.size,), jnp.float32)
-            if eligible(leaf, ratio)
+            if eligible(leaf, ratio, ws)
             else None
             for leaf in jax.tree_util.tree_leaves(params)
         )
@@ -135,7 +151,7 @@ def topk_transform(
         return x
 
     def init_fn(params):
-        return init_topk(params, ratio)
+        return init_topk(params, ratio, ws)
 
     def update_fn(updates, state, params=None):
         del params
@@ -144,7 +160,7 @@ def topk_transform(
             # and passes False
             from .grad_sync import _warn_ef_placement_once
 
-            _warn_ef_placement_once()
+            _warn_ef_placement_once("topk")
         leaves, treedef = jax.tree_util.tree_flatten(updates)
         if len(leaves) != len(state.es):
             raise ValueError(
@@ -208,7 +224,7 @@ def init_topk_state(
     ws = int(np.prod([mesh.shape[a] for a in sync_axes]))
     es = tuple(
         jnp.zeros((ws, leaf.size), jnp.float32)
-        if eligible(leaf, ratio)
+        if eligible(leaf, ratio, ws)
         else None
         for leaf in jax.tree_util.tree_leaves(params)
     )

@@ -1,0 +1,38 @@
+"""Fault injection + the defenses it exercises.
+
+Three legs (see ``docs/ROBUSTNESS.md``):
+
+* :mod:`.faults` — the deterministic ``CGX_FAULTS`` injector threaded
+  through the shm channel, the torch backend, and the train step.
+* :mod:`.heartbeat` — per-rank liveness files that let a bounded wait
+  name its suspected dead peer instead of just expiring.
+* :mod:`.errors` — the failure taxonomy (:class:`BridgeTimeoutError`,
+  :class:`WireCorruptionError`), both ``RuntimeError`` subclasses.
+
+:mod:`.guard` (the JAX-side ``nan_grad`` staging) is imported lazily by
+``parallel/grad_sync`` — this package root stays importable without a
+working accelerator runtime.
+"""
+
+from .errors import BridgeTimeoutError, WireCorruptionError
+from .faults import (
+    FaultInjector,
+    FaultSpec,
+    get_injector,
+    parse_faults,
+    reset_injectors,
+)
+from .heartbeat import Heartbeat, ensure_heartbeat, suspect_dead_pids
+
+__all__ = [
+    "BridgeTimeoutError",
+    "WireCorruptionError",
+    "FaultInjector",
+    "FaultSpec",
+    "get_injector",
+    "parse_faults",
+    "reset_injectors",
+    "Heartbeat",
+    "ensure_heartbeat",
+    "suspect_dead_pids",
+]

@@ -1,0 +1,41 @@
+"""Failure taxonomy of the hardened data plane.
+
+Both exceptions subclass :class:`RuntimeError` so every existing
+``except RuntimeError`` site (and torch's Work-future plumbing, which
+re-raises worker exceptions verbatim) keeps working; catching the
+specific type is opt-in for callers that want to distinguish transport
+death from data corruption.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+class BridgeTimeoutError(RuntimeError):
+    """A bounded wait on the bridge expired: the peer a collective was
+    matched against never produced (or never acked) its payload.
+
+    ``key`` is the store/shm key the wait was parked on (or the arena
+    ack key for writer-side pressure); ``suspects`` lists ranks whose
+    liveness heartbeat was missing or stale when the deadline fired —
+    the "who is dead" half the raw hang never told you.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        key: Optional[str] = None,
+        suspects: Sequence[int] = (),
+    ):
+        super().__init__(message)
+        self.key = key
+        self.suspects = tuple(suspects)
+
+
+class WireCorruptionError(RuntimeError):
+    """A payload failed its wire checksum twice (one fresh re-read
+    included): the bytes in the shared-memory arena do not match what the
+    writer framed. Distinct from quantization error — this is transport
+    damage, and the collective's result would be garbage."""

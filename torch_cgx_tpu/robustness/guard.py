@@ -1,0 +1,80 @@
+"""JAX-side fault staging: the ``nan_grad`` injector.
+
+The wire-level faults live where the bytes move (``shm.py`` /
+``backend.py``); gradient poisoning must instead be *staged into the
+jitted train step at trace time* — the fault has to originate inside the
+compiled SPMD program, upstream of quantization, exactly where a real
+overflow/0-div NaN would. ``make_train_step`` consults
+:func:`nan_grad_spec` when it builds and, when armed, threads
+:func:`inject_nan` between the backward pass and the gradient sync. The
+non-finite *defense* this exercises is
+``parallel/grad_sync`` 's ``CGX_NONFINITE_GUARD``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.logging import metrics
+from . import faults
+
+
+def nan_grad_spec() -> Optional[faults.FaultSpec]:
+    """The armed ``nan_grad`` spec per the current env, else None. Read
+    at trace/build time — jit caches bake the decision in, like every
+    other traced config in this codebase."""
+    inj = faults.get_injector()
+    return inj.spec("nan_grad") if inj is not None else None
+
+
+def inject_nan(
+    grads,
+    step_idx,
+    axes: Sequence[str],
+    spec: faults.FaultSpec,
+):
+    """Poison the first element of the first float leaf with NaN when the
+    (traced) step index matches ``spec.step`` (and, with ``rank=``, only
+    on that position along the first sync axis). A ``prob`` spec draws a
+    per-step Bernoulli from a stream seeded by ``CGX_FAULTS_SEED`` folded
+    with the step index — deterministic replay, jit-compatible. Bit-exact
+    identity on every non-matching step: the write is a ``where``-gated
+    ``.at[].set``, no arithmetic touches the gradient."""
+    import os
+
+    leaves, treedef = jax.tree_util.tree_flatten(grads)
+    idx = next(
+        (
+            i
+            for i, l in enumerate(leaves)
+            if hasattr(l, "dtype") and jnp.issubdtype(l.dtype, jnp.floating)
+        ),
+        None,
+    )
+    if idx is None:
+        return grads
+    flag = (
+        jnp.asarray(True)
+        if spec.step is None
+        else jnp.asarray(step_idx) == spec.step
+    )
+    if spec.prob is not None:
+        seed = int(os.environ.get(faults.FAULTS_SEED_ENV, "0") or 0)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(seed), jnp.asarray(step_idx)
+        )
+        flag = jnp.logical_and(flag, jax.random.bernoulli(key, spec.prob))
+    if spec.rank is not None and axes:
+        flag = jnp.logical_and(flag, lax.axis_index(axes[0]) == spec.rank)
+    leaf = leaves[idx]
+    flat = leaf.reshape(-1)
+    flat = flat.at[0].set(
+        jnp.where(flag, jnp.asarray(jnp.nan, flat.dtype), flat[0])
+    )
+    leaves[idx] = flat.reshape(leaf.shape)
+    metrics.add("cgx.faults.nan_grad_staged")  # trace-time: armed, not fired
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -1,0 +1,132 @@
+"""Per-process liveness heartbeat files: turn hangs into *named* errors.
+
+A rank that dies without reaching ``abort()`` (SIGKILL, OOM) leaves its
+peers parked in a wait. The bounded waits (``CGX_BRIDGE_TIMEOUT_MS``)
+bound the park; this module answers the follow-up question — *who* died.
+
+Design constraints learned the hard way:
+
+* **No control-plane traffic.** Liveness must not add store round-trips
+  (an early token-rendezvous design added a blocking C++ store ``get``
+  to every group init and destabilized the bridge under the test
+  suite's rapid init/destroy cycles). Identity rides on the pid, which
+  peers already learn from the host-fingerprint exchange.
+* **Per process, not per group.** One daemon thread per (process,
+  directory) touches ``cgx-hb-p<pid>``; every group in the process
+  shares it. The *mtime* is the signal — nothing has to be released on
+  death, it simply stops advancing, which is exactly the property a
+  SIGKILL'd rank needs. Pid reuse is benign: a recycled pid's new
+  owner keeps the same file alive, which is the correct per-pid answer.
+
+``suspect_dead_pids`` judges a set of peer pids; stale files past
+``reap_s`` are unlinked opportunistically so dead processes' 4-byte
+files don't accumulate in tmpfs forever.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Tuple
+
+_HB_PREFIX = "cgx-hb-p"
+
+DEFAULT_INTERVAL_S = 0.5
+DEFAULT_STALE_S = 2.0
+_REAP_S = 3600.0
+
+
+def heartbeat_path(directory: str, pid: int) -> str:
+    return os.path.join(directory, f"{_HB_PREFIX}{pid}")
+
+
+class Heartbeat:
+    """Daemon thread touching one liveness file (internal; use
+    :func:`ensure_heartbeat`)."""
+
+    def __init__(
+        self,
+        directory: str,
+        pid: int,
+        interval_s: float = DEFAULT_INTERVAL_S,
+    ):
+        self._path = heartbeat_path(directory, pid)
+        self._interval = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def start(self) -> "Heartbeat":
+        self._touch()
+        self._thread = threading.Thread(
+            target=self._run, name="cgx-heartbeat", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _touch(self) -> None:
+        try:
+            with open(self._path, "w") as f:
+                f.write(str(os.getpid()))
+        except OSError:
+            pass  # liveness is best-effort; never fail the data plane
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            self._touch()
+
+    def stop(self, unlink: bool = True) -> None:
+        self._stop.set()
+        if unlink:
+            try:
+                os.unlink(self._path)
+            except OSError:
+                pass
+
+
+_singletons: Dict[Tuple[str, int], Heartbeat] = {}
+_singleton_lock = threading.Lock()
+
+
+def ensure_heartbeat(directory: str) -> Heartbeat:
+    """This process's heartbeat for ``directory`` (started on first use).
+    Idempotent and shared by every process group in the process — group
+    teardown must NOT stop it (another group may still rely on it); it
+    dies with the process, which is the point."""
+    key = (directory, os.getpid())
+    with _singleton_lock:
+        hb = _singletons.get(key)
+        if hb is None:
+            hb = Heartbeat(directory, os.getpid()).start()
+            _singletons[key] = hb
+        return hb
+
+
+def suspect_dead_pids(
+    directory: str,
+    pids: Iterable[int],
+    stale_s: float = DEFAULT_STALE_S,
+) -> List[int]:
+    """Pids whose heartbeat file is missing or older than ``stale_s``.
+    Also reaps heartbeat litter older than an hour (crash leftovers)."""
+    now = time.time()
+    out = []
+    for pid in pids:
+        path = heartbeat_path(directory, pid)
+        try:
+            st = os.stat(path)
+        except OSError:
+            out.append(pid)
+            continue
+        if now - st.st_mtime > stale_s:
+            out.append(pid)
+            if now - st.st_mtime > _REAP_S:
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+    return sorted(set(out))

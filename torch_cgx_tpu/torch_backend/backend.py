@@ -53,6 +53,7 @@ path and vice versa.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 import queue as _queue
 from concurrent.futures import ThreadPoolExecutor
@@ -65,7 +66,10 @@ from torch.futures import Future
 
 from .. import config as cfg
 from ..ops import codec_host as hcodec
-from ..utils.logging import get_logger
+from ..robustness import faults as faults_mod
+from ..robustness import heartbeat as hb_mod
+from ..robustness.errors import BridgeTimeoutError
+from ..utils.logging import get_logger, metrics
 
 log = get_logger()
 
@@ -433,6 +437,13 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._timeout_s = 300.0
         if self._timeout_s <= 0:
             self._timeout_s = 300.0
+        # CGX_BRIDGE_TIMEOUT_MS wins over the group timeout when set: one
+        # knob bounds every bridge wait (docs/ROBUSTNESS.md).
+        bt = cfg.bridge_timeout_ms()
+        if bt:
+            self._timeout_s = bt / 1000.0
+        self._injector = faults_mod.get_injector(rank)
+        self._pid_by_rank: List[int] = []
         self._seq = 0  # collective sequence number (issued on calling thread)
         self._p2p_send = {}  # (dst, tag) -> count
         self._p2p_recv = {}  # (src, tag) -> count
@@ -483,14 +494,35 @@ class ProcessGroupCGX(dist.ProcessGroup):
         from . import shm as shm_mod
 
         fp = shm_mod.host_fingerprint()
-        self._store.set(f"cgxshm/h{self._rank}", fp.encode())
-        hosts = [
+        # Piggyback this rank's pid on the host-key exchange: peers need
+        # it to resolve the per-process liveness heartbeat file — no
+        # extra store round-trips (an init-time rendezvous here proved
+        # destabilizing under rapid group churn).
+        self._store.set(
+            f"cgxshm/h{self._rank}", f"{fp}|{os.getpid()}".encode()
+        )
+        raw = [
             bytes(self._store.get(f"cgxshm/h{j}")).decode()
             for j in range(self._size)
         ]
+        hosts, pids = [], []
+        for v in raw:
+            h, _, p = v.rpartition("|")
+            hosts.append(h)
+            pids.append(int(p) if p.isdigit() else -1)
         self._host_by_rank = hosts
+        self._pid_by_rank = pids
         self._local_ranks = [j for j, h in enumerate(hosts) if h == fp]
         if len(self._local_ranks) > 1:
+            # Per-process liveness file (robustness/heartbeat.py): lets a
+            # bounded wait NAME a SIGKILL'd same-host peer instead of only
+            # suspecting one. Process-wide singleton — survives group
+            # churn, dies with the process.
+            try:
+                hb_mod.ensure_heartbeat(shm_mod.default_dir())
+            except Exception as e:
+                log.warning("cgx heartbeat setup failed (%s); timeout "
+                            "errors will not name dead peers", e)
             # Channel creation must be GROUP-COORDINATED within the local
             # group: routing is computed independently on each rank, so one
             # rank degrading to the store while a local peer keeps SHM
@@ -565,6 +597,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 continue
             fn, fut, result = item
             try:
+                if self._injector is not None:
+                    # kill_rank fault: die mid-collective the way SIGKILL
+                    # does (no abort poison, no atexit) — each dequeued
+                    # work entry is one step of the injector's counter.
+                    self._injector.maybe_kill()
                 if self._aborted:
                     self._raise_abort()
                 fn()
@@ -674,10 +711,42 @@ class ProcessGroupCGX(dist.ProcessGroup):
             # forever (MPI ANY_SOURCE semantics) — only abort/shutdown
             # break it out.
             if bounded and _time.monotonic() > deadline:
-                raise RuntimeError(
-                    f"cgx: timed out after {self._timeout_s:.0f}s waiting "
-                    f"for {key!r} (peer dead or stalled?)"
+                suspects = self._suspect_dead_peers()
+                extra = (
+                    f"; suspected dead peer rank(s): {suspects}"
+                    if suspects
+                    else ""
                 )
+                metrics.add("cgx.bridge_timeout")
+                raise BridgeTimeoutError(
+                    f"cgx: timed out after {self._timeout_s:.0f}s waiting "
+                    f"for {key!r} (peer dead or stalled?){extra}",
+                    key=key,
+                    suspects=suspects,
+                )
+
+    def _suspect_dead_peers(self) -> List[int]:
+        """Same-host peers whose liveness heartbeat is missing/stale —
+        best-effort attribution for a timeout (cross-host peers have no
+        heartbeat file here and stay un-named)."""
+        if not self._pid_by_rank or len(self._local_ranks) < 2:
+            return []
+        try:
+            from . import shm as shm_mod
+
+            peers = [r for r in self._local_ranks if r != self._rank]
+            dead = set(
+                hb_mod.suspect_dead_pids(
+                    shm_mod.default_dir(),
+                    [self._pid_by_rank[r] for r in peers],
+                )
+            )
+            suspects = [r for r in peers if self._pid_by_rank[r] in dead]
+            if suspects:
+                metrics.add("cgx.heartbeat_stale", float(len(suspects)))
+            return suspects
+        except Exception:
+            return []
 
     def abort(self, reason: str = "") -> None:
         """Poison the group: peers blocked in any collective fail fast, and
@@ -715,6 +784,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if self._route_shm(local):
             self._shm.put(key, data, readers=readers)
             return
+        if self._injector is not None and self._injector.fire("drop_put"):
+            return  # store-path drop: the matching take's wait expires
         self._store.set(key, bytes(data) if not isinstance(data, bytes) else data)
 
     def _delete_key(self, key: str) -> None:
@@ -745,6 +816,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if self._route_shm(local):
             return self._shm.take(key)
         self._wait_key(key)
+        if self._injector is not None:
+            self._injector.delay("delay_take")
         data = self._store.get(key)
         if readers <= 1:
             self._delete_key(key)
@@ -1778,7 +1851,30 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def shutdown(self) -> None:
         self._shutdown.set()
         self._p2p_pool.shutdown(wait=False)
-        self._gc_announce_tickets()
+        # Announce-ticket GC is best-effort housekeeping on a store that
+        # is being torn down — run it on a bounded leash. A c10d FileStore
+        # whose backing file is already gone makes EVERY non-creating op
+        # (check/get/deleteKey) spin in its open-retry loop for the full
+        # store timeout (~30 min); hit mid-GC, that turned this rank's
+        # destroy_process_group into a silent half-hour hang (found by the
+        # fault harness's pool chaos runs). Shutdown must stay bounded —
+        # the same contract the data plane now honors everywhere.
+        gc = threading.Thread(
+            target=self._gc_announce_tickets,
+            name="cgx-shutdown-gc",
+            daemon=True,
+        )
+        gc.start()
+        gc.join(timeout=5.0)
+        if gc.is_alive():
+            log.warning(
+                "cgx shutdown: announce-ticket GC still running after 5s "
+                "(store backing gone?); abandoning it — keys may persist"
+            )
+            metrics.add("cgx.shutdown_gc_abandoned")
+        # NOTE: the process heartbeat is deliberately NOT stopped here —
+        # it is process-scoped (other live groups share it) and dies with
+        # the process.
         if self._shm is not None:
             self._shm.close()
             self._shm = None

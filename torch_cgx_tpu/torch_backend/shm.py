@@ -22,7 +22,8 @@ bytes ride mmap'd files under ``/dev/shm``:
   of blocking — a put can therefore never deadlock against a slow reader;
   drained generations are unlinked.
 * :class:`ShmChannel` — put/take with Store-get semantics: ``put`` copies
-  the payload into the arena and publishes a 24-byte header under the
+  the payload into the arena and publishes a small text header (path,
+  generation, offset, size, crc32) under the
   message key; ``take`` resolves the header, maps the writer's file
   (attachments are cached per path), copies the payload out and bumps the
   ack counter. One memcpy per side versus the Store's
@@ -34,6 +35,15 @@ share ``/dev/shm``). ``CGX_SHM_HOST_ID`` overrides the fingerprint — the
 test hook that simulates a multi-host topology on one box, and an escape
 hatch for containers that share hostname+boot_id but not ``/dev/shm``
 (set distinct ids to force the Store path).
+
+Hardened data plane (docs/ROBUSTNESS.md): every payload header carries a
+crc32 verified on ``take`` (one fresh re-read, then
+:class:`WireCorruptionError`), standalone takes are bounded by
+``CGX_BRIDGE_TIMEOUT_MS`` (:class:`BridgeTimeoutError` naming the key and
+any stale heartbeat), arena growth is capped by ``CGX_SHM_MAX_MB`` with a
+backoff-and-reclaim pressure path, and the ``CGX_FAULTS`` injector
+(``robustness/faults.py``) can drop puts, delay takes, corrupt payloads
+and stall acks deterministically to rehearse all of the above.
 """
 
 from __future__ import annotations
@@ -44,16 +54,45 @@ import os
 import re
 import socket
 import threading
+import time
 import uuid
+import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..utils.logging import get_logger
+from .. import config as cfg
+from ..robustness import faults as faults_mod
+from ..robustness.errors import BridgeTimeoutError, WireCorruptionError
+from ..utils.logging import get_logger, metrics
 
 log = get_logger()
 
 _ALIGN = 64  # region alignment (cache line)
+
+# Wire checksum cost model: full crc32 runs ~0.8 GB/s in this container —
+# free for codec frames (a 4-bit chunk of a 3M-float bucket is ~1.6 MB,
+# ~2 ms) but ~80 ms per side on a jumbo 64 MB raw broadcast, which would
+# hand back much of the plane's win over the store. Above _CRC_FULL_MAX
+# the checksum covers a deterministic sample (length + head + middle +
+# tail slices) at constant cost — still catching truncation, offset/gen
+# mixups and corruption in the sampled spans.
+_CRC_FULL_MAX = 4 << 20
+_CRC_SAMPLE = 256 << 10
+
+
+def _wire_checksum(buf) -> int:
+    """crc32 of the payload (full below _CRC_FULL_MAX, sampled above).
+    Writer and reader must agree byte-for-byte, so both sides call this."""
+    n = len(buf)
+    if n <= _CRC_FULL_MAX:
+        return zlib.crc32(buf)
+    c = n // 2
+    crc = zlib.crc32(n.to_bytes(8, "little"))
+    crc = zlib.crc32(buf[:_CRC_SAMPLE], crc)
+    crc = zlib.crc32(buf[c - _CRC_SAMPLE // 2 : c + _CRC_SAMPLE // 2], crc)
+    crc = zlib.crc32(buf[n - _CRC_SAMPLE :], crc)
+    return crc
 
 
 def host_fingerprint() -> str:
@@ -191,7 +230,11 @@ class _GenFile:
 
 
 class ShmArena:
-    """Writer-owned payload ring (grow-don't-block reclaim policy)."""
+    """Writer-owned payload ring (grow-don't-block reclaim policy, capped
+    at ``max_bytes`` total — past the cap, writes enter a bounded
+    backoff-and-reclaim pressure wait instead of growing forever under a
+    dead reader, and expire with :class:`BridgeTimeoutError` naming the
+    oldest un-acked key)."""
 
     def __init__(
         self,
@@ -200,6 +243,8 @@ class ShmArena:
         poll_ack: Callable[[str], int],
         drop_keys: Callable[[List[str]], None],
         min_capacity: int = 1 << 23,  # 8 MB
+        max_bytes: Optional[int] = None,
+        pressure_timeout_s: Optional[float] = None,
     ):
         self._dir = directory
         self._name = name
@@ -209,6 +254,15 @@ class ShmArena:
         self._gen = 0
         self._pending: List[_Region] = []  # allocation order
         self._lock = threading.Lock()
+        self._max_bytes = (
+            max_bytes if max_bytes is not None else cfg.shm_max_mb() << 20
+        )
+        bt = cfg.bridge_timeout_ms()
+        self._pressure_timeout_s = (
+            pressure_timeout_s
+            if pressure_timeout_s is not None
+            else (bt / 1000.0 if bt else 60.0)
+        )
         self._new_gen(min_capacity)
 
     def path_of(self, gen: int) -> str:
@@ -290,27 +344,72 @@ class ShmArena:
 
     def write(self, data, ack_key: str, readers: int) -> Tuple[int, int, int]:
         """Copy ``data`` (any C-contiguous buffer) into the ring; returns
-        (gen, offset, size) for the header. Never blocks: grows a new
-        generation when the ring is full."""
+        (gen, offset, size) for the header. Grows a new generation when the
+        ring is full — up to ``max_bytes`` total, past which the write
+        backs off (exponential, lock released) polling acks, and finally
+        raises :class:`BridgeTimeoutError` naming the stalled key."""
         data = memoryview(data).cast("B")
         size = max(_round_up(len(data), _ALIGN), _ALIGN)
-        with self._lock:
-            off = self._try_alloc(size)
-            if off < 0:
-                # Pressure path only: poll acks, then retry once.
-                self._reclaim()
+        if size > self._max_bytes:
+            raise RuntimeError(
+                f"cgx shm: payload of {size} bytes exceeds the arena cap "
+                f"({self._max_bytes} bytes); raise CGX_SHM_MAX_MB"
+            )
+        deadline = None
+        backoff = 0.001
+        while True:
+            with self._lock:
                 off = self._try_alloc(size)
-            if off < 0:
-                self._new_gen(max(2 * self._gens[self._gen].capacity, 4 * size))
-                gf = self._gens[self._gen]
-                off = 0
-                gf.head = size % gf.capacity
-                gf.live += size
-            gen = self._gen
-            gf = self._gens[gen]
-            gf.mm[off : off + len(data)] = data
-            self._pending.append(_Region(gen, off, size, ack_key, readers))
-            return gen, off, len(data)
+                if off < 0:
+                    # Pressure path only: poll acks, then retry.
+                    self._reclaim()
+                    off = self._try_alloc(size)
+                if off < 0:
+                    total = sum(gf.capacity for gf in self._gens.values())
+                    want = max(2 * self._gens[self._gen].capacity, 4 * size)
+                    if total + want > self._max_bytes:
+                        want = size  # minimal growth under the cap
+                    if total + want <= self._max_bytes:
+                        self._new_gen(want)
+                        gf = self._gens[self._gen]
+                        off = 0
+                        gf.head = size % gf.capacity
+                        gf.live += size
+                if off >= 0:
+                    gen = self._gen
+                    gf = self._gens[gen]
+                    gf.mm[off : off + len(data)] = data
+                    self._pending.append(
+                        _Region(gen, off, size, ack_key, readers)
+                    )
+                    return gen, off, len(data)
+                stalled = next(
+                    (r for r in self._pending if not r.freed and r.ack_key),
+                    None,
+                )
+            # Over the capacity cap with nothing reclaimable: bounded
+            # pressure wait (outside the lock — takers may be acking).
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + self._pressure_timeout_s
+            if now >= deadline:
+                detail = (
+                    f"oldest un-acked key {stalled.ack_key!r} "
+                    f"({self._poll_ack(stalled.ack_key)}/{stalled.readers} "
+                    "acks)"
+                    if stalled is not None
+                    else "no pending regions (cap too small for burst?)"
+                )
+                metrics.add("cgx.bridge_timeout")
+                raise BridgeTimeoutError(
+                    f"cgx shm: arena at its {self._max_bytes >> 20} MB cap "
+                    f"for {self._pressure_timeout_s:.1f}s and readers are "
+                    f"not draining — {detail}; a reader is dead or stalled",
+                    key=stalled.ack_key if stalled is not None else None,
+                )
+            metrics.add("cgx.arena_pressure_waits")
+            time.sleep(min(backoff, deadline - now if deadline > now else 0))
+            backoff = min(backoff * 2, 0.2)
 
     def close(self) -> None:
         with self._lock:
@@ -345,6 +444,10 @@ class ShmChannel:
         # (SIGKILL/OOM — close() never fires there).
         _reap_dead_arenas(self._dir)
         name = f"cgx-{uuid.uuid4().hex[:12]}-p{os.getpid()}-r{rank}"
+        self._injector = faults_mod.get_injector(rank)
+        self._checksum = cfg.wire_checksum()
+        bt = cfg.bridge_timeout_ms()
+        self._timeout_s = bt / 1000.0 if bt else 300.0
         self._arena = ShmArena(
             self._dir, name, self._ack_count, self._drop_keys
         )
@@ -361,6 +464,8 @@ class ShmChannel:
     # -- store helpers ----------------------------------------------------
 
     def _ack_count(self, ack_key: str) -> int:
+        if self._injector is not None and self._injector.fire("stall_ack"):
+            return 0  # simulated dead reader: acks never observed
         try:
             return int(self._store.add(ack_key, 0))
         except Exception:
@@ -379,11 +484,27 @@ class ShmChannel:
 
     def put(self, key: str, data, readers: int = 1) -> None:
         """``data``: bytes or any C-contiguous buffer (uint8 ndarray views
-        included — one memcpy into the arena, no staging copy)."""
+        included — one memcpy into the arena, no staging copy). The header
+        carries a crc32 of the payload (``CGX_WIRE_CHECKSUM``, -1 when
+        disabled) that ``take`` verifies."""
         hkey = self.HDR + key
-        gen, off, size = self._arena.write(data, hkey + "/ack", readers)
+        mv = memoryview(data).cast("B")
+        crc = _wire_checksum(mv) if self._checksum else -1
+        inj = self._injector
+        # len check FIRST: an empty payload is not a corruptible event —
+        # firing on it would advance the injector's counter and report a
+        # fault that never exercised the verify-on-take defense.
+        if inj is not None and len(mv) and inj.fire("corrupt_wire"):
+            # Damage the bytes AFTER the checksum: models tmpfs/DMA
+            # corruption the verify-on-take defense exists to catch.
+            buf = bytearray(mv)
+            buf[len(buf) // 2] ^= 0xFF
+            mv = memoryview(buf)
+        gen, off, size = self._arena.write(mv, hkey + "/ack", readers)
+        if inj is not None and inj.fire("drop_put"):
+            return  # header never published: the reader's bounded wait fires
         path = self._arena.path_of(gen)
-        self._store.set(hkey, f"{path}:{gen}:{off}:{size}".encode())
+        self._store.set(hkey, f"{path}:{gen}:{off}:{size}:{crc}".encode())
         with self._attach_lock:  # worker + p2p pool threads share us
             self.n_puts += 1
 
@@ -391,14 +512,78 @@ class ShmChannel:
         hkey = self.HDR + key
         if self._wait_key is not None:
             self._wait_key(hkey)
-        hdr = bytes(self._store.get(hkey)).decode()
-        path, _gen, off_s, size_s = hdr.rsplit(":", 3)
-        off, size = int(off_s), int(size_s)
+            hdr_raw = self._store.get(hkey)
+        else:
+            # Standalone channel (no group wait): bounded header wait.
+            hdr_raw = self._bounded_get(hkey)
+        hdr = bytes(hdr_raw).decode()
+        path, _gen, off_s, size_s, crc_s = hdr.rsplit(":", 4)
+        off, size, crc = int(off_s), int(size_s), int(crc_s)
+        if self._injector is not None:
+            self._injector.delay("delay_take")
         out = self._read(path, off, size)
+        if crc >= 0:
+            got = _wire_checksum(out)
+            if got != crc:
+                metrics.add("cgx.wire_corrupt")
+                log.warning(
+                    "cgx shm: checksum mismatch for %r (want %08x got %08x);"
+                    " re-reading once with a fresh mapping", key, crc, got,
+                )
+                out = self._read(path, off, size, refresh=True)
+                if _wire_checksum(out) != crc:
+                    raise WireCorruptionError(
+                        f"cgx shm: payload checksum mismatch for {key!r} "
+                        f"after one re-read ({path}:{off}+{size}) — the "
+                        "wire payload is corrupted"
+                    )
+                metrics.add("cgx.wire_reread_ok")
         self._store.add(hkey + "/ack", 1)
         with self._attach_lock:
             self.n_takes += 1
         return out
+
+    def _bounded_get(self, hkey: str) -> bytes:
+        """Header fetch bounded by ``CGX_BRIDGE_TIMEOUT_MS``, then
+        :class:`BridgeTimeoutError` naming the key (a hang becomes an
+        actionable error).
+
+        Real c10d stores park *inside* a bare ``get`` for the store's own
+        timeout, which would let that timeout trump ours — so when the
+        store supports ``wait(keys, timeout)`` the park happens in 200 ms
+        slices with our deadline checked between them; stores without
+        ``wait`` (test doubles) are polled with exponential backoff."""
+        import datetime as _dt
+
+        deadline = time.monotonic() + self._timeout_s
+        backoff = 0.0005
+        slice_ = _dt.timedelta(milliseconds=200)
+        can_wait: Optional[bool] = None
+        while True:
+            if can_wait is not False:
+                try:
+                    self._store.wait([hkey], slice_)
+                    return self._store.get(hkey)
+                except (NotImplementedError, AttributeError, TypeError):
+                    can_wait = False  # store double without wait support
+                except Exception:
+                    can_wait = True  # a real wait that timed out its slice
+            else:
+                try:
+                    return self._store.get(hkey)
+                except Exception:
+                    pass
+            if time.monotonic() >= deadline:
+                metrics.add("cgx.bridge_timeout")
+                raise BridgeTimeoutError(
+                    f"cgx shm: timed out after {self._timeout_s:.1f}s "
+                    f"waiting for {hkey!r} (writer dead, or its put "
+                    "dropped?)",
+                    key=hkey,
+                )
+            if can_wait is False:
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 0.05)
 
     @staticmethod
     def _split_gen(path: str) -> Tuple[str, int]:
@@ -406,13 +591,21 @@ class ShmChannel:
         prefix, g = path.rsplit("-g", 1)
         return prefix, int(g)
 
-    def _read(self, path: str, off: int, size: int) -> np.ndarray:
+    def _read(
+        self, path: str, off: int, size: int, refresh: bool = False
+    ) -> np.ndarray:
         """Copy a payload out of a writer's arena file. The copy runs under
         the attach lock so generation eviction can never close a map that a
         concurrent take is still reading (the memcpy is fast; only this
-        process's own reader threads serialize)."""
+        process's own reader threads serialize). ``refresh`` drops any
+        cached mapping first — the checksum retry path, which must rule out
+        a stale map before declaring the payload corrupt."""
         with self._attach_lock:
             mm = self._attached.get(path)
+            if refresh and mm is not None:
+                mm.close()
+                del self._attached[path]
+                mm = None
             if mm is None:
                 try:
                     fd = os.open(path, os.O_RDONLY)

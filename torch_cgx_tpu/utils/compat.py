@@ -1,0 +1,90 @@
+"""JAX version compatibility shims.
+
+The codebase targets the modern surface (``jax.shard_map`` with
+``axis_names``/``check_vma``, ``jax.set_mesh``,
+``jax.distributed.is_initialized``); older runtimes (<= 0.4.x) expose the
+same machinery under ``jax.experimental.shard_map`` /
+``jax.sharding``-era names with different keyword spellings. Routing every
+call site through this module keeps the robustness/chaos suite runnable on
+both — a wedged-container debug session should not also be a jax-upgrade
+session.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax
+
+
+def shard_map(
+    f,
+    *,
+    mesh,
+    in_specs,
+    out_specs,
+    axis_names=None,
+    check_vma: Optional[bool] = None,
+    check_rep: Optional[bool] = None,
+):
+    """``jax.shard_map`` when available, else the
+    ``jax.experimental.shard_map`` spelling with keywords translated:
+    ``check_vma`` -> ``check_rep`` and ``axis_names`` -> the complementary
+    ``auto`` set (old shard_map names the *non*-manual axes)."""
+    if hasattr(jax, "shard_map"):
+        kw = {}
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        if check_vma is not None or check_rep is not None:
+            kw["check_vma"] = check_vma if check_vma is not None else check_rep
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    kw = {}
+    if check_vma is not None or check_rep is not None:
+        kw["check_rep"] = check_vma if check_vma is not None else check_rep
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kw["auto"] = auto
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )
+
+
+def set_mesh(mesh):
+    """Context manager installing ``mesh`` as the ambient mesh:
+    ``jax.set_mesh`` / ``jax.sharding.use_mesh`` when present, else the
+    legacy ``with mesh:`` context (old global-mesh semantics)."""
+    if hasattr(jax, "set_mesh"):
+        return jax.set_mesh(mesh)
+    use_mesh = getattr(jax.sharding, "use_mesh", None)
+    if use_mesh is not None:
+        return use_mesh(mesh)
+    return contextlib.nullcontext(mesh) if mesh is None else mesh
+
+
+def distributed_is_initialized() -> bool:
+    """``jax.distributed.is_initialized`` with a state-probe fallback for
+    runtimes that predate the accessor."""
+    probe = getattr(jax.distributed, "is_initialized", None)
+    if probe is not None:
+        return bool(probe())
+    try:
+        from jax._src.distributed import global_state
+
+        return global_state.client is not None
+    except Exception:
+        return False
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` (static mapped-axis extent inside shard_map)
+    with the classic ``psum(1, axis)`` constant-fold fallback for runtimes
+    that predate the accessor."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)

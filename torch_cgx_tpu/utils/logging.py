@@ -49,9 +49,18 @@ class Metrics:
         with self._lock:
             return self._counters.get(name, 0.0)
 
-    def snapshot(self) -> Dict[str, float]:
+    def snapshot(self, prefix: str = "") -> Dict[str, float]:
+        """All counters, optionally filtered by name prefix — e.g.
+        ``metrics.snapshot("cgx.faults.")`` for the fault-injection tally
+        or ``metrics.snapshot("cgx.wire")`` for wire-integrity events."""
         with self._lock:
-            return dict(self._counters)
+            if not prefix:
+                return dict(self._counters)
+            return {
+                k: v
+                for k, v in self._counters.items()
+                if k.startswith(prefix)
+            }
 
     def reset(self) -> None:
         with self._lock:
