@@ -70,6 +70,18 @@ def log_jsonl(record: dict) -> None:
     round's evidence because nothing persisted per-variant results)."""
     rec = dict(record)
     rec.setdefault("ts", time.strftime("%Y-%m-%dT%H:%M:%S"))
+    # Counter context rides along with every perf row: which paths ran,
+    # how many elements traveled compressed vs raw, any faults — the BENCH
+    # trajectory is then diffable against the registry, not just wall
+    # clock. Never let the snapshot break (or bloat) the record itself.
+    try:
+        from torch_cgx_tpu.utils.logging import metrics as _metrics
+
+        snap = _metrics.snapshot()
+        if snap and "metrics" not in rec:
+            rec["metrics"] = snap
+    except Exception:
+        pass
     # NOT setdefault: its default argument evaluates eagerly, which would
     # probe jax.devices() even when the caller pre-filled the keys (the
     # watchdog must never touch the backend).

@@ -334,12 +334,13 @@ def test_heartbeat_process_singleton(tmp_path):
 # ---------------------------------------------------------------------------
 
 
-def _kill_rank_main(rank: int, ws: int, initfile: str, q) -> None:
+def _kill_rank_main(rank: int, ws: int, initfile: str, mdir: str, q) -> None:
     try:
         os.environ["JAX_PLATFORMS"] = "cpu"
         sys.path.insert(0, _REPO)
         os.environ["CGX_BRIDGE_TIMEOUT_MS"] = "6000"
         os.environ["CGX_FAULTS"] = "kill_rank:1@step=0"
+        os.environ["CGX_METRICS_DIR"] = mdir  # acceptance: black-box dump
         os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
         import torch
         import torch.distributed as dist
@@ -374,14 +375,21 @@ def _kill_rank_main(rank: int, ws: int, initfile: str, q) -> None:
 
 
 @pytest.mark.torch_bridge
-def test_kill_rank_produces_named_timeout():
+def test_kill_rank_produces_named_timeout(tmp_path):
     """A SIGKILL-style peer death mid-collective surfaces on the survivor
-    as BridgeTimeoutError naming rank 1, within CGX_BRIDGE_TIMEOUT_MS."""
+    as BridgeTimeoutError naming rank 1, within CGX_BRIDGE_TIMEOUT_MS —
+    and (ISSUE 2 acceptance) with CGX_METRICS_DIR set the survivor leaves
+    a flight-recorder dump identifying the failed collective and the
+    suspected dead rank, which tools/cgx_report.py renders."""
+    import json
+    import subprocess
+
+    mdir = str(tmp_path / "metrics")
     initfile = tempfile.mktemp(prefix="cgx_faults_store_")
     ctx = mp.get_context("spawn")
     q = ctx.Queue()
     procs = [
-        ctx.Process(target=_kill_rank_main, args=(r, 2, initfile, q))
+        ctx.Process(target=_kill_rank_main, args=(r, 2, initfile, mdir, q))
         for r in range(2)
     ]
     for p in procs:
@@ -398,6 +406,40 @@ def test_kill_rank_produces_named_timeout():
     assert procs[1].exitcode == KILL_EXIT_CODE, procs[1].exitcode
     if os.path.exists(initfile):
         os.unlink(initfile)
+    # -- flight-recorder acceptance: the evidence survived the failure --
+    path = os.path.join(mdir, "flightrec-rank0.jsonl")
+    assert os.path.exists(path), (
+        os.listdir(mdir) if os.path.isdir(mdir) else "no metrics dir"
+    )
+    lines = [json.loads(line) for line in open(path)]
+    assert lines[0]["kind"] == "dump"
+    failures = [e for e in lines[1:] if e["kind"] == "failure"]
+    assert failures, "no failure event in the survivor's dump"
+    assert any(f["error"] == "BridgeTimeoutError" for f in failures)
+    # the failed collective is named...
+    assert any(f.get("op") == "allreduce" for f in failures)
+    # ...and so is the suspected dead peer
+    assert any(1 in (f.get("suspects") or []) for f in failures)
+    # the report CLI renders the chaos dir without error (text + json)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "cgx_report.py"), mdir],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    # (kill_rank itself fired on the DEAD rank — an os._exit leaves no
+    # dump, by design; the survivor's evidence is the named timeout.)
+    assert "BridgeTimeoutError" in proc.stdout
+    assert "suspected dead" in proc.stdout
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "cgx_report.py"),
+         mdir, "--json"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0
+    js = json.loads(proc.stdout)
+    assert js["failures"]
+    assert any(f.get("op") == "allreduce" for f in js["failures"])
+    assert any(1 in (f.get("suspects") or []) for f in js["failures"])
 
 
 # ---------------------------------------------------------------------------

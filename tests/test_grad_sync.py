@@ -640,7 +640,7 @@ def test_runtime_wire_metrics(monkeypatch):
     )
     metrics.reset()
     jax.block_until_ready(fn(g))
-    after_one = metrics.get("runtime.allreduce.compressed_elems")
+    after_one = metrics.get("cgx.runtime.allreduce.compressed_elems")
     assert after_one > 0 and after_one % g.size == 0
     per_step = after_one
     for _ in range(2):
@@ -654,15 +654,15 @@ def test_runtime_wire_metrics(monkeypatch):
 
     deadline = _time.time() + 60
     while (
-        metrics.get("runtime.allreduce.compressed_elems") < 3 * per_step
+        metrics.get("cgx.runtime.allreduce.compressed_elems") < 3 * per_step
         and _time.time() < deadline
     ):
         _time.sleep(0.05)
-    total = metrics.get("runtime.allreduce.compressed_elems")
+    total = metrics.get("cgx.runtime.allreduce.compressed_elems")
     assert total == 3 * per_step, (
         f"runtime counter {total} != expected {3 * per_step} "
         f"(per_step={per_step}) after effects_barrier + 60 s poll — "
         "a lost io_callback delivery or an over-count"
     )
     # trace counter stays at one program's worth
-    assert metrics.get("trace.allreduce.compressed_elems") == g.size
+    assert metrics.get("cgx.trace.allreduce.compressed_elems") == g.size

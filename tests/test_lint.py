@@ -97,3 +97,55 @@ def test_linter_wait_gate_scoped_to_transport_dirs(tmp_path):
     )
     proc = _run_lint(other)
     assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_flags_bare_print_in_library(tmp_path):
+    # Observability satellite (ISSUE 2): printf-only observability is the
+    # reference gap this codebase closes — a bare print() in library code
+    # bypasses leveled logging AND the metrics pipeline, so it fails lint.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text("def f(x):\n    print(x)\n    return x\n")
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "bare print()" in proc.stdout
+
+
+def test_linter_print_gate_scoped_to_library(tmp_path):
+    # tools/tests/examples may print freely (CLIs are supposed to).
+    ok = tmp_path / "cli.py"
+    ok.write_text("def f(x):\n    print(x)\n    return x\n")
+    proc = _run_lint(ok)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_flags_offnamespace_metric_name(tmp_path):
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from .utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('my_counter')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "outside the documented namespaces" in proc.stdout
+
+
+def test_linter_accepts_namespaced_metrics_and_fstrings(tmp_path):
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "good.py"
+    good.write_text(
+        "from .utils.logging import metrics\n"
+        "def f(mode, dur, store, key):\n"
+        "    metrics.add('cgx.faults.total')\n"
+        "    metrics.add(f'cgx.faults.{mode}')\n"
+        "    metrics.observe(f'span.{mode}', dur)\n"
+        "    metrics.set('cgx.arena_bytes', 1.0)\n"
+        "    store.add(key, 1)\n"  # not the registry: no namespace rule
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout

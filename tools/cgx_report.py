@@ -1,0 +1,274 @@
+#!/usr/bin/env python3
+"""Render a human-readable summary of a CGX_METRICS_DIR.
+
+Reads whatever the observability layer left behind —
+``flightrec-rank*.jsonl`` (flight-recorder dumps), ``metrics-rank*.jsonl``
+(periodic exporter), ``cluster-report.jsonl`` (leader merges) — and
+prints the operator's view: top collectives by time, compression ratios,
+fault/corruption tallies, and the failure timeline per rank. Stdlib
+only; tolerant of partial/missing files (a chaos run's whole point is
+that some rank died mid-write).
+
+    python tools/cgx_report.py [dir]          # default: $CGX_METRICS_DIR
+    python tools/cgx_report.py [dir] --json   # machine-readable summary
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import Counter, defaultdict
+from typing import Dict, List, Optional, Tuple
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail of a killed writer
+    except OSError:
+        pass
+    return out
+
+
+def _rank_of(path: str, prefix: str) -> Optional[int]:
+    name = os.path.basename(path)
+    try:
+        return int(name[len(prefix):].split(".")[0])
+    except (ValueError, IndexError):
+        return None
+
+
+def load_dir(directory: str) -> dict:
+    flight: Dict[int, List[dict]] = {}
+    for p in sorted(glob.glob(os.path.join(directory, "flightrec-rank*.jsonl"))):
+        r = _rank_of(p, "flightrec-rank")
+        if r is not None:
+            flight[r] = _read_jsonl(p)
+    metrics_files: Dict[int, List[dict]] = {}
+    for p in sorted(glob.glob(os.path.join(directory, "metrics-rank*.jsonl"))):
+        r = _rank_of(p, "metrics-rank")
+        if r is not None:
+            metrics_files[r] = _read_jsonl(p)
+    cluster = _read_jsonl(os.path.join(directory, "cluster-report.jsonl"))
+    return {"flight": flight, "metrics": metrics_files, "cluster": cluster}
+
+
+def summarize(data: dict) -> dict:
+    summary: dict = {"ranks": sorted(data["flight"]), "failures": [],
+                     "faults": {}, "collectives": {}, "compression": {},
+                     "suspected_dead": [], "counters": {}}
+    coll_time: Dict[str, float] = defaultdict(float)
+    coll_n: Dict[str, int] = defaultdict(int)
+    ratios: Dict[str, List[float]] = defaultdict(list)
+    suspects: set = set()
+    # Counters are monotonic per rank but a rank may report several times
+    # (multiple dumps + exporter lines): take the max WITHIN a rank (its
+    # latest total), then sum ACROSS ranks for the cluster tally.
+    rank_counters: Dict[int, Dict[str, float]] = defaultdict(dict)
+    # Dump headers carry a FLAT snapshot where histograms flatten into
+    # stat keys (cgx.x.p50/.mean/...) — summing a p50 across ranks is
+    # nonsense, so those suffixes are excluded from the flat fold. The
+    # exporter's "counters" dict is typed (true Counters only) and is
+    # folded without the exclusion — a genuine counter named *.count
+    # (e.g. span.x.count) must not be dropped there.
+    hist_suffixes = (".count", ".sum", ".min", ".max", ".mean",
+                     ".p50", ".p90", ".p99")
+
+    def _fold_counter(rank: int, k: str, v: float, flat: bool = True) -> None:
+        if flat and k.endswith(hist_suffixes):
+            return
+        cur = rank_counters[rank]
+        cur[k] = max(cur.get(k, 0.0), v)
+
+    for rank, events in data["flight"].items():
+        for ev in events:
+            kind = ev.get("kind")
+            if kind == "dump":
+                for k, v in (ev.get("metrics") or {}).items():
+                    if isinstance(v, (int, float)):
+                        _fold_counter(rank, k, v)
+            elif kind == "collective":
+                op = ev.get("op", "?")
+                coll_time[op] += ev.get("seconds", 0.0)
+                coll_n[op] += 1
+            elif kind in ("sra", "ring"):
+                b_in, b_out = ev.get("bytes_in"), ev.get("wire_bytes_out")
+                if b_in and b_out:
+                    ratios[kind].append(b_in / b_out)
+            elif kind == "allreduce_group" and ev.get("wire_ratio"):
+                ratios[f"jax_{ev.get('algo', '?')}"].append(ev["wire_ratio"])
+            elif kind == "failure":
+                # One incident can be recorded twice — the raise site
+                # knows key/suspects, the worker loop knows the op. Merge
+                # rows with the same (rank, error, message) into one.
+                row = {
+                    "rank": rank,
+                    "error": ev.get("error"),
+                    "op": ev.get("op"),
+                    "key": ev.get("key"),
+                    "suspects": ev.get("suspects"),
+                    "message": (ev.get("message") or "")[:160],
+                }
+                merged = False
+                for f in summary["failures"]:
+                    if (
+                        f["rank"] == row["rank"]
+                        and f["error"] == row["error"]
+                        and f["message"] == row["message"]
+                    ):
+                        for field in ("op", "key", "suspects"):
+                            if f.get(field) in (None, [], ()):
+                                f[field] = row[field]
+                        merged = True
+                        break
+                if not merged:
+                    summary["failures"].append(row)
+                for s in ev.get("suspects") or []:
+                    suspects.add(s)
+            elif kind == "heartbeat_suspect":
+                for pid in ev.get("pids") or []:
+                    suspects.add(f"pid:{pid}")
+    # Newest exporter line per rank folds in counters the dumps may miss.
+    for rank, lines in data["metrics"].items():
+        if not lines:
+            continue
+        for k, v in (lines[-1].get("counters") or {}).items():
+            if isinstance(v, (int, float)):
+                _fold_counter(rank, k, v, flat=False)
+    totals: Counter = Counter()
+    for per_rank in rank_counters.values():
+        for k, v in per_rank.items():
+            totals[k] += v
+    summary["counters"] = dict(totals)
+    summary["faults"] = {
+        k[len("cgx.faults."):]: int(v)
+        for k, v in totals.items()
+        if k.startswith("cgx.faults.")
+    }
+    summary["collectives"] = {
+        op: {"count": coll_n[op], "total_s": round(t, 6)}
+        for op, t in sorted(coll_time.items(), key=lambda kv: -kv[1])
+    }
+    summary["compression"] = {
+        k: {"n": len(v), "mean_ratio": round(sum(v) / len(v), 3),
+            "min_ratio": round(min(v), 3), "max_ratio": round(max(v), 3)}
+        for k, v in ratios.items() if v
+    }
+    summary["suspected_dead"] = sorted(suspects, key=str)
+    if data["cluster"]:
+        summary["cluster"] = data["cluster"][-1]
+    return summary
+
+
+def _fmt_table(rows: List[Tuple], headers: Tuple) -> str:
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+    out = [line(headers), line(tuple("-" * w for w in widths))]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render(summary: dict) -> str:
+    parts: List[str] = []
+    parts.append(f"ranks with flight data: {summary['ranks'] or 'none'}")
+    if summary["failures"]:
+        parts.append("\n== failures ==")
+        for f in summary["failures"]:
+            who = f"rank {f['rank']}"
+            sus = (
+                f" suspected dead rank(s): {f['suspects']}"
+                if f.get("suspects")
+                else ""
+            )
+            op = f" op={f['op']}" if f.get("op") else ""
+            key = f" key={f['key']}" if f.get("key") else ""
+            parts.append(f"  {who}: {f['error']}{op}{key}{sus}")
+            if f.get("message"):
+                parts.append(f"      {f['message']}")
+    if summary["suspected_dead"]:
+        parts.append(
+            f"\nsuspected dead: {summary['suspected_dead']}"
+        )
+    if summary["faults"]:
+        parts.append("\n== injected faults (CGX_FAULTS) ==")
+        for mode, n in sorted(summary["faults"].items()):
+            parts.append(f"  {mode}: {n}")
+    if summary["collectives"]:
+        parts.append("\n== top collectives by time ==")
+        rows = [
+            (op, d["count"], f"{d['total_s'] * 1e3:.1f}")
+            for op, d in summary["collectives"].items()
+        ]
+        parts.append(_fmt_table(rows, ("op", "count", "total_ms")))
+    if summary["compression"]:
+        parts.append("\n== compression ratios (bytes in / wire bytes) ==")
+        rows = [
+            (k, d["n"], d["mean_ratio"], d["min_ratio"], d["max_ratio"])
+            for k, d in sorted(summary["compression"].items())
+        ]
+        parts.append(_fmt_table(rows, ("path", "n", "mean", "min", "max")))
+    interesting = {
+        k: v for k, v in summary["counters"].items()
+        if any(t in k for t in (
+            "bridge_timeout", "wire_corrupt", "wire_reread", "nonfinite",
+            "heartbeat", "pressure", "shutdown",
+        )) and v
+    }
+    if interesting:
+        parts.append("\n== incident counters ==")
+        for k, v in sorted(interesting.items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("cluster"):
+        c = summary["cluster"]
+        parts.append(
+            f"\n== cluster report (last) == ws={c.get('world_size')} "
+            f"reporting={c.get('ranks_reporting')} "
+            f"missing={c.get('missing_ranks')}"
+        )
+    if len(parts) == 1:
+        parts.append("(no events recorded — was CGX_METRICS_DIR set?)")
+    return "\n".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "directory", nargs="?", default=os.environ.get("CGX_METRICS_DIR"),
+        help="metrics dir (default: $CGX_METRICS_DIR)",
+    )
+    ap.add_argument("--json", action="store_true", help="print JSON summary")
+    args = ap.parse_args(argv)
+    if not args.directory:
+        print("cgx_report: no directory given and CGX_METRICS_DIR unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.directory):
+        print(f"cgx_report: {args.directory!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    summary = summarize(load_dir(args.directory))
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -284,6 +284,68 @@ def check_unbounded_waits(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+_LIB_DIR = "torch_cgx_tpu"
+_METRIC_WRITE_METHODS = {"add", "set", "observe"}
+_METRIC_RECEIVERS = {"metrics", "_metrics"}
+_METRIC_NAMESPACES = ("cgx.", "span.")
+
+
+def _literal_metric_name(arg: ast.expr) -> str | None:
+    """The static prefix of a metric-name argument: a plain string, or the
+    leading constant of an f-string (``f"cgx.faults.{mode}"`` ->
+    ``"cgx.faults."``). None = dynamic, not checkable."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if (
+        isinstance(arg, ast.JoinedStr)
+        and arg.values
+        and isinstance(arg.values[0], ast.Constant)
+        and isinstance(arg.values[0].value, str)
+    ):
+        return arg.values[0].value
+    return None
+
+
+def check_library_hygiene(path: Path, tree: ast.Module) -> list[str]:
+    """Observability gates, scoped to torch_cgx_tpu/ library code:
+
+    * no bare ``print(`` — the reference's printf-only observability is the
+      exact gap this codebase closes; library output goes through
+      ``utils.logging.get_logger()`` (leveled) or the metric registry.
+    * metric names written via ``metrics.add/set/observe`` must live in
+      the documented ``cgx.`` / ``span.`` namespaces
+      (docs/OBSERVABILITY.md) — an off-namespace name is invisible to the
+      exporter's dashboards and the report tool's prefix scans.
+    """
+    if _LIB_DIR not in path.parts:
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if isinstance(fn, ast.Name) and fn.id == "print":
+            findings.append(
+                f"{path}:{node.lineno}: bare print() in library code — "
+                "use utils.logging.get_logger() or the metrics registry"
+            )
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _METRIC_WRITE_METHODS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id in _METRIC_RECEIVERS
+            and node.args
+        ):
+            name = _literal_metric_name(node.args[0])
+            if name is not None and not name.startswith(_METRIC_NAMESPACES):
+                findings.append(
+                    f"{path}:{node.lineno}: metric name {name!r} outside "
+                    f"the documented namespaces {_METRIC_NAMESPACES} "
+                    "(docs/OBSERVABILITY.md)"
+                )
+    return findings
+
+
 def check_file(path: Path) -> list[str]:
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -292,6 +354,7 @@ def check_file(path: Path) -> list[str]:
     c = Checker(path, tree)
     out = [f"{path}:{line}: undefined name '{name}'" for line, name in c.findings]
     out.extend(check_unbounded_waits(path, tree))
+    out.extend(check_library_hygiene(path, tree))
     return out
 
 

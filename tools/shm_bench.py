@@ -58,6 +58,11 @@ def _rank_main(rank: int, ws: int, initfile: str, mb: int, iters: int, q):
         dist.barrier()
         results[mode] = (time.perf_counter() - t0) / iters
         dist.destroy_process_group()
+    # Counter context for the BENCH_LOG record: the parent process never
+    # ran a collective, so the meaningful snapshot lives here in the rank.
+    from torch_cgx_tpu.utils.logging import metrics
+
+    results["metrics"] = metrics.snapshot("cgx.")
     q.put((rank, results))
 
 
@@ -106,6 +111,9 @@ def main() -> None:
             "note": "vs_baseline = speedup of the shm data plane over "
                     "the store-only transport on the same payload",
         },
+        # rank 1 (the receiver) carries the interesting counters: take
+        # waits/copies, wire bytes, any corruption or timeout tallies.
+        "metrics": res[1].get("metrics", {}),
         "ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
     }
     with open(os.path.join(_REPO, "BENCH_LOG.jsonl"), "a") as f:

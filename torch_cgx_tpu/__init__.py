@@ -10,7 +10,7 @@ data-parallel front end, and a pure-Python torch.distributed backend.
 
 __version__ = "0.1.0"
 
-from . import checkpoint, config, data, robustness
+from . import checkpoint, config, data, observability, robustness
 from .config import (
     CompressionConfig,
     TopologyConfig,
@@ -25,6 +25,7 @@ from .ops import QTensor, dequantize, quantize
 __all__ = [
     "checkpoint",
     "config",
+    "observability",
     "robustness",
     "CompressionConfig",
     "TopologyConfig",

@@ -69,6 +69,11 @@ SHM_MAX_MB = "CGX_SHM_MAX_MB"  # arena growth cap before pressure errors
 NONFINITE_GUARD = "CGX_NONFINITE_GUARD"  # off | skip | exact
 FAULTS = "CGX_FAULTS"  # fault-injection spec (robustness/faults.py grammar)
 FAULTS_SEED = "CGX_FAULTS_SEED"
+# Observability layer (docs/OBSERVABILITY.md):
+METRICS_DIR = "CGX_METRICS_DIR"  # flight-recorder dumps + metric exports
+METRICS_FLUSH_S = "CGX_METRICS_FLUSH_S"  # periodic exporter interval
+QERR_STATS = "CGX_QERR_STATS"  # per-layer relative-L2 quantization error
+FLIGHTREC_CAP = "CGX_FLIGHTREC_CAP"  # flight-recorder ring capacity
 
 # Defaults — reference values (common.h:24-41, compressor.h:32,
 # mpi_allreduce_operations.h:32).
@@ -347,6 +352,40 @@ def shm_max_mb() -> int:
     un-acked key — instead of eating tmpfs until the host OOMs under a
     dead reader."""
     return _env.get_int_env_or_default(SHM_MAX_MB, 1024)
+
+
+def metrics_dir() -> Optional[str]:
+    """CGX_METRICS_DIR: target directory for flight-recorder dumps
+    (``flightrec-rank<N>.jsonl``), periodic metric exports
+    (``metrics-rank<N>.jsonl``) and leader cluster reports
+    (``cluster-report.jsonl``). Unset (default) = all of those are
+    no-ops — the clean path touches no filesystem and stays
+    bit-identical (docs/OBSERVABILITY.md)."""
+    v = _env.get_str_env_or_default(METRICS_DIR, "")
+    return v or None
+
+
+def metrics_flush_s() -> float:
+    """CGX_METRICS_FLUSH_S: interval of the periodic per-rank metrics
+    exporter (only active when CGX_METRICS_DIR is set)."""
+    v = _env.get_float_env_or_default(METRICS_FLUSH_S, 10.0)
+    return v if v > 0 else 10.0
+
+
+def qerr_stats() -> bool:
+    """CGX_QERR_STATS: stage a per-layer relative-L2 quantization-error
+    measurement (this device's contribution vs its wire decode) into the
+    compressed allreduce, reported through a host callback into the
+    ``cgx.qerr.<path>`` histograms and the flight recorder. Off by
+    default: enabling it adds a decode + norm pass per layer to the
+    traced program (the clean path stays bit-identical only when off)."""
+    return _env.get_bool_env_or_default(QERR_STATS, False)
+
+
+def flightrec_cap() -> int:
+    """CGX_FLIGHTREC_CAP: flight-recorder ring capacity in events."""
+    v = _env.get_int_env_or_default(FLIGHTREC_CAP, 512)
+    return v if v > 0 else 512
 
 
 NONFINITE_POLICIES = ("off", "skip", "exact")

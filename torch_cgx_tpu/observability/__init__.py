@@ -1,0 +1,47 @@
+"""Observability subsystem: typed instruments, flight recorder, exporter.
+
+Three layers (see ``docs/OBSERVABILITY.md``):
+
+* :mod:`.instruments` — counters/gauges/histograms behind the process
+  registry (``torch_cgx_tpu.utils.logging.metrics`` is the same object;
+  the seed's flat-counter API still works).
+* :mod:`.flightrec` — per-rank bounded ring of structured events, dumped
+  to ``CGX_METRICS_DIR/flightrec-rank<N>.jsonl`` on data-plane failures,
+  guard trips, shutdown, and on demand.
+* :mod:`.exporter` — periodic per-rank JSONL snapshots
+  (``CGX_METRICS_FLUSH_S``) plus a leader-side cross-rank merge riding
+  the group's store control plane.
+
+``instruments`` is imported eagerly (``utils.logging`` depends on it);
+``flightrec``/``exporter`` load lazily so this package root stays
+importable from anywhere in the import graph without cycles.
+"""
+
+from __future__ import annotations
+
+from . import instruments
+from .instruments import Counter, Gauge, Histogram, Metrics, metrics
+
+_LAZY = ("flightrec", "exporter")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
+__all__ = [
+    "instruments",
+    "flightrec",
+    "exporter",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "Metrics",
+    "metrics",
+]

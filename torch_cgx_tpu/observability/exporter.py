@@ -1,0 +1,265 @@
+"""Periodic JSONL metrics export + leader-side cross-rank aggregation.
+
+Two consumers of the instrument registry
+(:mod:`.instruments`) that get the numbers OUT of the process:
+
+* :class:`MetricsExporter` — a daemon thread appending one typed
+  snapshot line to ``CGX_METRICS_DIR/metrics-rank<N>.jsonl`` every
+  ``CGX_METRICS_FLUSH_S`` seconds (and once on stop), so a wedged or
+  killed rank leaves a trail of its last healthy state.
+* :func:`aggregate_over_store` — a cross-rank merge riding the group's
+  existing control plane (the c10d Store the bridge already holds): every
+  rank publishes its snapshot under a well-known key, the leader polls
+  them in with a bounded deadline (a dead rank yields a named gap, not a
+  hang — the data plane's own contract), merges counters by sum and
+  histograms by component, and appends one cluster line to
+  ``CGX_METRICS_DIR/cluster-report.jsonl``.
+
+Both are inert unless ``CGX_METRICS_DIR`` is set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .. import config as cfg
+from ..utils.logging import get_logger
+from .instruments import metrics
+
+log = get_logger()
+
+
+class MetricsExporter:
+    """Daemon flusher for one rank's registry (use :func:`start_exporter`)."""
+
+    def __init__(self, directory: str, rank: int, flush_s: float):
+        self._path = os.path.join(directory, f"metrics-rank{rank}.jsonl")
+        self._rank = rank
+        self._flush_s = flush_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def start(self) -> "MetricsExporter":
+        os.makedirs(os.path.dirname(self._path) or ".", exist_ok=True)
+        self._thread = threading.Thread(
+            target=self._run, name="cgx-metrics-exporter", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def flush(self) -> None:
+        rec = {
+            "ts": round(time.time(), 6),
+            "rank": self._rank,
+            "pid": os.getpid(),
+            **metrics.snapshot_typed(),
+        }
+        try:
+            with open(self._path, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+        except OSError as e:  # export must never take down training
+            log.warning("metrics export to %s failed: %s", self._path, e)
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._flush_s):
+            self.flush()
+
+    def stop(self, final_flush: bool = True) -> None:
+        self._stop.set()
+        if final_flush:
+            self.flush()
+
+
+_exporter: Optional[MetricsExporter] = None
+_exporter_refs = 0
+_exporter_lock = threading.Lock()
+
+
+def start_exporter(rank: int = 0) -> Optional[MetricsExporter]:
+    """Start (idempotently) the process's periodic exporter and take a
+    reference on it. Returns None — and starts nothing — when
+    ``CGX_METRICS_DIR`` is unset. Each ``start_exporter`` is balanced by
+    a :func:`release_exporter` (the process-group lifecycle) or a final
+    :func:`stop_exporter` (tests / explicit teardown)."""
+    directory = cfg.metrics_dir()
+    if not directory:
+        return None
+    global _exporter, _exporter_refs
+    with _exporter_lock:
+        if _exporter is None:
+            _exporter = MetricsExporter(
+                directory, rank, cfg.metrics_flush_s()
+            ).start()
+        _exporter_refs += 1
+        return _exporter
+
+
+def release_exporter() -> None:
+    """Drop one reference: flush now, and stop the daemon only when the
+    last holder releases — a subgroup's shutdown must not silence the
+    exporter while the main group is still training."""
+    global _exporter, _exporter_refs
+    with _exporter_lock:
+        _exporter_refs = max(0, _exporter_refs - 1)
+        ex = _exporter
+        last = _exporter_refs == 0
+        if last:
+            _exporter = None
+    if ex is not None:
+        if last:
+            ex.stop()
+        else:
+            ex.flush()
+
+
+def stop_exporter() -> None:
+    """Stop the process exporter after one final flush, dropping all
+    references (idempotent)."""
+    global _exporter, _exporter_refs
+    with _exporter_lock:
+        ex, _exporter = _exporter, None
+        _exporter_refs = 0
+    if ex is not None:
+        ex.stop()
+
+
+_AGG_PREFIX = "cgxmetrics/agg"
+
+
+def _bounded_store_get(store, key: str, deadline: float):
+    """Fetch a store key with the deadline actually enforced against real
+    c10d stores: a bare ``get`` on a missing key parks for the STORE's
+    own timeout (~300 s — the FileStore open-retry spin PR 1's shutdown
+    leash documents), which would let it trump ours. So when the store
+    supports ``wait(keys, timeout)`` the park happens in 200 ms slices
+    with our deadline checked between them; stores without ``wait``
+    (test doubles) are polled with backoff. None = deadline expired."""
+    import datetime as _dt
+
+    slice_ = _dt.timedelta(milliseconds=200)
+    backoff = 0.001
+    can_wait: Optional[bool] = None
+    while True:
+        slept_in_wait = False
+        if can_wait is not False:
+            t0 = time.monotonic()
+            try:
+                store.wait([key], slice_)
+                return store.get(key)
+            except (NotImplementedError, AttributeError, TypeError):
+                can_wait = False  # store double without wait support
+            except Exception:
+                can_wait = True  # a real wait that timed out its slice
+                # A wait that failed in well under its slice didn't time
+                # out — it errored (broken store). Don't busy-spin on it.
+                slept_in_wait = time.monotonic() - t0 >= 0.1
+        else:
+            try:
+                return store.get(key)
+            except Exception:
+                pass
+        if time.monotonic() >= deadline:
+            return None
+        if not slept_in_wait:
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 0.05)
+
+
+def aggregate_over_store(
+    store,
+    rank: int,
+    world_size: int,
+    round_id: int = 0,
+    timeout_s: float = 5.0,
+) -> Optional[Dict]:
+    """Merge every rank's snapshot into one report on the leader.
+
+    Rides the group's existing store control plane — no new transport.
+    Every rank (leader included) publishes its typed snapshot under
+    ``cgxmetrics/agg/<round>/r<rank>``; rank 0 then polls the keys in
+    with a single bounded deadline shared across ranks and merges what
+    arrived: counters/gauge sums, histograms by mergeable component
+    (count/sum/min/max). Ranks that never published within ``timeout_s``
+    are listed in ``missing_ranks`` — a killed rank degrades the report,
+    never hangs it.
+
+    Returns the merged report on rank 0 (also appended to
+    ``CGX_METRICS_DIR/cluster-report.jsonl`` when set), None elsewhere.
+    Never raises: aggregation is housekeeping on a store that may be
+    dying (shutdown path).
+    """
+    try:
+        snap = metrics.snapshot_typed()
+        key = f"{_AGG_PREFIX}/{round_id}/r{rank}"
+        store.set(key, json.dumps({"rank": rank, **snap}).encode())
+    except Exception as e:
+        log.warning("metrics aggregation publish failed: %s", e)
+        return None
+    if rank != 0:
+        return None
+    per_rank: Dict[int, Dict] = {}
+    missing: List[int] = []
+    deadline = time.monotonic() + timeout_s
+    for r in range(world_size):
+        raw = _bounded_store_get(
+            store, f"{_AGG_PREFIX}/{round_id}/r{r}", deadline
+        )
+        if raw is None:
+            missing.append(r)
+            continue
+        try:
+            per_rank[r] = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            missing.append(r)
+    counters: Dict[str, float] = {}
+    hists: Dict[str, Dict[str, float]] = {}
+    for r, snap_r in per_rank.items():
+        for k, v in snap_r.get("counters", {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+        for k, h in snap_r.get("histograms", {}).items():
+            m = hists.setdefault(
+                k,
+                {"count": 0.0, "sum": 0.0, "min": float("inf"),
+                 "max": float("-inf")},
+            )
+            m["count"] += h.get("count", 0.0)
+            m["sum"] += h.get("sum", 0.0)
+            m["min"] = min(m["min"], h.get("min", float("inf")))
+            m["max"] = max(m["max"], h.get("max", float("-inf")))
+    for m in hists.values():
+        if m["count"]:
+            m["mean"] = m["sum"] / m["count"]
+        else:
+            m.pop("min", None)
+            m.pop("max", None)
+    report = {
+        "ts": round(time.time(), 6),
+        "round": round_id,
+        "world_size": world_size,
+        "ranks_reporting": sorted(per_rank),
+        "missing_ranks": missing,
+        "counters": counters,
+        "histograms": hists,
+        "gauges_per_rank": {
+            r: s.get("gauges", {}) for r, s in per_rank.items()
+        },
+    }
+    directory = cfg.metrics_dir()
+    if directory:
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(
+                os.path.join(directory, "cluster-report.jsonl"), "a"
+            ) as f:
+                f.write(json.dumps(report) + "\n")
+        except OSError as e:
+            log.warning("cluster report write failed: %s", e)
+    return report

@@ -1,0 +1,197 @@
+"""Per-rank flight recorder: a bounded ring of structured events.
+
+When a ``BridgeTimeoutError`` fires today, the evidence — which
+collective, how many bytes, what bits/bucket, how long each phase took —
+dies with the process; the exception message is all that survives. The
+flight recorder keeps the last ``CGX_FLIGHTREC_CAP`` (default 512)
+events in memory at near-zero cost and writes them to
+``CGX_METRICS_DIR/flightrec-rank<N>.jsonl`` when it matters:
+
+* automatically, on a :class:`~..robustness.errors.BridgeTimeoutError`,
+  :class:`~..robustness.errors.WireCorruptionError`, or a non-finite
+  guard trip (the instrumented raise sites call :func:`record_failure`),
+* on ``ProcessGroup.shutdown()``,
+* on demand (:func:`dump`).
+
+Each dump atomically rewrites the rank's file with the full current ring
+(tmp + rename — a reader, human or ``tools/cgx_report.py``, never sees a
+torn file). With ``CGX_METRICS_DIR`` unset, recording still happens (the
+ring is cheap and an explicit ``dump(path=...)`` can target anywhere)
+but automatic dumps are no-ops — the clean path touches no filesystem.
+
+Events are plain dicts: ``{"ts", "seq", "kind", ...caller fields}``.
+Kinds in use: ``collective`` (op/seq/bytes/algo), ``shm_put``/
+``shm_take`` (bytes, wait/copy seconds), ``failure`` (error type +
+context), ``nonfinite_guard``, ``heartbeat_suspect``, ``qerr``
+(per-layer relative-L2 quantization error), ``dump`` (the header line).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .. import config as cfg
+from ..utils.logging import get_logger, metrics
+
+log = get_logger()
+
+
+class FlightRecorder:
+    """Bounded, thread-safe event ring for one rank."""
+
+    def __init__(self, rank: Optional[int] = None, capacity: Optional[int] = None):
+        self.rank = rank
+        self._events: deque = deque(
+            maxlen=capacity if capacity is not None else cfg.flightrec_cap()
+        )
+        self._seq = 0
+        self._lock = threading.Lock()
+        # Serializes dump(): a p2p-pool failure dump racing a worker-loop
+        # dump would share the same tmp path (same pid) and publish a
+        # torn file — exactly the evidence loss the atomic rename exists
+        # to prevent.
+        self._dump_lock = threading.Lock()
+
+    def record(self, kind: str, **fields: Any) -> None:
+        ev = {"ts": round(time.time(), 6), "kind": kind}
+        ev.update(fields)
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._events.append(ev)
+
+    def events(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._events)
+
+    def _effective_rank(self) -> int:
+        """Rank for the dump filename. The torch bridge binds it
+        explicitly (set_rank); JAX-only multi-process runs never do, so
+        fall back to ``jax.process_index()`` when jax is already loaded —
+        otherwise N processes sharing one CGX_METRICS_DIR would all
+        clobber ``flightrec-rank0.jsonl``. Never imports jax itself."""
+        if self.rank is not None:
+            return self.rank
+        import sys
+
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                self.rank = int(jax_mod.process_index())
+                return self.rank
+            except Exception:
+                pass
+        return 0
+
+    def dump(
+        self, reason: str = "on_demand", path: Optional[str] = None
+    ) -> Optional[str]:
+        """Write the ring as JSONL (header line first, then events oldest
+        to newest). Returns the path written, or None when no target
+        exists (``path`` not given and ``CGX_METRICS_DIR`` unset). Never
+        raises: a dump runs on failure paths where a second exception
+        would mask the first."""
+        if path is None:
+            d = cfg.metrics_dir()
+            if not d:
+                return None
+            path = os.path.join(
+                d, f"flightrec-rank{self._effective_rank()}.jsonl"
+            )
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with self._lock:
+                events = list(self._events)
+                seq = self._seq
+            with self._dump_lock:
+                return self._write_dump(path, reason, events, seq)
+        except Exception as e:  # a dump must never mask the real failure
+            log.warning("flight recorder dump failed: %s", e)
+            return None
+
+    def _write_dump(self, path, reason, events, seq) -> str:
+        header = {
+            "ts": round(time.time(), 6),
+            "kind": "dump",
+            "reason": reason,
+            "rank": self._effective_rank(),
+            "pid": os.getpid(),
+            "events": len(events),
+            "events_total": seq,
+            "metrics": metrics.snapshot("cgx."),
+        }
+        tmp = f"{path}.tmp{os.getpid()}.{threading.get_ident()}"
+        with open(tmp, "w") as f:
+            f.write(json.dumps(header) + "\n")
+            for ev in events:
+                f.write(json.dumps(ev) + "\n")
+        os.replace(tmp, path)
+        metrics.add("cgx.flightrec.dumps")
+        return path
+
+
+_recorder: Optional[FlightRecorder] = None
+_recorder_lock = threading.Lock()
+
+
+def get_recorder() -> FlightRecorder:
+    """The process's recorder (created on first use, rank unset)."""
+    global _recorder
+    with _recorder_lock:
+        if _recorder is None:
+            _recorder = FlightRecorder()
+        return _recorder
+
+
+def set_rank(rank: int) -> FlightRecorder:
+    """Explicitly bind the process recorder to a rank (overrides any
+    previous binding)."""
+    rec = get_recorder()
+    rec.rank = rank
+    return rec
+
+
+def bind_rank(rank: int) -> FlightRecorder:
+    """First-wins rank binding for implicit callers
+    (``ProcessGroupCGX.__init__``, ``ShmChannel``): the first group a
+    process constructs is the default/global one, whose rank is the
+    process-global rank — a later ``dist.new_group`` subgroup passes its
+    GROUP-LOCAL rank, and rebinding to that would make two processes
+    dump to (and clobber) the same ``flightrec-rank<N>.jsonl``."""
+    rec = get_recorder()
+    if rec.rank is None:
+        rec.rank = rank
+    return rec
+
+
+def record(kind: str, **fields: Any) -> None:
+    get_recorder().record(kind, **fields)
+
+
+def dump(reason: str = "on_demand", path: Optional[str] = None) -> Optional[str]:
+    return get_recorder().dump(reason, path)
+
+
+def record_failure(exc: BaseException, **fields: Any) -> None:
+    """Record a failure event and dump the ring — the black-box write the
+    recorder exists for. Call at (or just before) a raise site."""
+    rec = get_recorder()
+    rec.record(
+        "failure",
+        error=type(exc).__name__,
+        message=str(exc),
+        **fields,
+    )
+    rec.dump(reason=type(exc).__name__)
+
+
+def reset() -> None:
+    """Drop the process recorder (tests: fresh ring + seq per case)."""
+    global _recorder
+    with _recorder_lock:
+        _recorder = None

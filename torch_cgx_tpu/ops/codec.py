@@ -407,3 +407,20 @@ def allreduce_error_bound(
         * (world_size + 1)
         * value_range
     )
+
+
+# ---------------------------------------------------------------------------
+# Quantization-error measurement (CGX_QERR_STATS — docs/OBSERVABILITY.md).
+# ---------------------------------------------------------------------------
+
+
+def relative_l2_error(x: jax.Array, decoded: jax.Array) -> jax.Array:
+    """``‖x − decode(encode(x))‖₂ / ‖x‖₂`` — the per-layer quantization
+    error statistic the observability layer samples when ``CGX_QERR_STATS``
+    is on. Scale-invariant (a pre-divided averaged gradient reports the
+    same error as the raw one); a zero input reports zero error rather
+    than dividing by zero."""
+    x = x.astype(jnp.float32)
+    num = jnp.sqrt(jnp.sum((x - decoded.astype(jnp.float32)) ** 2))
+    den = jnp.sqrt(jnp.sum(x**2))
+    return num / jnp.maximum(den, jnp.float32(1e-30))

@@ -95,6 +95,40 @@ def _runtime_count(name: str, n: int) -> None:
     )
 
 
+def _report_qerr(path: str, leaf, rt) -> None:
+    """CGX_QERR_STATS: stage a relative-L2 quantization-error measurement
+    of this layer — this device's contribution vs its own wire decode
+    (the same stage-1 round trip error feedback consumes) — delivered at
+    execution time into the ``cgx.qerr.<path>`` histogram and the flight
+    recorder. One observation per device program per step; relative
+    error is scale-invariant, so the pre-divided averaging does not skew
+    it. Nothing is staged when the knob is off (the clean program stays
+    bit-identical)."""
+    from jax.experimental import io_callback
+
+    from ..ops.codec import relative_l2_error
+
+    err = relative_l2_error(leaf, rt)
+
+    def _sink(v, path=path):
+        from ..observability import flightrec
+
+        metrics.observe(f"cgx.qerr.{path}", float(v))
+        # The histogram keeps every observation; the flight-recorder event
+        # is subsampled (first, then every 32nd per layer) so a long run's
+        # qerr stream cannot flood rare events (trace structure, failures)
+        # out of the bounded ring.
+        n = _QERR_SEEN.get(path, 0)
+        _QERR_SEEN[path] = n + 1
+        if n % 32 == 0:
+            flightrec.record("qerr", layer=path, rel_l2=float(v), sample=n)
+
+    io_callback(_sink, None, err.astype(jnp.float32), ordered=False)
+
+
+_QERR_SEEN: Dict[str, int] = {}
+
+
 @dataclasses.dataclass(frozen=True)
 class _Group:
     cc: CompressionConfig
@@ -372,6 +406,7 @@ def allreduce_tree(
     """
     axes = tuple(axes)
     ws_total = int(np.prod([mesh.shape[a] for a in axes]))
+    qerr = cfg_mod.qerr_stats()
     with_path, treedef = jax.tree_util.tree_flatten_with_path(tree)
     paths_leaves = [(path_str(p), l) for p, l in with_path]
     flat_leaves = [l for _, l in paths_leaves]
@@ -403,9 +438,33 @@ def allreduce_tree(
             # callback (per device program — divide by the device count for
             # per-step totals).
             if g.cc.enabled:
-                metrics.add("trace.allreduce.compressed_elems", float(fused.shape[0]))
-                _runtime_count("runtime.allreduce.compressed_elems", fused.shape[0])
-                if return_roundtrip:
+                metrics.add("cgx.trace.allreduce.compressed_elems", float(fused.shape[0]))
+                _runtime_count("cgx.runtime.allreduce.compressed_elems", fused.shape[0])
+                # Trace-time structure event (once per compiled program):
+                # what this fused group ships and at what static ratio.
+                from ..observability import flightrec
+
+                topo_rec = topology or cfg_mod.topology_from_env()
+                n_f = int(fused.shape[0])
+                nb = -(-n_f // g.cc.bucket_size)
+                wire_b = n_f * g.cc.bits / 8 + nb * 8
+                flightrec.record(
+                    "allreduce_group",
+                    algo=(
+                        topo_rec.cross_reduction
+                        if len(axes) == 2
+                        else topo_rec.intra_reduction
+                    ),
+                    axes=list(axes),
+                    elems=n_f,
+                    layers=len(g.indices),
+                    bits=g.cc.bits,
+                    bucket=g.cc.bucket_size,
+                    wire_ratio=round(n_f * 4 / wire_b, 3),
+                )
+                # qerr stats need this device's wire decode even when the
+                # caller (no error feedback) didn't ask for it.
+                if return_roundtrip or qerr:
                     reduced, rt_flat = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, return_roundtrip=True,
@@ -416,8 +475,8 @@ def allreduce_tree(
                         key=g_key,
                     )
             else:
-                metrics.add("trace.allreduce.raw_elems", float(fused.shape[0]))
-                _runtime_count("runtime.allreduce.raw_elems", fused.shape[0])
+                metrics.add("cgx.trace.allreduce.raw_elems", float(fused.shape[0]))
+                _runtime_count("cgx.runtime.allreduce.raw_elems", fused.shape[0])
                 reduced = fused
                 if return_roundtrip:
                     rt_flat = fused  # exact wire: zero residual
@@ -428,10 +487,14 @@ def allreduce_tree(
         for i, leaf in zip(g.indices, leaves):
             n = leaf.size
             out[i] = lax.slice(reduced, (off,), (off + n,)).reshape(leaf.shape)
-            if return_roundtrip:
-                rt_out[i] = lax.slice(rt_flat, (off,), (off + n,)).reshape(
+            if return_roundtrip or (qerr and g.cc.enabled):
+                rt_leaf = lax.slice(rt_flat, (off,), (off + n,)).reshape(
                     leaf.shape
                 )
+                if return_roundtrip:
+                    rt_out[i] = rt_leaf
+                if qerr and g.cc.enabled:
+                    _report_qerr(paths_leaves[i][0], leaf, rt_leaf)
             off += n
     result = jax.tree_util.tree_unflatten(treedef, out)
     if return_roundtrip:

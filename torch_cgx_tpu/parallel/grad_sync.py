@@ -177,8 +177,24 @@ def _count_nonfinite(bad, axes):
         [jax.lax.axis_index(a) == 0 for a in axes],
         jnp.asarray(True),
     )
+
+    def _sink(v):
+        metrics.add("cgx.nonfinite_steps", float(v))
+        if v:
+            # Guard trip: black-box the evidence (docs/OBSERVABILITY.md).
+            # record() is ring-cheap and runs every trip; the full-ring
+            # dump is rate-limited (first trip, then every 32nd) so a
+            # diverged run that trips EVERY step doesn't rewrite the
+            # dump file ~100 KB/step for its remainder.
+            from ..observability import flightrec
+
+            flightrec.record("nonfinite_guard", steps=float(v))
+            n = int(metrics.get("cgx.nonfinite_steps"))
+            if n == 1 or n % 32 == 0:
+                flightrec.dump(reason="nonfinite_guard")
+
     io_callback(
-        lambda v: metrics.add("cgx.nonfinite_steps", float(v)),
+        _sink,
         None,
         jnp.where(jnp.logical_and(bad, is0), 1.0, 0.0).astype(jnp.float32),
         ordered=False,
@@ -650,12 +666,28 @@ def make_train_step(
             )
             if powersgd_rank is not None:
                 body = _step_psgd
+                compressor = f"powersgd(rank={powersgd_rank})"
             elif topk_ratio is not None:
                 body = _step_topk
+                compressor = f"topk(ratio={topk_ratio})"
             elif error_feedback:
                 body = _step_ef
+                compressor = "quantized+ef"
             else:
                 body = _step
+                compressor = "quantized"
+            # Trace-time event: one per compiled train step (a retrace storm
+            # shows up in the flight recorder as a run of these).
+            from ..observability import flightrec
+
+            metrics.add("cgx.trace.train_step_builds")
+            flightrec.record(
+                "train_step_trace",
+                compressor=compressor,
+                sync_axes=list(sync_axes),
+                guard=guard,
+                registry_version=version,
+            )
             sharded = _compat_shard_map(
                 body,
                 mesh=mesh,

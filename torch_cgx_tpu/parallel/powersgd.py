@@ -168,7 +168,7 @@ def powersgd_transform(
                     ws if average else 1
                 )
                 red = _psum(g)
-                metrics.add("trace.powersgd.raw_elems", float(leaf.size))
+                metrics.add("cgx.trace.powersgd.raw_elems", float(leaf.size))
                 out.append(red.astype(leaf.dtype))
                 qs_new.append(None)
                 es_new.append(None)
@@ -181,9 +181,9 @@ def powersgd_transform(
             q_new = _psum(mat.T @ p) / np.float32(ws)
             m_hat = p @ q_new.T
             metrics.add(
-                "trace.powersgd.wire_elems", float((n + m) * q.shape[1])
+                "cgx.trace.powersgd.wire_elems", float((n + m) * q.shape[1])
             )
-            metrics.add("trace.powersgd.grad_elems", float(n * m))
+            metrics.add("cgx.trace.powersgd.grad_elems", float(n * m))
             out.append(
                 (m_hat * out_scale).reshape(leaf.shape).astype(leaf.dtype)
             )

@@ -175,7 +175,7 @@ def topk_transform(
             if e is None:
                 g = leaf.astype(jnp.float32)
                 red = _psum(g) * out_scale
-                metrics.add("trace.topk.raw_elems", float(leaf.size))
+                metrics.add("cgx.trace.topk.raw_elems", float(leaf.size))
                 out.append(red.astype(leaf.dtype))
                 es_new.append(None)
                 continue
@@ -190,8 +190,8 @@ def topk_transform(
             dense = (
                 jnp.zeros((n,), jnp.float32).at[all_idx].add(all_val)
             )
-            metrics.add("trace.topk.wire_elems", float(2 * k))
-            metrics.add("trace.topk.grad_elems", float(n))
+            metrics.add("cgx.trace.topk.wire_elems", float(2 * k))
+            metrics.add("cgx.trace.topk.grad_elems", float(n))
             out.append(
                 (dense * out_scale).reshape(leaf.shape).astype(leaf.dtype)
             )

@@ -167,6 +167,15 @@ class FaultInjector:
             if s.prob is not None and self._rng.random() >= s.prob:
                 return False
         metrics.add(f"cgx.faults.{mode}")
+        # Black-box the activation: a chaos run's dump shows WHICH injected
+        # fault preceded the failure it caused (lazy import — robustness
+        # must stay importable before the observability package finishes).
+        from ..observability import flightrec
+
+        flightrec.record(
+            "fault", mode=mode, rank=self._rank,
+            event=n, step=step if step is not None else n,
+        )
         return True
 
     def delay(self, mode: str = "delay_take") -> None:

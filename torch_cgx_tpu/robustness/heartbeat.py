@@ -129,4 +129,17 @@ def suspect_dead_pids(
                     os.unlink(path)
                 except OSError:
                     pass
-    return sorted(set(out))
+    out = sorted(set(out))
+    if out:
+        # Lazy imports: liveness stays dependency-free until it actually
+        # finds a suspect (this module is imported before the package
+        # finishes loading).
+        from ..observability import flightrec
+        from ..utils.logging import metrics
+
+        metrics.add("cgx.heartbeat.suspect_checks")
+        flightrec.record(
+            "heartbeat_suspect", pids=out, directory=directory,
+            stale_s=stale_s,
+        )
+    return out

@@ -55,6 +55,7 @@ from __future__ import annotations
 import dataclasses
 import os
 import threading
+import time
 import queue as _queue
 from concurrent.futures import ThreadPoolExecutor
 from typing import List, Optional, Sequence, Tuple
@@ -65,10 +66,12 @@ import torch.distributed as dist
 from torch.futures import Future
 
 from .. import config as cfg
+from ..observability import exporter as obs_exporter
+from ..observability import flightrec
 from ..ops import codec_host as hcodec
 from ..robustness import faults as faults_mod
 from ..robustness import heartbeat as hb_mod
-from ..robustness.errors import BridgeTimeoutError
+from ..robustness.errors import BridgeTimeoutError, WireCorruptionError
 from ..utils.logging import get_logger, metrics
 
 log = get_logger()
@@ -316,6 +319,28 @@ def _chunk_split_layer_aligned(
     return sizes_out, offs_out
 
 
+def _record_qreduce_phases(
+    kind: str, pfx: str, ws: int, fused: np.ndarray, wire_out: int,
+    t0: float, t1: float,
+) -> None:
+    """Shared phase-timing epilogue of the quantized SRA/Ring allreduce:
+    scatter-reduce [t0, t1) vs allgather [t1, now) durations, wire bytes
+    and the measured compression ratio — into the metrics registry
+    (``cgx.<kind>.*``) and the flight recorder."""
+    t2 = time.perf_counter()
+    bytes_in = int(fused.nbytes)
+    metrics.observe(f"cgx.{kind}.scatter_reduce_s", t1 - t0)
+    metrics.observe(f"cgx.{kind}.allgather_s", t2 - t1)
+    metrics.add(f"cgx.{kind}.wire_bytes_out", float(wire_out))
+    flightrec.record(
+        kind, key=pfx, ws=ws, elems=int(fused.shape[0]),
+        bytes_in=bytes_in, wire_bytes_out=wire_out,
+        ratio=round(bytes_in / wire_out, 3) if wire_out else None,
+        scatter_reduce_s=round(t1 - t0, 6),
+        allgather_s=round(t2 - t1, 6),
+    )
+
+
 # ---------------------------------------------------------------------------
 # The process group.
 # ---------------------------------------------------------------------------
@@ -443,6 +468,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if bt:
             self._timeout_s = bt / 1000.0
         self._injector = faults_mod.get_injector(rank)
+        # Observability: bind the process flight recorder to this rank and
+        # start the periodic metrics exporter (both no-ops on the clean
+        # path — the exporter only runs with CGX_METRICS_DIR set).
+        flightrec.bind_rank(rank)
+        obs_exporter.start_exporter(rank)
         self._pid_by_rank: List[int] = []
         self._seq = 0  # collective sequence number (issued on calling thread)
         self._p2p_send = {}  # (dst, tag) -> count
@@ -595,7 +625,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 item = self._jobs.get(timeout=0.1)
             except _queue.Empty:
                 continue
-            fn, fut, result = item
+            fn, fut, result, op, seq = item
+            t0 = time.perf_counter()
             try:
                 if self._injector is not None:
                     # kill_rank fault: die mid-collective the way SIGKILL
@@ -609,6 +640,19 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 args = (fut, None, e)
             else:
                 args = (fut, result, None)
+            if op:
+                dt = time.perf_counter() - t0
+                metrics.observe(f"cgx.collective.{op}_s", dt)
+                flightrec.record(
+                    "collective", op=op, seq=seq,
+                    seconds=round(dt, 6), ok=args[2] is None,
+                )
+            if isinstance(args[2], (BridgeTimeoutError, WireCorruptionError)):
+                # Name the failing collective in the black box — the deeper
+                # raise site recorded the key/suspects but not which op was
+                # running. Ordered after the collective event so the
+                # re-dump (an idempotent rewrite of the ring) includes it.
+                flightrec.record_failure(args[2], op=op, seq=seq)
             try:
                 self._completions.submit(self._finish, args)
             except Exception as e:  # thread exhaustion: complete inline
@@ -619,9 +663,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
                             "completing inline", e)
                 self._finish(*args)
 
-    def _submit(self, fn, result) -> dist.Work:
+    def _submit(self, fn, result, op: str = "", seq: int = 0) -> dist.Work:
         fut = Future()
-        self._jobs.put((fn, fut, result))
+        self._jobs.put((fn, fut, result, op, seq))
         return _CGXWork(fut)
 
     def _done(self, result) -> dist.Work:
@@ -718,12 +762,17 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     else ""
                 )
                 metrics.add("cgx.bridge_timeout")
-                raise BridgeTimeoutError(
+                err = BridgeTimeoutError(
                     f"cgx: timed out after {self._timeout_s:.0f}s waiting "
                     f"for {key!r} (peer dead or stalled?){extra}",
                     key=key,
                     suspects=suspects,
                 )
+                flightrec.record_failure(
+                    err, key=key, suspects=list(suspects),
+                    rank=self._rank, timeout_s=self._timeout_s,
+                )
+                raise err
 
     def _suspect_dead_peers(self) -> List[int]:
         """Same-host peers whose liveness heartbeat is missing/stale —
@@ -761,7 +810,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         err = RuntimeError(f"cgx: process group aborted ({msg})")
         while True:
             try:
-                _fn, fut, _result = self._jobs.get_nowait()
+                _fn, fut, _result, _op, _seq = self._jobs.get_nowait()
             except _queue.Empty:
                 break
             self._completions.submit(self._finish, (fut, None, err))
@@ -913,7 +962,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             else:
                 self._allreduce_plain(t, op, seq)
 
-        return self._submit(run, tensors)
+        return self._submit(run, tensors, op="allreduce", seq=seq)
 
     def _allreduce_quantized(self, t: torch.Tensor, seq: int, bucket_key=None) -> None:
         # Per-layer partition into compress / no-compress, exactly the
@@ -962,6 +1011,18 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 off += n
             wdt = _wire_dtype(t.dtype)
             topo = cfg.topology_from_env()
+            flightrec.record(
+                "allreduce_layers", seq=seq,
+                elems=int(t.numel()),
+                compressed_elems=sum(n for (_, n, _) in comp),
+                raw_elems=sum(n for (_, n, _) in rest),
+                bits=sorted({c.bits for (_, _, c) in comp}),
+                buckets=sorted({c.bucket_size for (_, _, c) in comp}),
+                algo=(
+                    "hier" if self._use_hierarchy(topo)
+                    else topo.intra_reduction
+                ),
+            )
             if self._use_hierarchy(topo):
                 self._qreduce_hier(fused, fl, f"cgx{seq}q", wdt, topo)
             else:
@@ -1005,14 +1066,14 @@ class ProcessGroupCGX(dist.ProcessGroup):
         segs = [
             _segments_in(layers, offs[r], offs[r] + sizes[r]) for r in range(ws)
         ]
+        t0 = time.perf_counter()
+        wire_out = 0
         # Round 1: compress each peer's chunk and post it (ISend analogue).
         for j in range(ws):
             if j != me:
-                self._put(
-                    f"{pfx}/s{me}>{j}",
-                    _compress_frames(fused, segs[j], dummy, rng, wdt),
-                    local=local,
-                )
+                frame = _compress_frames(fused, segs[j], dummy, rng, wdt)
+                wire_out += len(frame)
+                self._put(f"{pfx}/s{me}>{j}", frame, local=local)
         # Accumulate peers into our own chunk (TestRecv + decompress-add).
         for j in range(ws):
             if j != me:
@@ -1022,7 +1083,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # carries the identical quantization error
         # (scatter_reduce_allgather.cc:157-160 — load-bearing for the
         # bit-exactness oracle).
+        t1 = time.perf_counter()
         wire = _compress_frames(fused, segs[me], dummy, rng, wdt)
+        wire_out += len(wire)
         _decompress_frames(
             np.frombuffer(wire, np.uint8), segs[me], fused, dummy, add=False,
             wire_dtype=wdt,
@@ -1033,6 +1096,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             if j != me:
                 buf = self._take(f"{pfx}/g{j}", readers=ws - 1, local=local)
                 _decompress_frames(buf, segs[j], fused, dummy, add=False, wire_dtype=wdt)
+        _record_qreduce_phases("sra", pfx, ws, fused, wire_out, t0, t1)
 
     def _qreduce_ring(
         self, fused, layers, pfx, wdt=np.float32, *, ranks=None, local=None,
@@ -1049,18 +1113,19 @@ class ProcessGroupCGX(dist.ProcessGroup):
             _segments_in(layers, offs[r], offs[r] + sizes[r]) for r in range(ws)
         ]
         right = (me + 1) % ws
+        t0 = time.perf_counter()
+        wire_out = 0
         for step in range(ws - 1):
             s_idx = (me - step) % ws  # chunk we send rightward
             r_idx = (me - step - 1) % ws  # chunk we receive + reduce
-            self._put(
-                f"{pfx}/r{step}>{right}",
-                _compress_frames(fused, segs[s_idx], dummy, rng, wdt),
-                local=local,
-            )
+            frame = _compress_frames(fused, segs[s_idx], dummy, rng, wdt)
+            wire_out += len(frame)
+            self._put(f"{pfx}/r{step}>{right}", frame, local=local)
             buf = self._take(f"{pfx}/r{step}>{me}", local=local)
             _decompress_frames(buf, segs[r_idx], fused, dummy, add=True, wire_dtype=wdt)
         # Our fully-reduced chunk is (me+1) % ws; requantize + self-dequantize
         # it once (error symmetry, ring.cc:190-199), then circulate.
+        t1 = time.perf_counter()
         hold = _compress_frames(fused, segs[(me + 1) % ws], dummy, rng, wdt)
         _decompress_frames(
             np.frombuffer(hold, np.uint8), segs[(me + 1) % ws], fused, dummy,
@@ -1068,10 +1133,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         )
         for step in range(ws - 1):
             r_idx = (me - step) % ws  # chunk arriving this step
+            wire_out += len(hold)
             self._put(f"{pfx}/a{step}>{right}", hold, local=local)
             buf = self._take(f"{pfx}/a{step}>{me}", local=local)
             _decompress_frames(buf, segs[r_idx], fused, dummy, add=False, wire_dtype=wdt)
             hold = buf.tobytes()  # forward verbatim next step
+        _record_qreduce_phases("ring", pfx, ws, fused, wire_out, t0, t1)
 
     def _qreduce_alltoall(
         self, fused, layers, pfx, wdt=np.float32, *, ranks=None, local=None,
@@ -1297,7 +1364,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 with torch.no_grad():
                     t.copy_(self._tensor_from(buf, t))
 
-        return self._submit(run, tensors)
+        return self._submit(run, tensors, op="broadcast", seq=seq)
 
     def allgather(self, output_tensors, input_tensors, opts=None):
         self._check_single(input_tensors)
@@ -1320,7 +1387,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 with torch.no_grad():
                     outs[j].copy_(self._tensor_from(buf, outs[j]))
 
-        return self._submit(run, output_tensors)
+        return self._submit(run, output_tensors, op="allgather", seq=seq)
 
     def allgather_coalesced(self, output_lists, input_tensors, opts=None):
         # The reference throws here (ProcessGroupCGX.cc:494-501); we loop
@@ -1354,7 +1421,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             else:
                 self._put(f"{key}/{self._rank}", self._bytes_of(inp))
 
-        return self._submit(run, output_tensors)
+        return self._submit(run, output_tensors, op="gather", seq=seq)
 
     def scatter(self, output_tensors, input_tensors, opts=None):
         self._check_single(output_tensors)
@@ -1377,7 +1444,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 with torch.no_grad():
                     out.copy_(self._tensor_from(buf, out))
 
-        return self._submit(run, output_tensors)
+        return self._submit(run, output_tensors, op="scatter", seq=seq)
 
     def reduce(self, tensors, opts=None):
         self._check_single(tensors)
@@ -1415,7 +1482,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             else:
                 self._put(f"{key}/{self._rank}", self._bytes_of(t))
 
-        return self._submit(run, tensors)
+        return self._submit(run, tensors, op="reduce", seq=seq)
 
     def alltoall(self, output_tensors, input_tensors, opts=None):
         seq = self._next_seq()
@@ -1437,7 +1504,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                             self._tensor_from(buf, output_tensors[j])
                         )
 
-        return self._submit(run, output_tensors)
+        return self._submit(run, output_tensors, op="alltoall", seq=seq)
 
     def _a2a_lengths(self, t: torch.Tensor, splits) -> Tuple[List[int], List[int]]:
         """Per-destination element (length, offset) pairs for alltoall_base —
@@ -1533,7 +1600,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 if not contig:
                     output.copy_(flat_out.reshape(output.shape))
 
-        return self._submit(run, [output])
+        return self._submit(run, [output], op="alltoall_base", seq=seq)
 
     def barrier(self, opts=None):
         seq = self._next_seq()
@@ -1550,7 +1617,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     self._delete_key(f"{pfx}/r{r}")
                 self._delete_key(f"{pfx}/done")
 
-        return self._submit(run, None)
+        return self._submit(run, None, op="barrier", seq=seq)
 
     # -- point-to-point (store mailboxes executed on a dedicated pool, so a
     # blocked recv stalls its Work future, not the caller or the collective
@@ -1723,7 +1790,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 with torch.no_grad():
                     output.copy_(flat.reshape(output.shape))
 
-        return self._submit(run, [output])
+        return self._submit(run, [output], op="all_gather_into_tensor", seq=seq)
 
     def _reduce_scatter_base(self, output, input, opts=None):
         """reduce_scatter_tensor: rank r receives the reduction of every
@@ -1807,7 +1874,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 own /= ws
             _from_np(output, own)
 
-        return self._submit(run, [output])
+        return self._submit(run, [output], op="reduce_scatter_tensor", seq=seq)
 
     def reduce_scatter(self, output_tensors, input_tensors, opts=None):
         # List form: flatten the per-rank input list into one contiguous
@@ -1851,6 +1918,24 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def shutdown(self) -> None:
         self._shutdown.set()
         self._p2p_pool.shutdown(wait=False)
+        # Observability flush: black-box dump + final metrics export + the
+        # leader-side cross-rank merge over the store. Gated on
+        # CGX_METRICS_DIR and leashed like the announce GC below — the
+        # store may already be dead, and shutdown must stay bounded.
+        if cfg.metrics_dir():
+            obs = threading.Thread(
+                target=self._export_observability,
+                name="cgx-shutdown-obs",
+                daemon=True,
+            )
+            obs.start()
+            obs.join(timeout=5.0)
+            if obs.is_alive():
+                log.warning(
+                    "cgx shutdown: observability export still running "
+                    "after 5s (store backing gone?); abandoning it"
+                )
+                metrics.add("cgx.shutdown_obs_abandoned")
         # Announce-ticket GC is best-effort housekeeping on a store that
         # is being torn down — run it on a bounded leash. A c10d FileStore
         # whose backing file is already gone makes EVERY non-creating op
@@ -1879,6 +1964,24 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._shm.close()
             self._shm = None
             self._all_local = False
+
+    def _export_observability(self) -> None:
+        """Shutdown-path observability flush (CGX_METRICS_DIR set): dump
+        the flight recorder, flush the periodic exporter once more, and
+        run the cross-rank aggregation over the store — rank 0 merges
+        whatever snapshots arrive within its bounded window into
+        ``cluster-report.jsonl`` (a rank that died mid-run shows up in
+        ``missing_ranks``, it does not hang the merge)."""
+        flightrec.dump(reason="shutdown")
+        # Drop this group's reference: flushes now, and stops the daemon
+        # only when the LAST group releases — a destroyed group must not
+        # leave the flusher appending stale snapshots forever, but a
+        # subgroup's teardown must not silence a still-training main
+        # group either (refcounted in the exporter module).
+        obs_exporter.release_exporter()
+        obs_exporter.aggregate_over_store(
+            self._store, self._rank, self._size, timeout_s=3.0
+        )
 
     def _gc_announce_tickets(self) -> None:
         """Delete announce tickets for this rank's inbox that no

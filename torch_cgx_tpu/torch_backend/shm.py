@@ -62,6 +62,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from .. import config as cfg
+from ..observability import flightrec
 from ..robustness import faults as faults_mod
 from ..robustness.errors import BridgeTimeoutError, WireCorruptionError
 from ..utils.logging import get_logger, metrics
@@ -378,7 +379,11 @@ class ShmArena:
                 if off >= 0:
                     gen = self._gen
                     gf = self._gens[gen]
+                    t_copy = time.perf_counter()
                     gf.mm[off : off + len(data)] = data
+                    metrics.observe(
+                        "cgx.shm.put_copy_s", time.perf_counter() - t_copy
+                    )
                     self._pending.append(
                         _Region(gen, off, size, ack_key, readers)
                     )
@@ -401,12 +406,16 @@ class ShmArena:
                     else "no pending regions (cap too small for burst?)"
                 )
                 metrics.add("cgx.bridge_timeout")
-                raise BridgeTimeoutError(
+                err = BridgeTimeoutError(
                     f"cgx shm: arena at its {self._max_bytes >> 20} MB cap "
                     f"for {self._pressure_timeout_s:.1f}s and readers are "
                     f"not draining — {detail}; a reader is dead or stalled",
                     key=stalled.ack_key if stalled is not None else None,
                 )
+                flightrec.record_failure(
+                    err, op="shm.put", key=err.key, bytes=len(data)
+                )
+                raise err
             metrics.add("cgx.arena_pressure_waits")
             time.sleep(min(backoff, deadline - now if deadline > now else 0))
             backoff = min(backoff * 2, 0.2)
@@ -443,6 +452,7 @@ class ShmChannel:
         # can reap arenas whose writer died without running atexit
         # (SIGKILL/OOM — close() never fires there).
         _reap_dead_arenas(self._dir)
+        flightrec.bind_rank(rank)
         name = f"cgx-{uuid.uuid4().hex[:12]}-p{os.getpid()}-r{rank}"
         self._injector = faults_mod.get_injector(rank)
         self._checksum = cfg.wire_checksum()
@@ -488,6 +498,7 @@ class ShmChannel:
         carries a crc32 of the payload (``CGX_WIRE_CHECKSUM``, -1 when
         disabled) that ``take`` verifies."""
         hkey = self.HDR + key
+        t0 = time.perf_counter()
         mv = memoryview(data).cast("B")
         crc = _wire_checksum(mv) if self._checksum else -1
         inj = self._injector
@@ -505,17 +516,26 @@ class ShmChannel:
             return  # header never published: the reader's bounded wait fires
         path = self._arena.path_of(gen)
         self._store.set(hkey, f"{path}:{gen}:{off}:{size}:{crc}".encode())
+        dt = time.perf_counter() - t0
+        metrics.observe("cgx.shm.put_s", dt)
+        metrics.add("cgx.shm.put_bytes", float(size))
+        flightrec.record(
+            "shm_put", key=key, bytes=size, readers=readers,
+            seconds=round(dt, 6),
+        )
         with self._attach_lock:  # worker + p2p pool threads share us
             self.n_puts += 1
 
     def take(self, key: str) -> np.ndarray:
         hkey = self.HDR + key
+        t0 = time.perf_counter()
         if self._wait_key is not None:
             self._wait_key(hkey)
             hdr_raw = self._store.get(hkey)
         else:
             # Standalone channel (no group wait): bounded header wait.
             hdr_raw = self._bounded_get(hkey)
+        t_hdr = time.perf_counter()  # queue wait ends when the header lands
         hdr = bytes(hdr_raw).decode()
         path, _gen, off_s, size_s, crc_s = hdr.rsplit(":", 4)
         off, size, crc = int(off_s), int(size_s), int(crc_s)
@@ -532,13 +552,25 @@ class ShmChannel:
                 )
                 out = self._read(path, off, size, refresh=True)
                 if _wire_checksum(out) != crc:
-                    raise WireCorruptionError(
+                    err = WireCorruptionError(
                         f"cgx shm: payload checksum mismatch for {key!r} "
                         f"after one re-read ({path}:{off}+{size}) — the "
                         "wire payload is corrupted"
                     )
+                    flightrec.record_failure(
+                        err, op="shm.take", key=key, path=path, bytes=size
+                    )
+                    raise err
                 metrics.add("cgx.wire_reread_ok")
         self._store.add(hkey + "/ack", 1)
+        t1 = time.perf_counter()
+        metrics.observe("cgx.shm.take_wait_s", t_hdr - t0)
+        metrics.observe("cgx.shm.take_copy_s", t1 - t_hdr)
+        metrics.add("cgx.shm.take_bytes", float(size))
+        flightrec.record(
+            "shm_take", key=key, bytes=size,
+            wait_s=round(t_hdr - t0, 6), copy_s=round(t1 - t_hdr, 6),
+        )
         with self._attach_lock:
             self.n_takes += 1
         return out
@@ -575,12 +607,14 @@ class ShmChannel:
                     pass
             if time.monotonic() >= deadline:
                 metrics.add("cgx.bridge_timeout")
-                raise BridgeTimeoutError(
+                err = BridgeTimeoutError(
                     f"cgx shm: timed out after {self._timeout_s:.1f}s "
                     f"waiting for {hkey!r} (writer dead, or its put "
                     "dropped?)",
                     key=hkey,
                 )
+                flightrec.record_failure(err, op="shm.take", key=hkey)
+                raise err
             if can_wait is False:
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 0.05)

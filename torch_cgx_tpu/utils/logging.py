@@ -1,17 +1,21 @@
 """Structured logging + metrics.
 
-The reference has printf-only observability (SURVEY.md §5.5); here we provide
-leveled logging (``CGX_LOG_LEVEL``) and a tiny in-process metrics registry so
-benchmarks/tests can assert on counters.
+The reference has printf-only observability (SURVEY.md §5.5); here we
+provide leveled logging (``CGX_LOG_LEVEL``) and the process-wide metric
+registry. The registry itself lives in
+:mod:`torch_cgx_tpu.observability.instruments` — typed counters, gauges
+and histograms with quantile snapshots — and is re-exported here under
+its historical name so every ``from ..utils.logging import metrics``
+call site (and the seed's ``add/set/get/snapshot/reset`` API) keeps
+working unchanged.
 """
 
 from __future__ import annotations
 
 import logging
 import os
-import threading
-from collections import defaultdict
-from typing import Dict
+
+from ..observability.instruments import Metrics, metrics  # noqa: F401
 
 _LOGGER_NAME = "torch_cgx_tpu"
 
@@ -28,43 +32,3 @@ def get_logger() -> logging.Logger:
         logger.setLevel(getattr(logging, level, logging.WARNING))
         logger.propagate = False
     return logger
-
-
-class Metrics:
-    """Process-wide counter/gauge registry (thread-safe)."""
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._counters: Dict[str, float] = defaultdict(float)
-
-    def add(self, name: str, value: float = 1.0) -> None:
-        with self._lock:
-            self._counters[name] += value
-
-    def set(self, name: str, value: float) -> None:
-        with self._lock:
-            self._counters[name] = value
-
-    def get(self, name: str) -> float:
-        with self._lock:
-            return self._counters.get(name, 0.0)
-
-    def snapshot(self, prefix: str = "") -> Dict[str, float]:
-        """All counters, optionally filtered by name prefix — e.g.
-        ``metrics.snapshot("cgx.faults.")`` for the fault-injection tally
-        or ``metrics.snapshot("cgx.wire")`` for wire-integrity events."""
-        with self._lock:
-            if not prefix:
-                return dict(self._counters)
-            return {
-                k: v
-                for k, v in self._counters.items()
-                if k.startswith(prefix)
-            }
-
-    def reset(self) -> None:
-        with self._lock:
-            self._counters.clear()
-
-
-metrics = Metrics()

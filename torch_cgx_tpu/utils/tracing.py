@@ -20,13 +20,27 @@ from .logging import metrics
 @contextlib.contextmanager
 def trace_span(name: str):
     """Annotate a host-side span: XLA trace annotation + duration counter
-    (``span.<name>.seconds`` / ``span.<name>.count`` in ``metrics``)."""
+    (``span.{name}.seconds`` / ``span.{name}.count`` in ``metrics``) and
+    a duration histogram (``span.{name}.duration_s`` — distinct name so
+    its flattened ``.count``/``.sum`` stats never collide with the legacy
+    counter keys in ``snapshot()``).
+
+    The duration sample is recorded in a ``finally`` so a span whose body
+    raises still lands in the registry — failed collectives are the
+    interesting ones; ``span.{name}.errors`` counts them.
+    """
     start = time.perf_counter()
-    with jax.profiler.TraceAnnotation(name):
-        yield
-    dur = time.perf_counter() - start
-    metrics.add(f"span.{name}.seconds", dur)
-    metrics.add(f"span.{name}.count", 1.0)
+    try:
+        with jax.profiler.TraceAnnotation(name):
+            yield
+    except BaseException:
+        metrics.add(f"span.{name}.errors", 1.0)
+        raise
+    finally:
+        dur = time.perf_counter() - start
+        metrics.add(f"span.{name}.seconds", dur)
+        metrics.add(f"span.{name}.count", 1.0)
+        metrics.observe(f"span.{name}.duration_s", dur)
 
 
 def named_scope(name: str):
