@@ -481,6 +481,26 @@ def _device_watchdog(seconds: float = 300.0):
     return done
 
 
+def _maybe_gate(result: dict) -> int:
+    """CGX_BENCH_GATE=1: run tools/bench_gate.py on the fresh record
+    against the committed trajectory BEFORE it is logged — a regressed
+    run exits nonzero, and its row lands in BENCH_LOG flagged
+    ``unresolved`` (the gate's normalizer skips such rows), so a cliff
+    neither passes silently nor ratchets its own baseline median down.
+    Returns the exit code to use (0 = clean or gate disabled)."""
+    if os.environ.get("CGX_BENCH_GATE", "0") != "1":
+        return 0
+    proc = subprocess.run(
+        [sys.executable,
+         str(Path(__file__).parent / "tools" / "bench_gate.py"),
+         "--candidate", "-"],
+        input=json.dumps({"tool": "bench", **result}) + "\n",
+        capture_output=True, text=True,
+    )
+    sys.stderr.write(proc.stdout + proc.stderr)
+    return proc.returncode
+
+
 def main() -> None:
     _preflight_lint()
     ready = _device_watchdog()
@@ -492,8 +512,27 @@ def main() -> None:
         on_tpu = jax.default_backend() == "tpu"
         result = bench_codec(on_tpu)
         result["detail"]["train_step"] = bench_train_step(on_tpu)
-    log_jsonl({"tool": "bench", **result})
+    # Gate BEFORE logging: the candidate must not be part of the history
+    # it is judged against, and a regressed row must not poison future
+    # baseline medians (it is logged, but flagged out of the gate's view).
+    # Only rc == 1 is a regression VERDICT; any other nonzero is a gate
+    # infrastructure error (missing log, bad args) — the measurement is
+    # healthy, so log it clean and don't fail the bench.
+    rc = _maybe_gate(result)
+    rec = {"tool": "bench", **result}
+    if rc == 1:
+        rec["unresolved"] = (
+            "bench_gate: regression vs the committed trajectory "
+            "(see gate output); excluded from future baselines"
+        )
+    elif rc:
+        print(f"bench: bench_gate errored (exit {rc}); measurement "
+              "logged ungated", file=sys.stderr)
+        rc = 0
+    log_jsonl(rec)
     print(json.dumps(result))
+    if rc:
+        sys.exit(rc)
 
 
 if __name__ == "__main__":

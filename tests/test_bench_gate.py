@@ -1,0 +1,122 @@
+"""Bench regression gate tests (ISSUE 3): the committed trajectory must
+pass ``--smoke`` (this IS the tier-1 self-check the issue asks for), a
+synthetic 2x regression must fail with the offending metric named, and
+the record normalization must skip failure/unresolved rows.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_GATE = os.path.join(_REPO, "tools", "bench_gate.py")
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location("bench_gate", _GATE)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_smoke_passes_on_committed_trajectory():
+    # Acceptance: bench_gate exits zero on the committed BENCH_LOG.
+    proc = subprocess.run(
+        [sys.executable, _GATE, "--smoke"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "checked" in proc.stdout
+
+
+def test_synthetic_2x_regression_fails_named(tmp_path):
+    # Acceptance: a fresh run at half the historical throughput exits
+    # nonzero and names the offending metric.
+    gate = _load_gate()
+    history = gate._read_jsonl(os.path.join(_REPO, "BENCH_LOG.jsonl"))
+    baselines = gate.build_baselines(history)
+    metric, base = next(iter(sorted(baselines.items())))
+    cand = tmp_path / "cand.jsonl"
+    cand.write_text(json.dumps({
+        "tool": "shm_bench" if "bridge" in metric else "bench",
+        "metric": metric, "value": base / 2, "unit": "GB/s",
+    }) + "\n")
+    proc = subprocess.run(
+        [sys.executable, _GATE, "--candidate", str(cand)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 1
+    assert metric in proc.stderr  # the offending metric is named
+    assert "REGRESSION" in proc.stdout
+
+
+def test_candidate_within_threshold_passes(tmp_path):
+    gate = _load_gate()
+    history = gate._read_jsonl(os.path.join(_REPO, "BENCH_LOG.jsonl"))
+    baselines = gate.build_baselines(history)
+    metric, base = next(iter(sorted(baselines.items())))
+    cand = tmp_path / "cand.jsonl"
+    cand.write_text(json.dumps({
+        "tool": "shm_bench", "metric": metric, "value": base * 0.9,
+        "unit": "GB/s",
+    }) + "\n")
+    proc = subprocess.run(
+        [sys.executable, _GATE, "--candidate", str(cand), "--json"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stdout
+    verdict = json.loads(proc.stdout)
+    assert verdict["ok"] and verdict["checks"]
+
+
+def test_normalize_skips_failures_and_unresolved():
+    gate = _load_gate()
+    assert gate.normalize({"tool": "bench", "metric": "device_init_failure",
+                           "value": 0, "unit": "none"}) is None
+    assert gate.normalize({"tool": "qbench", "variant": "x", "gbps_in": None,
+                           "unresolved": "noise"}) is None
+    assert gate.normalize({"tool": "qbench", "variant": "current", "tc": 16,
+                           "mb": 128, "bits": 4, "pack": "sum",
+                           "encode": "div", "gbps_in": 130.5}) == (
+        "qbench_current_tc16_mb128_b4_sum_div", 130.5)
+    key, v = gate.normalize({"tool": "shm_bench", "metric": "m",
+                             "value": 0.5, "unit": "GB/s (shm)"})
+    assert key == "m" and v == 0.5
+    # non-throughput units carry no gate direction: skipped
+    assert gate.normalize({"tool": "bench", "metric": "m", "value": 3.0,
+                           "unit": "steps"}) is None
+
+
+def test_gate_logic_threshold_and_first_sighting():
+    gate = _load_gate()
+    baselines = {"m": 1.0}
+    reg, checks = gate.gate(
+        [{"tool": "shm_bench", "metric": "m", "value": 0.65,
+          "unit": "GB/s"},
+         {"tool": "shm_bench", "metric": "new", "value": 0.1,
+          "unit": "GB/s"}],
+        baselines, threshold_pct=30.0,
+    )
+    assert len(checks) == 1  # first sighting of "new" is not gated
+    assert reg and reg[0]["metric"] == "m"
+    assert reg[0]["delta_pct"] == pytest.approx(-35.0)
+    reg2, _ = gate.gate(
+        [{"tool": "shm_bench", "metric": "m", "value": 0.75,
+          "unit": "GB/s"}],
+        baselines, threshold_pct=30.0,
+    )
+    assert not reg2  # -25% is inside the 30% band
+
+
+def test_published_floor_wins_over_history():
+    gate = _load_gate()
+    history = [{"tool": "shm_bench", "metric": "m", "value": 0.4,
+                "unit": "GB/s"}]
+    b = gate.build_baselines(history, published={"m": 0.8})
+    assert b["m"] == 0.8

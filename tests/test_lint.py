@@ -6,6 +6,7 @@ undefined name a test failure: `tools/lint.py` walks every function body of
 every source file and flags bare-name loads with no binding in scope.
 """
 
+import os
 import subprocess
 import sys
 from pathlib import Path
@@ -132,6 +133,61 @@ def test_linter_flags_offnamespace_metric_name(tmp_path):
     proc = _run_lint(bad)
     assert proc.returncode == 1
     assert "outside the documented namespaces" in proc.stdout
+
+
+def _timeline_tree(tmp_path, ops_in_backend, ops_declared):
+    # Miniature package tree: the cross-check reads BRIDGE_OPS from
+    # observability/timeline.py relative to torch_backend/backend.py.
+    pkg = tmp_path / "torch_cgx_tpu"
+    (pkg / "torch_backend").mkdir(parents=True)
+    (pkg / "observability").mkdir()
+    backend = pkg / "torch_backend" / "backend.py"
+    calls = "\n".join(
+        f"        self._submit(run, t, op=\"{op}\", seq=1)"
+        for op in ops_in_backend
+    )
+    backend.write_text(
+        "class PG:\n"
+        "    def go(self, run, t):\n"
+        f"{calls}\n"
+    )
+    (pkg / "observability" / "timeline.py").write_text(
+        "BRIDGE_OPS = frozenset({"
+        + ", ".join(f"\"{op}\"" for op in ops_declared)
+        + "})\n"
+    )
+    return backend
+
+
+def test_linter_flags_worker_op_missing_from_timeline(tmp_path):
+    # ISSUE 3 satellite: a collective wired into the worker loop without
+    # a BRIDGE_OPS entry would produce timeline spans cgx_trace cannot
+    # attribute — lint failure, same style as the namespace rules.
+    bad = _timeline_tree(
+        tmp_path, ["allreduce", "frobnicate"], ["allreduce"]
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "frobnicate" in proc.stdout
+    assert "BRIDGE_OPS" in proc.stdout
+
+
+def test_linter_accepts_covered_worker_ops(tmp_path):
+    good = _timeline_tree(
+        tmp_path, ["allreduce", "barrier"], ["allreduce", "barrier"]
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_worker_op_check_needs_timeline_file(tmp_path):
+    backend = _timeline_tree(tmp_path, ["allreduce"], ["allreduce"])
+    os.unlink(
+        tmp_path / "torch_cgx_tpu" / "observability" / "timeline.py"
+    )
+    proc = _run_lint(backend)
+    assert proc.returncode == 1
+    assert "cannot be cross-checked" in proc.stdout
 
 
 def test_linter_accepts_namespaced_metrics_and_fstrings(tmp_path):

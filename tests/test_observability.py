@@ -24,7 +24,7 @@ import numpy as np
 import pytest
 
 from torch_cgx_tpu.observability import exporter as obs_exporter
-from torch_cgx_tpu.observability import flightrec, instruments
+from torch_cgx_tpu.observability import flightrec, instruments, timeline
 from torch_cgx_tpu.robustness import (
     BridgeTimeoutError,
     WireCorruptionError,
@@ -44,11 +44,13 @@ def _fresh():
     faults.reset_injectors()
     metrics.reset()
     flightrec.reset()
+    timeline.reset()
     obs_exporter.stop_exporter()
     yield
     faults.reset_injectors()
     metrics.reset()
     flightrec.reset()
+    timeline.reset()
     obs_exporter.stop_exporter()
 
 
@@ -140,6 +142,40 @@ def test_flightrec_ring_bounded_and_ordered():
     assert len(evs) == 8
     assert [e["i"] for e in evs] == list(range(12, 20))
     assert evs[-1]["seq"] == 20  # seq counts all-time, ring holds the tail
+
+
+def test_flightrec_events_carry_both_clocks():
+    # ISSUE 3 satellite: t_mono (perf_counter) rides alongside wall ts so
+    # the cross-rank merger can align ranks without trusting wall clocks.
+    rec = flightrec.FlightRecorder(rank=0)
+    t0 = time.perf_counter()
+    rec.record("collective", op="allreduce", seq=1)
+    t1 = time.perf_counter()
+    ev = rec.events()[-1]
+    assert t0 <= ev["t_mono"] <= t1 + 1e-6
+    assert abs(ev["ts"] - time.time()) < 60.0  # wall clock, roughly now
+
+
+def test_flightrec_dump_header_has_t_mono_and_report_prints_it(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("CGX_METRICS_DIR", str(tmp_path))
+    flightrec.set_rank(0)
+    flightrec.record(
+        "failure", error="BridgeTimeoutError", message="timed out",
+        op="allreduce", key="k",
+    )
+    path = flightrec.dump("unit")
+    header = json.loads(open(path).readline())
+    assert "t_mono" in header and "ts" in header
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", "cgx_report.py"),
+         str(tmp_path)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    # the failure line shows both clocks
+    assert "ts=" in proc.stdout and "t_mono=" in proc.stdout
 
 
 def test_flightrec_dump_without_dir_is_noop(tmp_path):
@@ -273,6 +309,58 @@ def test_exporter_periodic_flush(tmp_path, monkeypatch):
 
 def test_exporter_inert_without_dir():
     assert obs_exporter.start_exporter(rank=0) is None
+
+
+_SIGTERM_CHILD = r"""
+import os, signal, sys, time
+sys.path.insert(0, {repo!r})
+os.environ["CGX_METRICS_DIR"] = {mdir!r}
+os.environ["CGX_METRICS_FLUSH_S"] = "3600"  # no periodic flush
+from torch_cgx_tpu.observability import exporter, timeline
+from torch_cgx_tpu.utils.logging import metrics
+
+metrics.add("cgx.steps", 7.0)
+timeline.set_rank(0)
+with timeline.span("allreduce", timeline.CAT_COLLECTIVE, seq=1):
+    pass
+exporter.start_exporter(rank=0)
+print("READY", flush=True)
+time.sleep(60)
+"""
+
+
+def test_exporter_sigterm_flush_leaves_snapshot(tmp_path):
+    # ISSUE 3 satellite: a rank torn down between periodic flushes
+    # (SIGTERM from a launcher) still leaves its last metrics snapshot
+    # AND its buffered timeline spans on disk.
+    import signal
+
+    mdir = str(tmp_path / "m")
+    child = subprocess.Popen(
+        [sys.executable, "-c",
+         _SIGTERM_CHILD.format(repo=_REPO, mdir=mdir)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=_REPO,
+    )
+    try:
+        line = child.stdout.readline()
+        assert "READY" in line, child.stderr.read()
+        child.send_signal(signal.SIGTERM)
+        child.wait(timeout=30)
+    finally:
+        if child.poll() is None:
+            child.kill()
+    assert child.returncode != 0  # SIGTERM still terminates the process
+    mpath = os.path.join(mdir, "metrics-rank0.jsonl")
+    assert os.path.exists(mpath), os.listdir(mdir)
+    lines = [json.loads(l) for l in open(mpath)]
+    assert lines and lines[-1]["counters"]["cgx.steps"] == 7.0
+    spath = os.path.join(mdir, "spans-rank0.jsonl")
+    assert os.path.exists(spath), os.listdir(mdir)
+    spans = [json.loads(l) for l in open(spath)]
+    assert any(
+        e.get("kind") == "span" and e["name"] == "allreduce" for e in spans
+    )
 
 
 def test_aggregate_over_store_merges_and_names_missing(tmp_path, monkeypatch):

@@ -119,6 +119,10 @@ def summarize(data: dict) -> dict:
                     "key": ev.get("key"),
                     "suspects": ev.get("suspects"),
                     "message": (ev.get("message") or "")[:160],
+                    # Both clocks: wall for humans, monotonic for
+                    # cross-rank alignment (tools/cgx_trace.py).
+                    "ts": ev.get("ts"),
+                    "t_mono": ev.get("t_mono"),
                 }
                 merged = False
                 for f in summary["failures"]:
@@ -127,7 +131,8 @@ def summarize(data: dict) -> dict:
                         and f["error"] == row["error"]
                         and f["message"] == row["message"]
                     ):
-                        for field in ("op", "key", "suspects"):
+                        for field in ("op", "key", "suspects", "ts",
+                                      "t_mono"):
                             if f.get(field) in (None, [], ()):
                                 f[field] = row[field]
                         merged = True
@@ -198,7 +203,12 @@ def render(summary: dict) -> str:
             )
             op = f" op={f['op']}" if f.get("op") else ""
             key = f" key={f['key']}" if f.get("key") else ""
-            parts.append(f"  {who}: {f['error']}{op}{key}{sus}")
+            clocks = ""
+            if f.get("ts") is not None:
+                clocks = f" ts={f['ts']}"
+            if f.get("t_mono") is not None:
+                clocks += f" t_mono={f['t_mono']}"
+            parts.append(f"  {who}: {f['error']}{op}{key}{sus}{clocks}")
             if f.get("message"):
                 parts.append(f"      {f['message']}")
     if summary["suspected_dead"]:

@@ -1,0 +1,481 @@
+#!/usr/bin/env python3
+"""Merge per-rank span JSONL into one Chrome trace-event timeline.
+
+The timeline layer (``torch_cgx_tpu/observability/timeline.py``) leaves
+``spans-rank<N>.jsonl`` files in ``CGX_METRICS_DIR``. This tool merges
+them into a single ``trace.json`` in the Chrome trace-event format —
+open it at ui.perfetto.dev (or chrome://tracing):
+
+* one track (process) per rank, sub-tracks per thread,
+* flow arrows joining the same collective across ranks — matched by
+  ``(op, seq)`` for worker-loop collectives and by **message key** for
+  shm/store transfers (a put on rank A flows into the take on rank B),
+* per-rank clock-offset estimation from put→take round trips: a put's
+  publish happens-before the matching take's header arrival, so
+  opposing message directions bound the offset from both sides
+  (NTP-style midpoint); ranks with no message pairs fall back to the
+  wall-clock delta in each file's ``meta`` header,
+* torn-file tolerant (a killed writer's half line is skipped).
+
+Also prints a step-time attribution report: per-collective p50/p99 and
+per-rank decomposition of collective time into quantize (codec) / wire
+(byte movement) / queue-wait / other (compute & bookkeeping).
+
+    python tools/cgx_trace.py <dir>                 # default: $CGX_METRICS_DIR
+    python tools/cgx_trace.py <dir> -o trace.json   # explicit output path
+    python tools/cgx_trace.py <dir> --json          # machine-readable report
+
+Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import zlib
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+_PUT_NAMES = ("shm.put", "store.put")
+_TAKE_WAIT_NAMES = ("shm.take.wait", "store.take.wait")
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail of a killed writer
+    except OSError:
+        pass
+    return out
+
+
+def load_spans(directory: str) -> Dict[int, dict]:
+    """{rank: {"meta": header-or-None, "events": [span dicts]}}."""
+    per_rank: Dict[int, dict] = {}
+    for p in sorted(glob.glob(os.path.join(directory, "spans-rank*.jsonl"))):
+        name = os.path.basename(p)
+        try:
+            rank = int(name[len("spans-rank"):].split(".")[0])
+        except (ValueError, IndexError):
+            continue
+        rows = _read_jsonl(p)
+        meta = next((r for r in rows if r.get("kind") == "meta"), None)
+        events = [
+            r for r in rows
+            if r.get("kind") in ("span", "instant")
+            and isinstance(r.get("t_mono"), (int, float))
+        ]
+        per_rank[rank] = {"meta": meta, "events": events}
+    return per_rank
+
+
+# ---------------------------------------------------------------------------
+# Clock-offset estimation.
+# ---------------------------------------------------------------------------
+
+
+def estimate_offsets(per_rank: Dict[int, dict]) -> Dict[int, float]:
+    """Per-rank additive correction to ``t_mono`` that places all ranks
+    on one timeline (reference = the lowest rank, correction 0.0).
+
+    Uses the bridge's own message round trips: a put span's end (the
+    header publish) happens-before the matching take-wait span's end
+    (the header arrival). For ranks A→B this yields a lower bound on
+    ``off_B - off_A``; traffic in the opposite direction yields the
+    matching upper bound, and the midpoint is the classic NTP estimate
+    (error bounded by the one-way latency). Ranks connected by no
+    messages fall back to the ``meta`` headers' wall-clock deltas.
+    """
+    ranks = sorted(per_rank)
+    if not ranks:
+        return {}
+    # key -> (rank, t_pub_end) / (rank, t_hdr_arrival)
+    puts: Dict[str, List[Tuple[int, float]]] = defaultdict(list)
+    takes: Dict[str, List[Tuple[int, float]]] = defaultdict(list)
+    for rank, data in per_rank.items():
+        for ev in data["events"]:
+            key = ev.get("key")
+            if not key:
+                continue
+            if ev.get("name") in _PUT_NAMES:
+                puts[key].append((rank, ev["t_mono"] + ev.get("dur_s", 0.0)))
+            elif ev.get("name") in _TAKE_WAIT_NAMES:
+                takes[key].append((rank, ev["t_mono"] + ev.get("dur_s", 0.0)))
+    # Directed happens-before bounds: lo[(a, b)] = max over msgs a->b of
+    # (t_pub_a - t_hdr_b)  <=  off_b - off_a.
+    lo: Dict[Tuple[int, int], float] = {}
+    for key, senders in puts.items():
+        if len(senders) != 1:
+            continue  # ambiguous key reuse: skip
+        a, t_pub = senders[0]
+        for b, t_hdr in takes.get(key, []):
+            if a == b:
+                continue
+            bound = t_pub - t_hdr
+            cur = lo.get((a, b))
+            if cur is None or bound > cur:
+                lo[(a, b)] = bound
+    # Pairwise estimates: midpoint when both directions exist, else the
+    # single bound (assumes zero one-way latency — still causally safe).
+    est: Dict[Tuple[int, int], float] = {}
+    for (a, b), lob in lo.items():
+        if (b, a) in lo:
+            hi = -lo[(b, a)]
+            est[(a, b)] = (lob + hi) / 2.0
+        else:
+            est[(a, b)] = lob
+    offsets: Dict[int, float] = {ranks[0]: 0.0}
+    # BFS over the pairwise-estimate graph.
+    frontier = [ranks[0]]
+    while frontier:
+        a = frontier.pop()
+        for b in ranks:
+            if b in offsets:
+                continue
+            if (a, b) in est:
+                offsets[b] = offsets[a] + est[(a, b)]
+                frontier.append(b)
+            elif (b, a) in est:
+                offsets[b] = offsets[a] - est[(b, a)]
+                frontier.append(b)
+    # Fallback for disconnected ranks: align mono clocks via each file's
+    # wall-clock delta (meta header) relative to the reference rank.
+    ref_meta = per_rank[ranks[0]].get("meta") or {}
+    ref_delta = ref_meta.get("mono_wall_delta")
+    for r in ranks:
+        if r in offsets:
+            continue
+        meta = per_rank[r].get("meta") or {}
+        delta = meta.get("mono_wall_delta")
+        if ref_delta is not None and delta is not None:
+            offsets[r] = delta - ref_delta
+        else:
+            offsets[r] = 0.0
+    return offsets
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event export.
+# ---------------------------------------------------------------------------
+
+
+def _flow_id(tag: str) -> int:
+    return zlib.crc32(tag.encode()) & 0x7FFFFFFF
+
+
+def build_chrome_trace(
+    per_rank: Dict[int, dict], offsets: Dict[int, float]
+) -> dict:
+    """The merged trace: complete/instant events one process per rank,
+    plus flow arrow pairs for cross-rank correlation."""
+    events: List[dict] = []
+    if not per_rank:
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+    t0 = min(
+        ev["t_mono"] + offsets.get(r, 0.0)
+        for r, d in per_rank.items()
+        for ev in d["events"]
+    ) if any(d["events"] for d in per_rank.values()) else 0.0
+
+    def us(rank: int, t_mono: float) -> float:
+        return round((t_mono + offsets.get(rank, 0.0) - t0) * 1e6, 3)
+
+    seen_threads = set()
+    # (group, op, seq) -> [(rank, tid, ts_us)] for collective flows —
+    # group-namespaced so a dist.new_group subgroup's seq stream never
+    # cross-links with the default group's.
+    coll: Dict[Tuple[int, str, int], List[Tuple[int, int, float]]] = (
+        defaultdict(list)
+    )
+    # key -> source (rank, tid, ts_end) / sinks [(rank, tid, ts_start)]
+    xfer_src: Dict[str, Tuple[int, int, float]] = {}
+    xfer_dst: Dict[str, List[Tuple[int, int, float]]] = defaultdict(list)
+    for rank in sorted(per_rank):
+        events.append({
+            "name": "process_name", "ph": "M", "pid": rank,
+            "args": {"name": f"rank {rank}"},
+        })
+        events.append({
+            "name": "process_sort_index", "ph": "M", "pid": rank,
+            "args": {"sort_index": rank},
+        })
+        for ev in per_rank[rank]["events"]:
+            tid = int(ev.get("tid") or 0) % (1 << 31)
+            tname = ev.get("tname")
+            if tname and (rank, tid) not in seen_threads:
+                seen_threads.add((rank, tid))
+                events.append({
+                    "name": "thread_name", "ph": "M", "pid": rank,
+                    "tid": tid, "args": {"name": tname},
+                })
+            ts = us(rank, ev["t_mono"])
+            args = {
+                k: v for k, v in ev.items()
+                if k not in ("kind", "name", "cat", "t_mono", "dur_s",
+                             "tid", "tname")
+            }
+            if ev["kind"] == "instant":
+                events.append({
+                    "name": ev["name"], "cat": ev.get("cat", "trace"),
+                    "ph": "i", "s": "p", "ts": ts, "pid": rank,
+                    "tid": tid, "args": args,
+                })
+                continue
+            dur = max(round(ev.get("dur_s", 0.0) * 1e6, 3), 0.001)
+            events.append({
+                "name": ev["name"], "cat": ev.get("cat", "span"),
+                "ph": "X", "ts": ts, "dur": dur, "pid": rank,
+                "tid": tid, "args": args,
+            })
+            if ev.get("cat") == "collective" and ev.get("seq") is not None:
+                coll[
+                    (int(ev.get("group", 0)), ev["name"], int(ev["seq"]))
+                ].append((rank, tid, ts))
+            key = ev.get("key")
+            if key:
+                if ev["name"] in _PUT_NAMES:
+                    xfer_src[key] = (rank, tid, ts + dur)
+                elif ev["name"] in _TAKE_WAIT_NAMES:
+                    xfer_dst[key].append((rank, tid, ts + dur))
+    flows = 0
+    # Collective flows: lowest-participating rank -> every other rank.
+    for (group, op, seq), parts in coll.items():
+        ranks_in = sorted(set(r for r, _, _ in parts))
+        if len(ranks_in) < 2:
+            continue
+        parts.sort()
+        src = parts[0]
+        done = set()
+        for rank, tid, ts in parts[1:]:
+            if rank == src[0] or rank in done:
+                continue
+            done.add(rank)
+            # one flow id per (collective, destination rank): fan-out as
+            # distinct arrows (Chrome flows are chains, not trees).
+            fid = _flow_id(f"coll/{group}/{op}/{seq}/{rank}")
+            events.append({
+                "name": f"{op}#{seq}", "cat": "flow.collective", "ph": "s",
+                "id": fid, "ts": src[2], "pid": src[0], "tid": src[1],
+            })
+            events.append({
+                "name": f"{op}#{seq}", "cat": "flow.collective", "ph": "f",
+                "bp": "e", "id": fid, "ts": max(ts, src[2]), "pid": rank,
+                "tid": tid,
+            })
+            flows += 1
+    # Message flows: put end -> take header arrival.
+    for key, (srank, stid, sts) in xfer_src.items():
+        for drank, dtid, dts in xfer_dst.get(key, []):
+            if drank == srank:
+                continue
+            # one flow id per (key, destination): a multi-reader put
+            # (broadcast) fans out as distinct arrows, not one id with
+            # several finish events.
+            fid = _flow_id(f"msg/{key}/{drank}")
+            events.append({
+                "name": key, "cat": "flow.msg", "ph": "s", "id": fid,
+                "ts": sts, "pid": srank, "tid": stid,
+            })
+            events.append({
+                "name": key, "cat": "flow.msg", "ph": "f", "bp": "e",
+                "id": fid, "ts": max(dts, sts), "pid": drank, "tid": dtid,
+            })
+            flows += 1
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "tool": "cgx_trace",
+            "clock_offsets_s": {str(r): round(o, 6)
+                                for r, o in offsets.items()},
+            "cross_rank_flows": flows,
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# Step-time attribution.
+# ---------------------------------------------------------------------------
+
+
+def _quantiles(vals: List[float]) -> Dict[str, float]:
+    s = sorted(vals)
+
+    def q(p: float) -> float:
+        # Nearest-rank (ceil(p*n)-1): for the common 2-ranks x 1-call
+        # case p50 must be the interpolated middle, not the max, so the
+        # median is taken exactly.
+        if p == 0.5:
+            n = len(s)
+            return (
+                s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+            )
+        import math
+
+        return s[min(max(math.ceil(p * len(s)) - 1, 0), len(s) - 1)]
+
+    return {
+        "count": len(s),
+        "total_s": round(sum(s), 6),
+        "p50_s": round(q(0.5), 6),
+        "p99_s": round(q(0.99), 6),
+    }
+
+
+def attribution(per_rank: Dict[int, dict]) -> dict:
+    """Per-collective p50/p99 and per-rank category decomposition:
+    collective wall time split into quantize / wire / queue-wait /
+    other (compute & bookkeeping). Spans emitted from the p2p pool
+    threads (``cgx-p2p*`` — send/recv bypass the collective worker
+    loop) are tallied separately as ``p2p``: subtracting their wire/
+    wait time from collective time they were never part of would
+    falsely zero the ``other`` bucket on pipeline workloads."""
+    per_op: Dict[str, List[float]] = defaultdict(list)
+    per_rank_cat: Dict[int, Dict[str, float]] = {}
+    for rank, data in per_rank.items():
+        cats = {"collective": 0.0, "quantize": 0.0, "wire": 0.0,
+                "wait": 0.0, "p2p": 0.0}
+        for ev in data["events"]:
+            if ev.get("kind") != "span":
+                continue
+            dur = float(ev.get("dur_s", 0.0))
+            cat = ev.get("cat")
+            if str(ev.get("tname", "")).startswith("cgx-p2p"):
+                cats["p2p"] += dur
+                continue
+            if cat == "collective":
+                per_op[ev["name"]].append(dur)
+            if cat in cats:
+                cats[cat] += dur
+        cats["other"] = max(
+            0.0,
+            cats["collective"]
+            - cats["quantize"] - cats["wire"] - cats["wait"],
+        )
+        per_rank_cat[rank] = {k: round(v, 6) for k, v in cats.items()}
+    return {
+        "per_op": {op: _quantiles(v) for op, v in sorted(per_op.items())},
+        "per_rank": per_rank_cat,
+    }
+
+
+def _fmt_table(rows: List[Tuple], headers: Tuple) -> str:
+    rows = [tuple(str(c) for c in r) for r in rows]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+
+    def line(cells):
+        return "  ".join(c.ljust(w) for c, w in zip(cells, widths)).rstrip()
+
+    out = [line(headers), line(tuple("-" * w for w in widths))]
+    out.extend(line(r) for r in rows)
+    return "\n".join(out)
+
+
+def render_report(
+    att: dict, offsets: Dict[int, float], flows: int, out_path: str
+) -> str:
+    parts = [f"merged trace written to {out_path}"]
+    parts.append(
+        "clock offsets (s, vs lowest rank): "
+        + ", ".join(f"r{r}={o:+.6f}" for r, o in sorted(offsets.items()))
+    )
+    parts.append(f"cross-rank flow links: {flows}")
+    if att["per_op"]:
+        parts.append("\n== collectives (per-rank spans, merged) ==")
+        rows = [
+            (op, d["count"], f"{d['p50_s'] * 1e3:.2f}",
+             f"{d['p99_s'] * 1e3:.2f}", f"{d['total_s'] * 1e3:.1f}")
+            for op, d in att["per_op"].items()
+        ]
+        parts.append(
+            _fmt_table(rows, ("op", "count", "p50_ms", "p99_ms", "total_ms"))
+        )
+    if att["per_rank"]:
+        parts.append("\n== step-time attribution (s, per rank) ==")
+        rows = [
+            (r, c["collective"], c["quantize"], c["wire"], c["wait"],
+             c["other"], c.get("p2p", 0.0))
+            for r, c in sorted(att["per_rank"].items())
+        ]
+        parts.append(_fmt_table(
+            rows,
+            ("rank", "collective", "quantize", "wire", "queue-wait",
+             "other(compute)", "p2p"),
+        ))
+        parts.append(
+            "  (quantize = codec frames; wire = byte movement; queue-wait "
+            "= header/key waits; other = collective time not in those "
+            "buckets — compute overlap and bookkeeping; p2p = send/recv "
+            "pool time, outside the collective decomposition)"
+        )
+    return "\n".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "directory", nargs="?", default=os.environ.get("CGX_METRICS_DIR"),
+        help="metrics dir holding spans-rank*.jsonl (default: "
+             "$CGX_METRICS_DIR)",
+    )
+    ap.add_argument(
+        "-o", "--out", default=None,
+        help="output trace path (default: <dir>/trace.json)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the attribution report as JSON",
+    )
+    args = ap.parse_args(argv)
+    if not args.directory:
+        print("cgx_trace: no directory given and CGX_METRICS_DIR unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.directory):
+        print(f"cgx_trace: {args.directory!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    per_rank = load_spans(args.directory)
+    if not per_rank:
+        print(
+            "cgx_trace: no spans-rank*.jsonl in "
+            f"{args.directory!r} — was CGX_METRICS_DIR set during the run?",
+            file=sys.stderr,
+        )
+        return 1
+    offsets = estimate_offsets(per_rank)
+    trace = build_chrome_trace(per_rank, offsets)
+    out_path = args.out or os.path.join(args.directory, "trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    att = attribution(per_rank)
+    flows = trace["metadata"]["cross_rank_flows"]
+    if args.json:
+        print(json.dumps({
+            "trace": out_path,
+            "ranks": sorted(per_rank),
+            "clock_offsets_s": {str(r): o for r, o in offsets.items()},
+            "cross_rank_flows": flows,
+            **att,
+        }, indent=2))
+    else:
+        print(render_report(att, offsets, flows, out_path))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

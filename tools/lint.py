@@ -346,6 +346,78 @@ def check_library_hygiene(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
+    """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
+    (parsed, not imported — lint must not execute library code).
+    None = file missing or no parseable frozenset literal."""
+    try:
+        tree = ast.parse(timeline_path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "BRIDGE_OPS"
+            for t in node.targets
+        ):
+            continue
+        names: set[str] = set()
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                names.add(n.value)
+        return names
+    return None
+
+
+def check_worker_timeline_coverage(path: Path, tree: ast.Module) -> list[str]:
+    """Timeline-coverage gate for the bridge worker loop: every literal
+    ``op="..."`` a collective passes to ``_submit`` (the name the worker
+    loop emits a timeline span under) must appear in
+    ``observability/timeline.py``'s ``BRIDGE_OPS`` list — the name-list
+    the trace merger's per-op attribution and the docs key off. A new
+    collective added to the backend without a timeline entry would
+    produce spans the tooling cannot categorize; make it a lint failure
+    (same style as the print/metric-namespace rules)."""
+    if (
+        _LIB_DIR not in path.parts
+        or "torch_backend" not in path.parts
+        or path.name != "backend.py"
+    ):
+        return []
+    ops: dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if not (isinstance(fn, ast.Attribute) and fn.attr == "_submit"):
+            continue
+        for kw in node.keywords:
+            if (
+                kw.arg == "op"
+                and isinstance(kw.value, ast.Constant)
+                and isinstance(kw.value.value, str)
+                and kw.value.value
+            ):
+                ops.setdefault(kw.value.value, node.lineno)
+    if not ops:
+        return []
+    timeline_path = path.parent.parent / "observability" / "timeline.py"
+    declared = _timeline_bridge_ops(timeline_path)
+    if declared is None:
+        return [
+            f"{path}:1: worker-loop ops cannot be cross-checked: "
+            f"{timeline_path} missing or lacks a BRIDGE_OPS frozenset"
+        ]
+    return [
+        f"{path}:{line}: worker-loop op {op!r} missing from "
+        "observability/timeline.py BRIDGE_OPS — its timeline span would "
+        "be uncategorized in cgx_trace attribution"
+        for op, line in sorted(ops.items())
+        if op not in declared
+    ]
+
+
 def check_file(path: Path) -> list[str]:
     try:
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -355,6 +427,7 @@ def check_file(path: Path) -> list[str]:
     out = [f"{path}:{line}: undefined name '{name}'" for line, name in c.findings]
     out.extend(check_unbounded_waits(path, tree))
     out.extend(check_library_hygiene(path, tree))
+    out.extend(check_worker_timeline_coverage(path, tree))
     return out
 
 

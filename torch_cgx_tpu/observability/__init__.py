@@ -11,6 +11,10 @@ Three layers (see ``docs/OBSERVABILITY.md``):
 * :mod:`.exporter` — periodic per-rank JSONL snapshots
   (``CGX_METRICS_FLUSH_S``) plus a leader-side cross-rank merge riding
   the group's store control plane.
+* :mod:`.timeline` — structured span layer: per-rank span JSONL
+  (``spans-rank<N>.jsonl``) with monotonic clocks and collective
+  seq/key correlation, merged by ``tools/cgx_trace.py`` into a Chrome
+  trace-event file with cross-rank flow arrows.
 
 ``instruments`` is imported eagerly (``utils.logging`` depends on it);
 ``flightrec``/``exporter`` load lazily so this package root stays
@@ -22,7 +26,7 @@ from __future__ import annotations
 from . import instruments
 from .instruments import Counter, Gauge, Histogram, Metrics, metrics
 
-_LAZY = ("flightrec", "exporter")
+_LAZY = ("flightrec", "exporter", "timeline")
 
 
 def __getattr__(name: str):
@@ -39,6 +43,7 @@ __all__ = [
     "instruments",
     "flightrec",
     "exporter",
+    "timeline",
     "Counter",
     "Gauge",
     "Histogram",

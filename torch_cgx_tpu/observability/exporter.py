@@ -20,6 +20,7 @@ Both are inert unless ``CGX_METRICS_DIR`` is set.
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
 import threading
@@ -81,6 +82,74 @@ class MetricsExporter:
 _exporter: Optional[MetricsExporter] = None
 _exporter_refs = 0
 _exporter_lock = threading.Lock()
+_final_flush_installed = False
+
+
+def _final_flush() -> None:
+    """One last snapshot to disk: the periodic exporter's current state
+    plus any buffered timeline spans. Runs from atexit and SIGTERM so a
+    rank torn down *between* periodic flushes (the common chaos-run
+    shape: SIGTERM from a launcher reaping a failed peer) still leaves
+    its last metrics on disk. Never raises."""
+    with _exporter_lock:
+        ex = _exporter
+    try:
+        if ex is not None:
+            ex.flush()
+    except Exception:
+        pass
+    try:
+        from . import timeline
+
+        timeline.flush()
+    except Exception:
+        pass
+
+
+def _install_final_flush() -> None:
+    """Idempotently register the atexit hook and chain a SIGTERM
+    handler (SIGKILL is unhookable by design — that case is what the
+    *survivors'* flight dumps are for)."""
+    global _final_flush_installed
+    if _final_flush_installed:
+        return
+    _final_flush_installed = True
+    atexit.register(_final_flush)
+    try:
+        import signal
+
+        prev = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            # The flush runs on a SEPARATE thread with a bounded join:
+            # the handler interrupts the main thread at an arbitrary
+            # point, possibly inside one of the registry/timeline locks
+            # (metrics.add on a hot path) — flushing inline would then
+            # self-deadlock on a non-reentrant lock held by the very
+            # frame we interrupted. A worker thread blocks on that lock
+            # instead, the join times out, and the process still dies.
+            t = threading.Thread(
+                target=_final_flush, name="cgx-sigterm-flush", daemon=True
+            )
+            t.start()
+            # Generous but bounded: a contended box can take seconds to
+            # schedule the flush thread, and launchers typically allow
+            # tens of seconds between SIGTERM and SIGKILL.
+            t.join(timeout=10.0)
+            if prev is signal.SIG_IGN:
+                return  # the process chose to ignore SIGTERM: honor it
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                # Restore the default disposition and re-deliver so the
+                # process still dies with the conventional 143.
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError, ImportError):
+        # Non-main thread or platform without signals: atexit still runs.
+        pass
 
 
 def start_exporter(rank: int = 0) -> Optional[MetricsExporter]:
@@ -93,6 +162,7 @@ def start_exporter(rank: int = 0) -> Optional[MetricsExporter]:
     if not directory:
         return None
     global _exporter, _exporter_refs
+    _install_final_flush()
     with _exporter_lock:
         if _exporter is None:
             _exporter = MetricsExporter(

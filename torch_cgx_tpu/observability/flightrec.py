@@ -58,7 +58,14 @@ class FlightRecorder:
         self._dump_lock = threading.Lock()
 
     def record(self, kind: str, **fields: Any) -> None:
-        ev = {"ts": round(time.time(), 6), "kind": kind}
+        # Both clocks: ``ts`` (wall) for humans, ``t_mono``
+        # (perf_counter) so the cross-rank merger can align ranks
+        # without trusting wall clocks (tools/cgx_trace.py).
+        ev = {
+            "ts": round(time.time(), 6),
+            "t_mono": round(time.perf_counter(), 6),
+            "kind": kind,
+        }
         ev.update(fields)
         with self._lock:
             self._seq += 1
@@ -117,6 +124,7 @@ class FlightRecorder:
     def _write_dump(self, path, reason, events, seq) -> str:
         header = {
             "ts": round(time.time(), 6),
+            "t_mono": round(time.perf_counter(), 6),
             "kind": "dump",
             "reason": reason,
             "rank": self._effective_rank(),

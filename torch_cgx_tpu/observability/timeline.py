@@ -1,0 +1,296 @@
+"""Cross-rank trace timeline: the structured span layer.
+
+PR 2's flight recorder keeps per-rank *evidence*; correlating the same
+collective across N ranks still meant a human diffing N JSONL files.
+This module is the missing span layer: every interesting host-side
+interval — a worker-loop collective, an SRA/Ring phase, a codec
+compress/decompress, an shm put/take, a ``trace_span`` body — is
+recorded as a **span** carrying
+
+* ``t_mono`` (``time.perf_counter`` — the alignment clock; wall clocks
+  on two hosts cannot be trusted) and ``dur_s``,
+* the **collective sequence number** and **message key** where one
+  exists (``cgx{seq}q/s0>1``-style keys already travel across the shm
+  bridge in the store header, so the same allreduce is linkable across
+  ranks by key), and
+* track metadata (rank, pid, thread id + name) so a merger can lay the
+  spans out one track per rank.
+
+Spans are buffered and appended to
+``CGX_METRICS_DIR/spans-rank<N>.jsonl`` (first line is a ``meta``
+header with the rank's mono→wall delta). ``tools/cgx_trace.py`` merges
+the per-rank files into a single Chrome trace-event ``trace.json``
+(flow arrows joining matching collectives, clock-offset estimation
+from put→take round trips) plus a step-time attribution report.
+
+With ``CGX_METRICS_DIR`` unset the layer is **inert**: ``span()`` is a
+plain ``yield``, nothing is buffered, no file is touched, and no
+staged program changes (the PR 2 bit-identity suite covers this).
+"""
+
+from __future__ import annotations
+
+import atexit
+import contextlib
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import config as cfg
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# Ops the bridge worker loop (torch_backend/backend.py ``_run_loop``)
+# emits collective spans for. ``tools/lint.py`` cross-checks this list
+# against the literal ``op=`` names passed to ``_submit`` — a new
+# collective added to the backend without a timeline entry is a lint
+# failure, the same style as the print/metric-namespace rules.
+# ``tools/cgx_trace.py`` uses it to label per-op attribution rows.
+BRIDGE_OPS = frozenset({
+    "allreduce",
+    "broadcast",
+    "allgather",
+    "gather",
+    "scatter",
+    "reduce",
+    "alltoall",
+    "alltoall_base",
+    "barrier",
+    "all_gather_into_tensor",
+    "reduce_scatter_tensor",
+})
+
+# Span categories the attribution report decomposes step time into.
+CAT_COLLECTIVE = "collective"  # worker-loop op, end to end
+CAT_PHASE = "phase"  # SRA/Ring scatter-reduce vs allgather
+CAT_QUANTIZE = "quantize"  # codec frame compress/decompress
+CAT_WIRE = "wire"  # byte movement: shm/store put + take copy
+CAT_WAIT = "wait"  # queue wait: header/key waits
+CAT_SPAN = "span"  # generic trace_span bodies
+CAT_TRACE = "trace"  # JAX trace-time structure instants
+
+_FLUSH_EVERY = 128  # buffered spans before an automatic flush
+
+
+class Timeline:
+    """Buffered per-rank span sink (one per process; see module funcs)."""
+
+    def __init__(self, rank: Optional[int] = None):
+        self.rank = rank
+        self._buf: List[Dict[str, Any]] = []
+        self._lock = threading.Lock()
+        self._flush_lock = threading.Lock()  # serializes file appends
+        self._atexit_installed = False
+        # Paths THIS process has already written: the first flush to a
+        # path truncates (a rerun with the same CGX_METRICS_DIR must not
+        # append under a stale meta header — collective seqs restart per
+        # run, so mixed-run files would cross-link unrelated
+        # collectives in the merger); later flushes append.
+        self._owned_paths: set = set()
+
+    # -- gating -----------------------------------------------------------
+
+    @staticmethod
+    def enabled() -> bool:
+        """Timeline recording is on iff ``CGX_METRICS_DIR`` is set
+        (re-read per call, like every CGX_* knob)."""
+        return cfg.metrics_dir() is not None
+
+    # -- rank binding (same contract as flightrec) ------------------------
+
+    def _effective_rank(self) -> int:
+        if self.rank is not None:
+            return self.rank
+        import sys
+
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            try:
+                self.rank = int(jax_mod.process_index())
+                return self.rank
+            except Exception:
+                pass
+        return 0
+
+    # -- recording --------------------------------------------------------
+
+    def record(
+        self,
+        name: str,
+        cat: str,
+        t_mono: float,
+        dur_s: float,
+        **fields: Any,
+    ) -> None:
+        """Record a completed span retroactively (callers that already
+        hold start/stop perf_counter readings — the hot paths — pay no
+        extra clock reads)."""
+        if not self.enabled():
+            return
+        t = threading.current_thread()
+        ev = {
+            "kind": "span",
+            "name": name,
+            "cat": cat,
+            "t_mono": round(t_mono, 7),
+            "dur_s": round(dur_s, 7),
+            "tid": t.ident,
+            "tname": t.name,
+        }
+        ev.update(fields)
+        self._push(ev)
+
+    def instant(self, name: str, cat: str = CAT_TRACE, **fields: Any) -> None:
+        if not self.enabled():
+            return
+        t = threading.current_thread()
+        ev = {
+            "kind": "instant",
+            "name": name,
+            "cat": cat,
+            "t_mono": round(time.perf_counter(), 7),
+            "tid": t.ident,
+            "tname": t.name,
+        }
+        ev.update(fields)
+        self._push(ev)
+
+    @contextlib.contextmanager
+    def span(self, name: str, cat: str = CAT_SPAN, **fields: Any):
+        """Context manager form; a span whose body raises is still
+        recorded (``ok: false``) — failed collectives are the
+        interesting ones."""
+        if not self.enabled():
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        except BaseException:
+            self.record(
+                name, cat, t0, time.perf_counter() - t0, ok=False, **fields
+            )
+            raise
+        self.record(name, cat, t0, time.perf_counter() - t0, **fields)
+
+    def _push(self, ev: Dict[str, Any]) -> None:
+        with self._lock:
+            self._buf.append(ev)
+            n = len(self._buf)
+            if not self._atexit_installed:
+                # A rank torn down between flushes must still leave its
+                # spans on disk (the exporter's SIGTERM hook also calls
+                # flush() — this is the belt for plain exits).
+                self._atexit_installed = True
+                atexit.register(self.flush)
+        if n >= _FLUSH_EVERY:
+            self.flush()
+
+    # -- output -----------------------------------------------------------
+
+    def path(self) -> Optional[str]:
+        d = cfg.metrics_dir()
+        if not d:
+            return None
+        return os.path.join(d, f"spans-rank{self._effective_rank()}.jsonl")
+
+    def flush(self) -> None:
+        """Append buffered spans to the rank's span file. Never raises —
+        flushes run on failure/teardown paths."""
+        with self._lock:
+            if not self._buf:
+                return
+            buf, self._buf = self._buf, []
+        path = self.path()
+        if path is None:
+            return  # CGX_METRICS_DIR raced off between record and flush
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with self._flush_lock:
+                first = path not in self._owned_paths
+                self._owned_paths.add(path)
+                with open(path, "w" if first else "a") as f:
+                    if first:
+                        f.write(json.dumps(self._meta()) + "\n")
+                    for ev in buf:
+                        f.write(json.dumps(ev) + "\n")
+        except Exception as e:
+            log.warning("timeline flush to %s failed: %s", path, e)
+
+    def _meta(self) -> Dict[str, Any]:
+        """File header: the rank's identity and its mono→wall mapping —
+        the merger's *fallback* alignment when no cross-rank message
+        pairs exist (the primary alignment never trusts wall clocks)."""
+        t_mono = time.perf_counter()
+        t_wall = time.time()
+        return {
+            "kind": "meta",
+            "rank": self._effective_rank(),
+            "pid": os.getpid(),
+            "t_mono": round(t_mono, 7),
+            "t_wall": round(t_wall, 6),
+            "mono_wall_delta": round(t_wall - t_mono, 6),
+        }
+
+
+_timeline: Optional[Timeline] = None
+_timeline_lock = threading.Lock()
+
+
+def get_timeline() -> Timeline:
+    global _timeline
+    with _timeline_lock:
+        if _timeline is None:
+            _timeline = Timeline()
+        return _timeline
+
+
+def enabled() -> bool:
+    return Timeline.enabled()
+
+
+def bind_rank(rank: int) -> Timeline:
+    """First-wins rank binding (mirror of ``flightrec.bind_rank``: a
+    subgroup's group-local rank must not steal the file of the default
+    group's process-global rank)."""
+    tl = get_timeline()
+    if tl.rank is None:
+        tl.rank = rank
+    return tl
+
+
+def set_rank(rank: int) -> Timeline:
+    tl = get_timeline()
+    tl.rank = rank
+    return tl
+
+
+def record(name: str, cat: str, t_mono: float, dur_s: float, **fields) -> None:
+    get_timeline().record(name, cat, t_mono, dur_s, **fields)
+
+
+def instant(name: str, cat: str = CAT_TRACE, **fields) -> None:
+    get_timeline().instant(name, cat, **fields)
+
+
+def span(name: str, cat: str = CAT_SPAN, **fields):
+    return get_timeline().span(name, cat, **fields)
+
+
+def flush() -> None:
+    get_timeline().flush()
+
+
+def reset() -> None:
+    """Drop the process timeline (tests: fresh buffer per case)."""
+    global _timeline
+    with _timeline_lock:
+        tl, _timeline = _timeline, None
+    if tl is not None:
+        try:
+            atexit.unregister(tl.flush)
+        except Exception:
+            pass

@@ -442,14 +442,13 @@ def allreduce_tree(
                 _runtime_count("cgx.runtime.allreduce.compressed_elems", fused.shape[0])
                 # Trace-time structure event (once per compiled program):
                 # what this fused group ships and at what static ratio.
-                from ..observability import flightrec
+                from ..observability import flightrec, timeline
 
                 topo_rec = topology or cfg_mod.topology_from_env()
                 n_f = int(fused.shape[0])
                 nb = -(-n_f // g.cc.bucket_size)
                 wire_b = n_f * g.cc.bits / 8 + nb * 8
-                flightrec.record(
-                    "allreduce_group",
+                group_rec = dict(
                     algo=(
                         topo_rec.cross_reduction
                         if len(axes) == 2
@@ -462,6 +461,8 @@ def allreduce_tree(
                     bucket=g.cc.bucket_size,
                     wire_ratio=round(n_f * 4 / wire_b, 3),
                 )
+                flightrec.record("allreduce_group", **group_rec)
+                timeline.instant("allreduce_group", **group_rec)
                 # qerr stats need this device's wire decode even when the
                 # caller (no error feedback) didn't ask for it.
                 if return_roundtrip or qerr:

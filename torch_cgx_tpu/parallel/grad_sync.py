@@ -678,13 +678,19 @@ def make_train_step(
                 compressor = "quantized"
             # Trace-time event: one per compiled train step (a retrace storm
             # shows up in the flight recorder as a run of these).
-            from ..observability import flightrec
+            from ..observability import flightrec, timeline
 
             metrics.add("cgx.trace.train_step_builds")
             flightrec.record(
                 "train_step_trace",
                 compressor=compressor,
                 sync_axes=list(sync_axes),
+                guard=guard,
+                registry_version=version,
+            )
+            timeline.instant(
+                "train_step_trace",
+                compressor=compressor,
                 guard=guard,
                 registry_version=version,
             )

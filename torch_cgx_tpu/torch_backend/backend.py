@@ -68,6 +68,7 @@ from torch.futures import Future
 from .. import config as cfg
 from ..observability import exporter as obs_exporter
 from ..observability import flightrec
+from ..observability import timeline
 from ..ops import codec_host as hcodec
 from ..robustness import faults as faults_mod
 from ..robustness import heartbeat as hb_mod
@@ -177,6 +178,7 @@ def _compress_frames(
     Quantization math stays float32 regardless (the host codec upcasts)."""
     from . import device_codec
 
+    t0 = time.perf_counter()
     parts: List[np.ndarray] = []
     for s in segs:
         x = fused[s.start : s.start + s.numel]
@@ -207,7 +209,13 @@ def _compress_frames(
             parts.append(q.to_bytes())
     if not parts:
         return b""
-    return np.concatenate(parts).tobytes()
+    out = np.concatenate(parts).tobytes()
+    timeline.record(
+        "codec.compress", timeline.CAT_QUANTIZE, t0,
+        time.perf_counter() - t0,
+        elems=sum(s.numel for s in segs), bytes=len(out),
+    )
+    return out
 
 
 def _decompress_frames(
@@ -218,6 +226,7 @@ def _decompress_frames(
     accumulating (round 1) or assigning (allgather round)."""
     from . import device_codec
 
+    t0 = time.perf_counter()
     off = 0
     for s in segs:
         sl = slice(s.start, s.start + s.numel)
@@ -243,6 +252,12 @@ def _decompress_frames(
             fused[sl] += vals
         else:
             fused[sl] = vals
+    if segs:
+        timeline.record(
+            "codec.decompress", timeline.CAT_QUANTIZE, t0,
+            time.perf_counter() - t0,
+            elems=sum(s.numel for s in segs), bytes=int(off),
+        )
 
 
 def _chunk_split(
@@ -332,6 +347,16 @@ def _record_qreduce_phases(
     metrics.observe(f"cgx.{kind}.scatter_reduce_s", t1 - t0)
     metrics.observe(f"cgx.{kind}.allgather_s", t2 - t1)
     metrics.add(f"cgx.{kind}.wire_bytes_out", float(wire_out))
+    # Timeline: the two algorithm phases as spans keyed by the collective
+    # prefix (the same key the wire messages carry — cross-rank linkable).
+    timeline.record(
+        f"{kind}.scatter_reduce", timeline.CAT_PHASE, t0, t1 - t0,
+        key=pfx, ws=ws,
+    )
+    timeline.record(
+        f"{kind}.allgather", timeline.CAT_PHASE, t1, t2 - t1,
+        key=pfx, ws=ws, wire_bytes=wire_out,
+    )
     flightrec.record(
         kind, key=pfx, ws=ws, elems=int(fused.shape[0]),
         bytes_in=bytes_in, wire_bytes_out=wire_out,
@@ -442,6 +467,15 @@ class _CompletionPool:
                     # lock: loop and collect it (some parked thread must).
 
 
+# Per-process group ordinal: c10d requires every rank to construct
+# process groups in the same order, so this counter is cross-rank
+# consistent — the timeline uses it to namespace collective seqs (a
+# dist.new_group subgroup's ("allreduce", 5) must not correlate with
+# the default group's in the merged trace).
+_group_counter = 0
+_group_counter_lock = threading.Lock()
+
+
 class ProcessGroupCGX(dist.ProcessGroup):
     """Store-transport c10d process group with quantized allreduce.
 
@@ -453,6 +487,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         self._store = store
         self._rank = rank
         self._size = size
+        global _group_counter
+        with _group_counter_lock:
+            self._gid = _group_counter
+            _group_counter += 1
         # Collective wait deadline: the c10d group timeout when given, else
         # the classic store-get bound. A peer that dies WITHOUT reaching
         # abort() must surface as a timeout error, not an infinite park.
@@ -472,6 +510,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # start the periodic metrics exporter (both no-ops on the clean
         # path — the exporter only runs with CGX_METRICS_DIR set).
         flightrec.bind_rank(rank)
+        timeline.bind_rank(rank)
         obs_exporter.start_exporter(rank)
         self._pid_by_rank: List[int] = []
         self._seq = 0  # collective sequence number (issued on calling thread)
@@ -646,6 +685,14 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 flightrec.record(
                     "collective", op=op, seq=seq,
                     seconds=round(dt, 6), ok=args[2] is None,
+                )
+                # Cross-rank correlation anchor: every rank issues the
+                # same seq for the same collective (SPMD program order),
+                # so (op, seq) links this span to its peers in the
+                # merged timeline (tools/cgx_trace.py flow arrows).
+                timeline.record(
+                    op, timeline.CAT_COLLECTIVE, t0, dt,
+                    seq=seq, group=self._gid, ok=args[2] is None,
                 )
             if isinstance(args[2], (BridgeTimeoutError, WireCorruptionError)):
                 # Name the failing collective in the black box — the deeper
@@ -835,7 +882,13 @@ class ProcessGroupCGX(dist.ProcessGroup):
             return
         if self._injector is not None and self._injector.fire("drop_put"):
             return  # store-path drop: the matching take's wait expires
-        self._store.set(key, bytes(data) if not isinstance(data, bytes) else data)
+        payload = bytes(data) if not isinstance(data, bytes) else data
+        t0 = time.perf_counter()
+        self._store.set(key, payload)
+        timeline.record(
+            "store.put", timeline.CAT_WIRE, t0, time.perf_counter() - t0,
+            key=key, bytes=len(payload),
+        )
 
     def _delete_key(self, key: str) -> None:
         """Delete with one-time capability probe: stores without delete
@@ -864,10 +917,28 @@ class ProcessGroupCGX(dist.ProcessGroup):
         Abort-aware (waits poll the poison key) on both channels."""
         if self._route_shm(local):
             return self._shm.take(key)
-        self._wait_key(key)
+        t0 = time.perf_counter()
+        try:
+            self._wait_key(key)
+        except BaseException:
+            # A timed-out wait is the span the trace is for: record it
+            # as a failed wait before propagating.
+            timeline.record(
+                "store.take.wait", timeline.CAT_WAIT, t0,
+                time.perf_counter() - t0, key=key, ok=False,
+            )
+            raise
+        t_hdr = time.perf_counter()
+        timeline.record(
+            "store.take.wait", timeline.CAT_WAIT, t0, t_hdr - t0, key=key
+        )
         if self._injector is not None:
             self._injector.delay("delay_take")
         data = self._store.get(key)
+        timeline.record(
+            "store.take.copy", timeline.CAT_WIRE, t_hdr,
+            time.perf_counter() - t_hdr, key=key, bytes=len(data),
+        )
         if readers <= 1:
             self._delete_key(key)
         elif int(self._store.add(key + "/ack", 1)) >= readers:
@@ -1973,6 +2044,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         ``cluster-report.jsonl`` (a rank that died mid-run shows up in
         ``missing_ranks``, it does not hang the merge)."""
         flightrec.dump(reason="shutdown")
+        timeline.flush()
         # Drop this group's reference: flushes now, and stops the daemon
         # only when the LAST group releases — a destroyed group must not
         # leave the flusher appending stale snapshots forever, but a

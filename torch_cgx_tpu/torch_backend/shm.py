@@ -63,6 +63,7 @@ import numpy as np
 
 from .. import config as cfg
 from ..observability import flightrec
+from ..observability import timeline
 from ..robustness import faults as faults_mod
 from ..robustness.errors import BridgeTimeoutError, WireCorruptionError
 from ..utils.logging import get_logger, metrics
@@ -453,6 +454,7 @@ class ShmChannel:
         # (SIGKILL/OOM — close() never fires there).
         _reap_dead_arenas(self._dir)
         flightrec.bind_rank(rank)
+        timeline.bind_rank(rank)
         name = f"cgx-{uuid.uuid4().hex[:12]}-p{os.getpid()}-r{rank}"
         self._injector = faults_mod.get_injector(rank)
         self._checksum = cfg.wire_checksum()
@@ -523,45 +525,72 @@ class ShmChannel:
             "shm_put", key=key, bytes=size, readers=readers,
             seconds=round(dt, 6),
         )
+        timeline.record(
+            "shm.put", timeline.CAT_WIRE, t0, dt, key=key, bytes=size
+        )
         with self._attach_lock:  # worker + p2p pool threads share us
             self.n_puts += 1
 
     def take(self, key: str) -> np.ndarray:
         hkey = self.HDR + key
         t0 = time.perf_counter()
-        if self._wait_key is not None:
-            self._wait_key(hkey)
-            hdr_raw = self._store.get(hkey)
-        else:
-            # Standalone channel (no group wait): bounded header wait.
-            hdr_raw = self._bounded_get(hkey)
+        try:
+            if self._wait_key is not None:
+                self._wait_key(hkey)
+                hdr_raw = self._store.get(hkey)
+            else:
+                # Standalone channel (no group wait): bounded header wait.
+                hdr_raw = self._bounded_get(hkey)
+        except BaseException:
+            # A wait that ends in BridgeTimeoutError is exactly the
+            # interval the trace exists to show: record it as a failed
+            # wait span before propagating.
+            timeline.record(
+                "shm.take.wait", timeline.CAT_WAIT, t0,
+                time.perf_counter() - t0, key=key, ok=False,
+            )
+            raise
         t_hdr = time.perf_counter()  # queue wait ends when the header lands
+        timeline.record(
+            "shm.take.wait", timeline.CAT_WAIT, t0, t_hdr - t0, key=key
+        )
         hdr = bytes(hdr_raw).decode()
         path, _gen, off_s, size_s, crc_s = hdr.rsplit(":", 4)
         off, size, crc = int(off_s), int(size_s), int(crc_s)
-        if self._injector is not None:
-            self._injector.delay("delay_take")
-        out = self._read(path, off, size)
-        if crc >= 0:
-            got = _wire_checksum(out)
-            if got != crc:
-                metrics.add("cgx.wire_corrupt")
-                log.warning(
-                    "cgx shm: checksum mismatch for %r (want %08x got %08x);"
-                    " re-reading once with a fresh mapping", key, crc, got,
-                )
-                out = self._read(path, off, size, refresh=True)
-                if _wire_checksum(out) != crc:
-                    err = WireCorruptionError(
-                        f"cgx shm: payload checksum mismatch for {key!r} "
-                        f"after one re-read ({path}:{off}+{size}) — the "
-                        "wire payload is corrupted"
+        try:
+            if self._injector is not None:
+                self._injector.delay("delay_take")
+            out = self._read(path, off, size)
+            if crc >= 0:
+                got = _wire_checksum(out)
+                if got != crc:
+                    metrics.add("cgx.wire_corrupt")
+                    log.warning(
+                        "cgx shm: checksum mismatch for %r (want %08x got "
+                        "%08x); re-reading once with a fresh mapping",
+                        key, crc, got,
                     )
-                    flightrec.record_failure(
-                        err, op="shm.take", key=key, path=path, bytes=size
-                    )
-                    raise err
-                metrics.add("cgx.wire_reread_ok")
+                    out = self._read(path, off, size, refresh=True)
+                    if _wire_checksum(out) != crc:
+                        err = WireCorruptionError(
+                            f"cgx shm: payload checksum mismatch for {key!r} "
+                            f"after one re-read ({path}:{off}+{size}) — the "
+                            "wire payload is corrupted"
+                        )
+                        flightrec.record_failure(
+                            err, op="shm.take", key=key, path=path,
+                            bytes=size,
+                        )
+                        raise err
+                    metrics.add("cgx.wire_reread_ok")
+        except BaseException:
+            # A copy that ends in WireCorruptionError (or a vanished
+            # arena) still leaves its interval in the trace.
+            timeline.record(
+                "shm.take.copy", timeline.CAT_WIRE, t_hdr,
+                time.perf_counter() - t_hdr, key=key, bytes=size, ok=False,
+            )
+            raise
         self._store.add(hkey + "/ack", 1)
         t1 = time.perf_counter()
         metrics.observe("cgx.shm.take_wait_s", t_hdr - t0)
@@ -570,6 +599,10 @@ class ShmChannel:
         flightrec.record(
             "shm_take", key=key, bytes=size,
             wait_s=round(t_hdr - t0, 6), copy_s=round(t1 - t_hdr, 6),
+        )
+        timeline.record(
+            "shm.take.copy", timeline.CAT_WIRE, t_hdr, t1 - t_hdr,
+            key=key, bytes=size,
         )
         with self._attach_lock:
             self.n_takes += 1

@@ -14,7 +14,9 @@ import time
 
 import jax
 
-from .logging import metrics
+from .logging import get_logger, metrics
+
+log = get_logger()
 
 
 @contextlib.contextmanager
@@ -25,15 +27,23 @@ def trace_span(name: str):
     its flattened ``.count``/``.sum`` stats never collide with the legacy
     counter keys in ``snapshot()``).
 
+    With ``CGX_METRICS_DIR`` set the span also lands in the cross-rank
+    timeline (``observability.timeline``) so it shows up as a slice in
+    the merged ``trace.json``.
+
     The duration sample is recorded in a ``finally`` so a span whose body
     raises still lands in the registry — failed collectives are the
     interesting ones; ``span.{name}.errors`` counts them.
     """
+    from ..observability import timeline
+
     start = time.perf_counter()
+    ok = True
     try:
         with jax.profiler.TraceAnnotation(name):
             yield
     except BaseException:
+        ok = False
         metrics.add(f"span.{name}.errors", 1.0)
         raise
     finally:
@@ -41,6 +51,7 @@ def trace_span(name: str):
         metrics.add(f"span.{name}.seconds", dur)
         metrics.add(f"span.{name}.count", 1.0)
         metrics.observe(f"span.{name}.duration_s", dur)
+        timeline.record(name, timeline.CAT_SPAN, start, dur, ok=ok)
 
 
 def named_scope(name: str):
@@ -67,5 +78,10 @@ def profile_capture(subdir: str = "cgx"):
         yield
         return
     path = os.path.join(base, subdir)
+    # A nonexistent CGX_TRACE_DIR used to make jax.profiler.trace fail
+    # (or silently drop the capture, backend-dependent) — create it and
+    # say where the capture went.
+    os.makedirs(path, exist_ok=True)
+    log.info("cgx: writing device profile capture to %s", path)
     with jax.profiler.trace(path):
         yield
