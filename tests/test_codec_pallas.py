@@ -522,3 +522,205 @@ def test_fuzz_pallas_wire_matches_xla():
         worst = int(np.argmax(diff - tol))
         assert (diff <= tol).all(), (
             ctx, worst, dp[worst], dx[worst], float(tol[worst]))
+
+
+# ---------------------------------------------------------------------------
+# Fused SRA epilogue (ISSUE 4): K-operand dequantize-accumulate(-requantize)
+# vs the staged oracle, in interpret mode on CPU.
+# ---------------------------------------------------------------------------
+
+
+def _staged_epilogue(q, xs, own_idx, bits, bucket, out_dtype=jnp.float32):
+    """The staged reference ops, spelled out: decode rows, swap the raw own
+    chunk, ordered accumulate, stage-2 quantize — the byte oracle for the
+    fused kernel."""
+    ws = xs.shape[0]
+    vals = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32, interpret=True)
+    own = (jnp.arange(ws) == own_idx)[:, None]
+    red = dispatch.ordered_rowsum(
+        jnp.where(own, xs.astype(jnp.float32), vals)
+    )
+    return red, codec_pallas.quantize_batch(
+        red.astype(out_dtype)[None], bits, bucket, interpret=True
+    )
+
+
+@pytest.mark.parametrize("ws,bits,bucket", [
+    (2, 4, 128), (4, 2, 128), (4, 8, 256), (8, 4, 128), (3, 1, 128),
+])
+def test_fused_epilogue_matches_staged_oracle(ws, bits, bucket):
+    """The acceptance oracle: the fused dequant-accumulate-requantize
+    kernel must reproduce the staged path's stage-2 wire BYTES (payload
+    and per-bucket meta) and reduced values exactly, per bucket, on the
+    default deterministic div encode."""
+    chunk = 2 * codec.CHUNK_BUCKETS * bucket
+    rng = np.random.default_rng(ws * 10 + bits)
+    xs = jnp.asarray(rng.normal(size=(ws, chunk)), jnp.float32)
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    assert codec_pallas.supports_reduce(q)
+    own_idx = jnp.int32(ws - 1)
+    red_ref, q_ref = _staged_epilogue(q, xs, own_idx, bits, bucket)
+    red = codec_pallas.reduce_rows_batch(
+        q, raw_row=xs[ws - 1], own_idx=own_idx, interpret=True
+    )
+    np.testing.assert_array_equal(np.asarray(red_ref), np.asarray(red))
+    q_f = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[ws - 1], own_idx=own_idx, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.packed), np.asarray(q_f.packed)
+    )
+    # per-bucket meta: (1, nb, 2) (unit, min) pairs must agree bucket by
+    # bucket, not just in aggregate
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.meta, np.float32), np.asarray(q_f.meta, np.float32)
+    )
+    # both decode to the same allgather-phase values
+    y_ref = codec_pallas.dequantize_batch(q_ref, interpret=True)
+    y_f = codec_pallas.dequantize_batch(q_f, interpret=True)
+    np.testing.assert_array_equal(np.asarray(y_ref), np.asarray(y_f))
+
+
+def test_fused_reduce_no_own_swap_matches_staged():
+    """The all-to-all form: no raw-row substitution — plain K-operand
+    decompress-accumulate."""
+    ws, bits, bucket = 4, 4, 128
+    chunk = codec.CHUNK_BUCKETS * bucket
+    xs = jnp.asarray(
+        np.random.default_rng(7).normal(size=(ws, chunk)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    vals = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32, interpret=True)
+    ref = dispatch.ordered_rowsum(vals)
+    got = codec_pallas.reduce_rows_batch(q, interpret=True)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_fused_epilogue_bf16_wire_dtype():
+    """bf16 wire: the staged path quantizes reduced.astype(bf16); the
+    fused kernel's cast_dtype must round identically."""
+    ws, bits, bucket = 4, 4, 128
+    chunk = codec.CHUNK_BUCKETS * bucket
+    xs = jnp.asarray(
+        np.random.default_rng(8).normal(size=(ws, chunk)), jnp.float32
+    ).astype(jnp.bfloat16)
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    own_idx = jnp.int32(1)
+    _, q_ref = _staged_epilogue(q, xs, own_idx, bits, bucket, jnp.bfloat16)
+    q_f = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[1], own_idx=own_idx, out_dtype=jnp.bfloat16,
+        interpret=True,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.packed), np.asarray(q_f.packed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.meta, np.float32), np.asarray(q_f.meta, np.float32)
+    )
+
+
+def test_fused_epilogue_mul_encode_envelope_and_ties(monkeypatch):
+    """ISSUE 4 satellite: CGX_CODEC_ENCODE=mul must apply INSIDE the fused
+    epilogue's requantize — same one-knob flip criterion as the plain
+    quantize kernel (PERF_NOTES.md): error envelope holds, only a tiny
+    tie fraction of levels moves vs the div encode, constants stay
+    bit-exact."""
+    ws, bits, bucket = 4, 4, 512
+    chunk = 2 * codec.CHUNK_BUCKETS * bucket
+    rng = np.random.default_rng(9)
+    xs = jnp.asarray(rng.normal(size=(ws, chunk)), jnp.float32)
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    own_idx = jnp.int32(0)
+    red_ref, q_div = _staged_epilogue(q, xs, own_idx, bits, bucket)
+    monkeypatch.setenv("CGX_CODEC_ENCODE", "mul")
+    q_mul = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[0], own_idx=own_idx, interpret=True
+    )
+    monkeypatch.delenv("CGX_CODEC_ENCODE")
+    # meta (pure max/min arithmetic) is encode-independent
+    np.testing.assert_array_equal(
+        np.asarray(q_div.meta, np.float32), np.asarray(q_mul.meta, np.float32)
+    )
+    y_div = codec_pallas.dequantize_batch(q_div, interpret=True)[0]
+    y_mul = codec_pallas.dequantize_batch(q_mul, interpret=True)[0]
+    unit = np.asarray(q_mul.meta, np.float32)[..., 0].max()
+    # envelope: the mul decode still round-trips the reduced chunk within
+    # half a level
+    assert np.abs(np.asarray(y_mul) - np.asarray(red_ref)).max() <= (
+        unit / 2 + 1e-5
+    )
+    # tie fraction: differing values are off by at most one level and rare
+    diff = np.abs(np.asarray(y_mul) - np.asarray(y_div))
+    assert (diff <= unit * 1.01).all()
+    assert np.mean(diff > unit * 0.1) < 1e-3
+    # constant buckets encode exactly under mul too
+    const = jnp.full((ws, chunk), 1.5, jnp.float32)
+    qc = codec_pallas.quantize_batch(const, bits, bucket, interpret=True)
+    monkeypatch.setenv("CGX_CODEC_ENCODE", "mul")
+    qc_f = codec_pallas.sra_epilogue_batch(
+        qc, raw_row=const[0], own_idx=jnp.int32(0), interpret=True
+    )
+    yc = codec_pallas.dequantize_batch(qc_f, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(yc), np.full((1, chunk), ws * 1.5, np.float32)
+    )
+
+
+def test_fused_reduce_unsupported_shapes_fall_back(monkeypatch):
+    """Dispatch keeps the staged reference path for shapes outside the
+    flat-kernel geometry (tail buckets, non-128-aligned buckets) and on
+    CPU auto mode — supports_reduce gates the kernel, values are
+    unchanged either way."""
+    ws, bits = 4, 4
+    # bucket not 128-aligned -> unsupported
+    xs = jnp.asarray(
+        np.random.default_rng(11).normal(size=(ws, 32 * 64)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, 64, interpret=True)
+    assert not codec_pallas.supports_reduce(q)
+    # chunk tail (nb_r % 32 != 0) -> unsupported
+    q2 = codec_pallas.quantize_batch(
+        jnp.asarray(np.random.default_rng(12).normal(size=(ws, 8 * 128)),
+                    jnp.float32),
+        bits, 128, interpret=True,
+    )
+    assert not codec_pallas.supports_reduce(q2)
+    # forced-fused dispatch on a supported shape equals forced-staged
+    chunk = codec.CHUNK_BUCKETS * 128
+    xs3 = jnp.asarray(
+        np.random.default_rng(13).normal(size=(ws, chunk)), jnp.float32
+    )
+    q3 = codec_pallas.quantize_batch(xs3, bits, 128, interpret=True)
+    own_idx = jnp.int32(2)
+    monkeypatch.setenv("CGX_CODEC_IMPL", "pallas")
+    monkeypatch.setenv("CGX_SRA_EPILOGUE", "staged")
+    staged = dispatch.reduce_rows(q3, raw_rows=xs3, own_idx=own_idx)
+    monkeypatch.setenv("CGX_SRA_EPILOGUE", "fused")
+    fused = dispatch.reduce_rows(q3, raw_rows=xs3, own_idx=own_idx)
+    np.testing.assert_array_equal(np.asarray(staged), np.asarray(fused))
+
+
+@pytest.mark.tpu  # compiled Mosaic lowering of the fused epilogue
+def test_fused_epilogue_tpu():
+    ws, bits, bucket = 8, 4, 512
+    chunk = 2 * codec.CHUNK_BUCKETS * bucket
+    xs = jnp.asarray(
+        np.random.default_rng(14).normal(size=(ws, chunk)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket)
+    own_idx = jnp.int32(3)
+    vals = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32)
+    own = (jnp.arange(ws) == own_idx)[:, None]
+    red = dispatch.ordered_rowsum(
+        jnp.where(own, xs.astype(jnp.float32), vals)
+    )
+    q_ref = codec_pallas.quantize_batch(red[None], bits, bucket)
+    q_f = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[3], own_idx=own_idx
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.packed), np.asarray(q_f.packed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_ref.meta, np.float32), np.asarray(q_f.meta, np.float32)
+    )

@@ -666,3 +666,84 @@ def test_runtime_wire_metrics(monkeypatch):
     )
     # trace counter stays at one program's worth
     assert metrics.get("cgx.trace.allreduce.compressed_elems") == g.size
+
+
+# ---------------------------------------------------------------------------
+# Trace-time layout cache (ISSUE 4): the group/concat/split/slice plan is
+# computed once per (treedef, shapes, config state), not per call.
+# ---------------------------------------------------------------------------
+
+
+def _trace_allreduce_once(mesh, tree):
+    """One fresh trace of allreduce_tree (new callables each time — the
+    shape of a make_train_step retrace or a user re-wrapping the sync)."""
+    body = shard_map(
+        lambda t: jax.tree.map(
+            lambda l: l[None],
+            allreduce_tree(
+                jax.tree.map(lambda l: l[0], t), mesh=mesh, axes=("dp",)
+            ),
+        ),
+        mesh=mesh,
+        in_specs=P("dp"),
+        out_specs=P("dp"),
+        check_vma=False,
+    )
+    jax.make_jaxpr(body)(tree)
+
+
+def test_layout_cache_hits_across_traces(monkeypatch):
+    from torch_cgx_tpu.parallel import allreduce as ar
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    mesh = flat_mesh()
+    tree = {
+        "w": jnp.ones((WS, 64, 64)),
+        "b": jnp.ones((WS, 128)),
+        "v": jnp.ones((WS, 32, 32)),
+    }
+    ar.layout_cache_clear()
+    _trace_allreduce_once(mesh, tree)
+    s1 = ar.layout_cache_stats()
+    assert s1 == {"hits": 0, "misses": 1}, s1
+    _trace_allreduce_once(mesh, tree)
+    s2 = ar.layout_cache_stats()
+    assert s2 == {"hits": 1, "misses": 1}, s2
+    # a different tree structure is a different plan
+    _trace_allreduce_once(mesh, {"w": jnp.ones((WS, 64, 64))})
+    assert ar.layout_cache_stats()["misses"] == 2
+
+
+def test_layout_cache_invalidated_by_registry_and_env(monkeypatch):
+    from torch_cgx_tpu.parallel import allreduce as ar
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    mesh = flat_mesh()
+    tree = {"w": jnp.ones((WS, 64, 64)), "b": jnp.ones((WS, 128))}
+    ar.layout_cache_clear()
+    _trace_allreduce_once(mesh, tree)
+    # pattern re-registration bumps the registry version -> fresh plan,
+    # never a stale hit (the make_train_step trace-cache rule)
+    cgx_config.set_layer_pattern_config("w", CompressionConfig(bits=2))
+    try:
+        _trace_allreduce_once(mesh, tree)
+        assert ar.layout_cache_stats() == {"hits": 0, "misses": 2}
+    finally:
+        cgx_config.clear_registry()
+    # env-derived knobs are part of the key too (a fusion-threshold flip
+    # between calls must re-slice)
+    before = ar.layout_cache_stats()["misses"]
+    monkeypatch.setenv("CGX_FUSION_BUFFER_SIZE_MB", "1")
+    _trace_allreduce_once(mesh, tree)
+    assert ar.layout_cache_stats()["misses"] == before + 1
+
+
+def test_layout_cache_bounded(monkeypatch):
+    from torch_cgx_tpu.parallel import allreduce as ar
+
+    monkeypatch.setenv(cgx_config.COMPRESSION_QUANTIZATION_BITS, "4")
+    mesh = flat_mesh()
+    ar.layout_cache_clear()
+    for i in range(ar._LAYOUT_CACHE_MAX + 8):
+        _trace_allreduce_once(mesh, {"w": jnp.ones((WS, 8, 8 + i))})
+    assert len(ar._LAYOUT_CACHE) <= ar._LAYOUT_CACHE_MAX

@@ -205,3 +205,73 @@ def test_linter_accepts_namespaced_metrics_and_fstrings(tmp_path):
     )
     proc = _run_lint(good)
     assert proc.returncode == 0, proc.stdout
+
+
+def _reducers_tree(tmp_path, body: str) -> Path:
+    ldir = tmp_path / "torch_cgx_tpu" / "parallel"
+    ldir.mkdir(parents=True)
+    f = ldir / "reducers.py"
+    f.write_text(body)
+    return f
+
+
+def test_linter_flags_dequantize_rows_sum_in_reducers(tmp_path):
+    # ISSUE 4 satellite: a reducer variant that decodes peer rows and
+    # reduces them inline re-materializes the (ws, chunk) f32 intermediate
+    # the fused SRA epilogue eliminates — it must go through
+    # ops.dispatch.reduce_rows instead.
+    bad = _reducers_tree(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def _dequantize_rows(q):\n"
+        "    return q\n"
+        "def my_new_allreduce(q):\n"
+        "    vals = _dequantize_rows(q)\n"
+        "    return jnp.sum(vals, axis=0)\n",
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "dispatch.reduce_rows" in proc.stdout
+
+
+def test_linter_flags_method_sum_form_too(tmp_path):
+    bad = _reducers_tree(
+        tmp_path,
+        "def _dequantize_rows(q):\n"
+        "    return q\n"
+        "def my_variant(q):\n"
+        "    return _dequantize_rows(q).sum(0)\n",
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "dispatch.reduce_rows" in proc.stdout
+
+
+def test_linter_reduce_routing_escape_hatch_and_scope(tmp_path):
+    # The suite's oracles keep the spelled-out staged form — the
+    # _reference/_staged/_unrolled suffixes are the documented escape —
+    # and decode-only (no sum) reducer code is not a reduce site. The rule
+    # is also scoped to parallel/reducers.py: the staged path's home
+    # (ops/dispatch.py) spells exactly this pattern legally.
+    ok = _reducers_tree(
+        tmp_path,
+        "import jax.numpy as jnp\n"
+        "def _dequantize_rows(q):\n"
+        "    return q\n"
+        "def ring_oracle_unrolled(q):\n"
+        "    return jnp.sum(_dequantize_rows(q), axis=0)\n"
+        "def decode_only(q, n):\n"
+        "    return _dequantize_rows(q).reshape(-1)[:n]\n",
+    )
+    proc = _run_lint(ok)
+    assert proc.returncode == 0, proc.stdout
+    other = tmp_path / "torch_cgx_tpu" / "parallel" / "dispatchish.py"
+    other.write_text(
+        "import jax.numpy as jnp\n"
+        "def _dequantize_rows(q):\n"
+        "    return q\n"
+        "def staged_path(q):\n"
+        "    return jnp.sum(_dequantize_rows(q), axis=0)\n"
+    )
+    proc = _run_lint(other)
+    assert proc.returncode == 0, proc.stdout

@@ -245,6 +245,106 @@ def test_ring_scan_program_size_constant_in_ws():
     assert n8 < n8_unrolled / 2, (n8, n8_unrolled)
 
 
+def _pallas_kernel_counts(jaxpr):
+    """kernel-function-name -> pallas_call count, walking nested jaxprs.
+    Kernel closures in codec_pallas.py carry distinctive names
+    (_quantize_flat_kernel, _sra_epilogue_kernel, ...) precisely so this
+    guard can count codec invocations by identity."""
+    from collections import Counter
+
+    counts = Counter()
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "pallas_call":
+                info = str(eqn.params.get("name_and_src_info", ""))
+                counts[info.split(" ")[0]] += 1
+            for v in eqn.params.values():
+                for item in v if isinstance(v, (list, tuple)) else [v]:
+                    if isinstance(item, jax.extend.core.ClosedJaxpr):
+                        walk(item.jaxpr)
+                    elif isinstance(item, jax.extend.core.Jaxpr):
+                        walk(item)
+
+    walk(jaxpr)
+    return counts
+
+
+def test_sra_codec_invocation_guard(monkeypatch):
+    """Codec-invocation regression guard (ISSUE 4), alongside the ring
+    jaxpr-size guard above: the fused SRA program must stage exactly ONE
+    quantize kernel (stage 1) and ONE fused epilogue kernel per shard —
+    plus a single decode for the allgather phase — and in particular no
+    standalone peer-row dequantize and no standalone stage-2 quantize.
+    A refactor that silently reintroduces the second codec round trip
+    (the 25.5%-overhead shape PERF_NOTES.md round 5 measured) fails
+    here at trace time, no hardware needed."""
+    from jax.sharding import Mesh
+
+    from torch_cgx_tpu.ops import codec as codec_mod
+
+    monkeypatch.setenv("CGX_CODEC_IMPL", "pallas")
+    monkeypatch.setenv("CGX_SRA_EPILOGUE", "fused")
+    ws, b = 4, 128
+    n = ws * 2 * codec_mod.CHUNK_BUCKETS * b  # whole chunks per shard row
+    cc = CompressionConfig(bits=4, bucket_size=b)
+    mesh = Mesh(np.array(jax.devices()[:ws]), ("dp",))
+    body = shard_map(
+        lambda x: reducers.sra_allreduce(x[0], "dp", ws, cc)[None],
+        mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False,  # pallas_call has no shard_map replication rule
+    )
+    counts = _pallas_kernel_counts(
+        jax.make_jaxpr(body)(jnp.zeros((ws, n), jnp.float32)).jaxpr
+    )
+    assert counts.get("_quantize_flat_kernel", 0) == 1, counts
+    assert counts.get("_sra_epilogue_kernel", 0) == 1, counts
+    # allgather decode only; the peer-row decode lives inside the epilogue
+    assert counts.get("_dequantize_flat_kernel", 0) == 1, counts
+    assert counts.get("_reduce_rows_kernel", 0) == 0, counts
+    # nothing else codec-shaped hides elsewhere in the program
+    assert sum(counts.values()) == 3, counts
+
+
+def test_sra_fused_epilogue_matches_staged_end_to_end(monkeypatch):
+    """sra_allreduce under forced-fused dispatch is bit-identical to the
+    staged lowering, through the real shard_map collectives (the
+    wire-identity acceptance criterion, CGX_CODEC_ENCODE=div default)."""
+    ws, b = 8, 128
+    n = ws * codec_chunked_n(b)
+    data = (
+        np.arange(ws * n, dtype=np.float32).reshape(ws, n) / (ws * n) - 0.5
+    )
+    cc = CompressionConfig(bits=4, bucket_size=b)
+
+    def run(per_rank):
+        mesh = _flat_mesh()
+        body = shard_map(
+            lambda x: reducers.sra_allreduce(x[0], "dp", WS, cc)[None],
+            mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False,  # pallas_call has no replication rule
+        )
+        arr = jax.device_put(
+            jnp.asarray(per_rank), NamedSharding(mesh, P("dp"))
+        )
+        return np.asarray(jax.jit(body)(arr))
+
+    monkeypatch.setenv("CGX_SRA_EPILOGUE", "staged")
+    staged = run(data)
+    monkeypatch.setenv("CGX_SRA_EPILOGUE", "fused")
+    monkeypatch.setenv("CGX_CODEC_IMPL", "pallas")
+    fused = run(data)
+    np.testing.assert_array_equal(staged, fused)
+
+
+def codec_chunked_n(b: int) -> int:
+    """Per-rank chunk elements that keep every SRA row whole 32-bucket
+    chunks at bucket size b (the fused fast-path geometry)."""
+    from torch_cgx_tpu.ops import codec as codec_mod
+
+    return codec_mod.CHUNK_BUCKETS * b
+
+
 def test_uncompressed_psum_exact():
     cc = CompressionConfig(bits=32)
     inputs = arange_inputs(1000)

@@ -12,6 +12,14 @@ gate makes it mechanical:
   key is the **median** of its history (robust to one lucky/unlucky
   run). ``BASELINE.json``'s ``published`` table, when populated, adds
   hard floors.
+
+  Gated metric families (anything with a GB/s unit qualifies
+  automatically): the ``pallas_codec_*`` round trips, the
+  ``sra_allreduce_*`` multi-device record, the
+  ``sra_epilogue_fused_vs_staged_*`` staged-vs-fused epilogue records
+  (bench.py emits one per run; a fused-path regression fails the gate
+  once the trajectory holds a baseline), the qbench variants, and
+  shm_bench.
 * **candidate** — a fresh run's JSON records (``--candidate file`` or
   ``-`` for stdin, same schemas the tools print).
 * **verdict** — a candidate value more than ``--threshold`` percent

@@ -88,6 +88,7 @@ session() {
   # --- production-path measurements (known-good compile shapes) ---------
   run 600 "current"               python tools/qbench.py current || return 1
   run 600 "dequant reference"     python tools/qbench.py dequant || return 1
+  run 600 "sra epilogue fused"    python tools/qbench.py sra_epilogue || return 1
   run 600 "mul production knob"   env CGX_CODEC_ENCODE=mul python tools/qbench.py current || return 1
   run 600 "current tc=4"          python tools/qbench.py current --tc 4 || return 1
 

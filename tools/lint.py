@@ -346,6 +346,60 @@ def check_library_hygiene(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+_REDUCE_ROUTE_ESCAPES = ("_reference", "_staged", "_unrolled")
+
+
+def check_reducer_reduce_routing(path: Path, tree: ast.Module) -> list[str]:
+    """Perf gate for the SRA/Ring hot path (parallel/reducers.py only): a
+    reducer variant that decodes peer rows with ``_dequantize_rows`` and
+    then reduces them with ``.sum(``/``jnp.sum`` re-materializes exactly
+    the (ws, chunk) f32 intermediate the fused epilogue kernel eliminates
+    — new variants must route the decompress-accumulate through
+    ``ops.dispatch.reduce_rows`` (fused Pallas kernel on TPU dispatch,
+    staged reference elsewhere; docs/COMPRESSION_GUIDE.md). Functions
+    whose names end in ``_reference``/``_staged``/``_unrolled`` are the
+    documented escape hatch — the suite's oracles keep the spelled-out
+    staged form."""
+    if (
+        _LIB_DIR not in path.parts
+        or "parallel" not in path.parts
+        or path.name != "reducers.py"
+    ):
+        return []
+    flagged: dict[int, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if any(node.name.endswith(sfx) for sfx in _REDUCE_ROUTE_ESCAPES):
+            continue
+        deq_line = None
+        has_sum = False
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            name = (
+                fn.attr
+                if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name == "_dequantize_rows" and deq_line is None:
+                deq_line = n.lineno
+            if name == "sum":
+                has_sum = True
+        if deq_line is not None and has_sum:
+            flagged.setdefault(
+                deq_line,
+                f"{path}:{deq_line}: `_dequantize_rows` decode reduced "
+                "with `.sum(`/`jnp.sum` in reducer variant "
+                f"{node.name!r} — route the decompress-accumulate "
+                "through ops.dispatch.reduce_rows (fused on TPU, staged "
+                "reference elsewhere); suffix the function _reference/"
+                "_staged/_unrolled if it IS the staged oracle",
+            )
+    return [flagged[k] for k in sorted(flagged)]
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -428,6 +482,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_unbounded_waits(path, tree))
     out.extend(check_library_hygiene(path, tree))
     out.extend(check_worker_timeline_coverage(path, tree))
+    out.extend(check_reducer_reduce_routing(path, tree))
     return out
 
 

@@ -170,7 +170,12 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("variant", choices=[
         "current", "butterfly", "mul", "nometa", "metalane", "read", "dequant",
+        "sra_epilogue",
     ])
+    ap.add_argument(
+        "--ws", type=int, default=8,
+        help="peer rows for the sra_epilogue variant (the SRA world size)",
+    )
     ap.add_argument("--tc", type=int, default=0, help="tile chunks override")
     ap.add_argument("--mb", type=int, default=128, help="payload MB (fp32)")
     ap.add_argument("--bits", type=int, default=4)
@@ -200,7 +205,56 @@ def main():
     gb = n * 4 / 1e9
     tc = args.tc or codec_pallas._pipe_tc(n // (CB * b), b)
 
-    if args.variant in ("current", "dequant"):
+    if args.variant == "sra_epilogue":
+        # The fused dequant-accumulate-requantize kernel over ws peer rows
+        # (the production SRA epilogue on TPU dispatch). Byte-checked
+        # against the staged decode/select/sum/quantize oracle on a small
+        # slice before timing, like every experimental kernel here.
+        from torch_cgx_tpu.ops import dispatch
+
+        ws = args.ws
+        chunk = n // ws
+        xs_stack = stack.reshape(k, ws, chunk)
+        own = jnp.int32(ws // 2)
+
+        def staged_small(q, xs):
+            vals = codec_pallas.dequantize_batch(q, out_dtype=jnp.float32)
+            mask = (jnp.arange(ws) == own)[:, None]
+            red = dispatch.ordered_rowsum(
+                jnp.where(mask, xs.astype(jnp.float32), vals)
+            )
+            return codec_pallas.quantize_batch(red[None], bits, b)
+
+        ns = CB * b * 2 * ws  # a couple of chunks per row
+        xsmall = xs_stack[0][:, : ns // ws]
+        q_small = codec_pallas.quantize_batch(xsmall, bits, b)
+        ref = staged_small(q_small, xsmall)
+        got = codec_pallas.sra_epilogue_batch(
+            q_small, raw_row=xsmall[ws // 2], own_idx=own
+        )
+        assert bool(jnp.array_equal(ref.packed, got.packed)) and bool(
+            jnp.array_equal(
+                jnp.asarray(ref.meta, jnp.float32),
+                jnp.asarray(got.meta, jnp.float32),
+            )
+        ), "sra_epilogue wire mismatch vs the staged oracle"
+        print("byte_check: ok")
+        qts = [
+            codec_pallas.quantize_batch(xs_stack[i], bits, b) for i in range(k)
+        ]
+        q_stack = jax.tree.map(
+            lambda *xs: jnp.stack(xs) if isinstance(xs[0], jax.Array) else xs[0],
+            *qts,
+        )
+        t = scan_time(
+            lambda args_: (
+                lambda q2: (q2.packed, q2.meta)
+            )(codec_pallas.sra_epilogue_batch(
+                args_[0], raw_row=args_[1][ws // 2], own_idx=own
+            )),
+            (q_stack, xs_stack),
+        )
+    elif args.variant in ("current", "dequant"):
         if args.variant == "current":
             fn = lambda x: (  # noqa: E731
                 lambda q: (q.packed, q.meta)

@@ -302,6 +302,25 @@ def codec_impl() -> str:
     return impl
 
 
+SRA_EPILOGUE = "CGX_SRA_EPILOGUE"
+
+
+def sra_epilogue() -> str:
+    """SRA epilogue lowering: "auto" (the fused dequant-accumulate-
+    requantize Pallas kernel on TPU, the staged reference path elsewhere),
+    "fused" (force the fused kernel — interpret mode off-TPU; test knob),
+    or "staged" (force the reference path everywhere). Wire bytes are
+    identical between lowerings on the default ``div`` encode
+    (docs/COMPRESSION_GUIDE.md "reduce_rows and the wire-identity
+    contract")."""
+    mode = _env.get_str_env_or_default(SRA_EPILOGUE, "auto").lower()
+    if mode not in ("auto", "fused", "staged"):
+        raise ValueError(
+            f"{SRA_EPILOGUE} must be auto|fused|staged, got {mode!r}"
+        )
+    return mode
+
+
 def bridge_device_codec() -> str:
     """Whether the torch bridge stages segments through the accelerator for
     codec work (DLPack -> jitted JAX codec -> one copy back): "on", "off",

@@ -112,12 +112,32 @@ class QTensor:
     def numel_main(self) -> int:
         return self.numel - self.residual.shape[-1]
 
+    @property
+    def batch_rows(self) -> int:
+        """Leading batch dimension of a row-batched QTensor (the shape
+        ``quantize_batch`` produces); 1 for the flat single-buffer form."""
+        return self.packed.shape[0] if self.packed.ndim == 2 else 1
+
     def wire_bytes(self) -> int:
         return (
             self.packed.size * 4
             + self.meta.size * self.meta.dtype.itemsize
             + self.residual.size * self.residual.dtype.itemsize
         )
+
+
+def batch_views(q: QTensor) -> Tuple[jax.Array, jax.Array]:
+    """Decode-side kernel views of a row-batched QTensor: ``(words, meta)``
+    with words bitcast to int32 (Mosaic has no uint32 math — bit ops run in
+    two's-complement int32, exact for shift/and/or) and meta upcast to
+    float32 ``(rows, nb_r, 2)`` (the wire carries it in the tensor dtype).
+    Shared prologue of every flat Pallas decode-side kernel
+    (``dequantize_batch``, ``reduce_rows_batch``, ``sra_epilogue_batch``)."""
+    words = jax.lax.bitcast_convert_type(q.packed, jnp.int32)
+    nb_r = num_buckets(q.numel_main, q.bucket_size)
+    return words, q.meta.astype(jnp.float32).reshape(
+        q.batch_rows, nb_r, 2
+    )
 
 
 # ---------------------------------------------------------------------------

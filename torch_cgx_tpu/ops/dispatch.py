@@ -12,6 +12,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .. import config as cfg_mod
 from ..config import CompressionConfig
@@ -95,3 +96,149 @@ def dequantize_batch(
             lambda qq, acc: codec.dequantize(qq, add_to=acc, out_dtype=out_dtype)
         )(q, add_to)
     return jax.vmap(lambda qq: codec.dequantize(qq, out_dtype=out_dtype))(q)
+
+
+# ---------------------------------------------------------------------------
+# Fused SRA epilogue dispatch (CGX_SRA_EPILOGUE = auto|fused|staged).
+#
+# The reducers' decompress-accumulate(-requantize) hot path routes through
+# these two entry points instead of composing dequantize_batch + jnp.sum +
+# quantize_batch at each call site: one place decides between the fused
+# Pallas kernels (TPU — the decoded floats never round-trip HBM) and the
+# staged reference path (everywhere else, and the oracle the fused kernels
+# are byte-checked against). tools/lint.py enforces the routing for new
+# reducer variants.
+# ---------------------------------------------------------------------------
+
+
+def _use_fused_reduce(q: codec.QTensor, *, stochastic: bool = False) -> bool:
+    """Fused-kernel eligibility for this QTensor under the current mode.
+    "fused" forces the kernel (interpret mode off TPU — the test knob);
+    "auto" takes it only on real TPU dispatch with the Pallas codec
+    allowed. Stochastic requantize needs the TPU hardware PRNG, which has
+    no interpret lowering — staged off-TPU regardless of mode."""
+    mode = cfg_mod.sra_epilogue()
+    if mode == "staged":
+        return False
+    if not codec_pallas.supports_reduce(q):
+        return False
+    if stochastic and not _on_tpu():
+        return False
+    if mode == "fused":
+        return True
+    return _on_tpu() and cfg_mod.codec_impl() != "xla"
+
+
+def fused_epilogue_would_run(
+    q: codec.QTensor, *, stochastic: bool = False
+) -> bool:
+    """True when :func:`reduce_rows_requantize` would take the fused
+    kernel for this QTensor under the current mode/backend. The ws==1
+    force-codec proxy (reducers.quantized_allreduce) keys off this so the
+    single-chip train-step probe emulates the kernel sequence a real rank
+    runs in the same era — staged three-kernel shape or fused two-kernel
+    shape."""
+    return _use_fused_reduce(q, stochastic=stochastic)
+
+
+def ordered_rowsum(vals: jax.Array) -> jax.Array:
+    """Row accumulation with the association pinned: ``v0 + v1 + ...``
+    ascending. A bare ``jnp.sum(axis=0)`` leaves the fold order to the XLA
+    lowering (measured: CPU re-trees a 4-row reduce pairwise), which would
+    put the staged and fused lowerings a last-ulp apart — and a last-ulp
+    apart in the accumulate is a different requantized WIRE BYTE. Both
+    lowerings spell this fold explicitly; the row count is the (small,
+    static) world size, so the unrolled chain costs nothing."""
+    red = vals[0]
+    for r in range(1, vals.shape[0]):
+        red = red + vals[r]
+    return red
+
+
+def _own_row(raw_rows: jax.Array, own_idx, numel: int) -> jax.Array:
+    """The raw own chunk: row ``own_idx`` of the (ws, chunk) stage-1
+    matrix, sliced outside the kernel so the fused path streams one chunk
+    of raw values instead of all ws rows."""
+    return lax.dynamic_slice(raw_rows, (own_idx, 0), (1, numel))[0]
+
+
+def reduce_rows(
+    q: codec.QTensor,
+    *,
+    raw_rows: Optional[jax.Array] = None,
+    own_idx: Optional[jax.Array] = None,
+    add_to: Optional[jax.Array] = None,
+    out_dtype=jnp.float32,
+) -> jax.Array:
+    """Dequantize-accumulate a row-batched QTensor -> flat (numel,)
+    reduced values: decode every row, substitute the raw own chunk
+    (``raw_rows[own_idx]``) for its own decode when given (the SRA
+    own-chunk-exact rule, scatter_reduce_allgather.cc:116-155), and sum.
+    ``add_to`` (flat) is a pre-accumulator (the Ring hop's decompress-add,
+    UnpackArray<ADD>). Fused Pallas kernel on TPU; staged reference ops
+    elsewhere — identical values by construction (interpret-mode
+    byte-check in the suite)."""
+    rows = q.batch_rows
+    if rows > 1 and add_to is None and _use_fused_reduce(q):
+        raw_row = (
+            _own_row(raw_rows, own_idx, q.numel)
+            if raw_rows is not None
+            else None
+        )
+        return codec_pallas.reduce_rows_batch(
+            q, raw_row=raw_row, own_idx=own_idx, interpret=not _on_tpu()
+        ).astype(out_dtype)
+    # Staged reference path (also the fused kernels' byte oracle).
+    if rows == 1 and raw_rows is None:
+        return dequantize_batch(
+            q,
+            add_to=None if add_to is None else add_to[None],
+            out_dtype=out_dtype,
+        )[0]
+    vals = dequantize_batch(q, out_dtype=jnp.float32)
+    if raw_rows is not None:
+        own = (jnp.arange(rows) == own_idx)[:, None]
+        vals = jnp.where(own, raw_rows.astype(jnp.float32), vals)
+    red = ordered_rowsum(vals)
+    if add_to is not None:
+        red = add_to.astype(jnp.float32) + red
+    return red.astype(out_dtype)
+
+
+def reduce_rows_requantize(
+    q: codec.QTensor,
+    cc: CompressionConfig,
+    *,
+    raw_rows: Optional[jax.Array] = None,
+    own_idx: Optional[jax.Array] = None,
+    key: Optional[jax.Array] = None,
+    out_dtype=jnp.float32,
+) -> codec.QTensor:
+    """The full SRA epilogue: :func:`reduce_rows` + requantize of the
+    reduced chunk into a rows=1 QTensor (the stage-2 allgather payload) —
+    one fused HBM pass on TPU, the staged decode/sum/quantize reference
+    elsewhere. Wire bytes are identical between the two lowerings on the
+    default deterministic ``div`` encode; ``CGX_CODEC_ENCODE=mul`` applies
+    inside the fused requantize exactly as in the staged quantize (same
+    one-knob flip, PERF_NOTES.md)."""
+    stochastic = cc.stochastic and key is not None
+    if _use_fused_reduce(q, stochastic=stochastic):
+        raw_row = (
+            _own_row(raw_rows, own_idx, q.numel)
+            if raw_rows is not None
+            else None
+        )
+        return codec_pallas.sra_epilogue_batch(
+            q,
+            raw_row=raw_row,
+            own_idx=own_idx,
+            key=key if stochastic else None,
+            out_dtype=out_dtype,
+            interpret=not _on_tpu(),
+        )
+    reduced = reduce_rows(
+        q, raw_rows=raw_rows, own_idx=own_idx, out_dtype=jnp.float32
+    )
+    return quantize_batch(
+        reduced.astype(out_dtype)[None], cc, key if stochastic else None
+    )

@@ -22,6 +22,7 @@ the static-shape cache key exactly as planned in SURVEY.md §7.4.
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -178,6 +179,108 @@ def _fusion_slices(n: int, elem_size: int) -> List[Tuple[int, int]]:
     return out
 
 
+# ---------------------------------------------------------------------------
+# Trace-time layout cache. allreduce_tree used to re-derive the whole
+# group/concat/split/slice plan — per-leaf path rendering, pattern-registry
+# resolution, grouping and fusion arithmetic — on every call, ~4 ms of pure-
+# Python glue per trace of the 473 MB GPT-2 tree (PERF_NOTES.md round 5).
+# The plan is a pure function of (tree structure, leaf shapes/dtypes,
+# config state), so it is computed once and memoized behind a bounded LRU;
+# the registry version in the key plays the same role as make_train_step's
+# trace-cache key (a re-registration must produce a fresh layout, never hit
+# a stale one).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class _GroupLayout:
+    """One fused group's precomputed plan: member leaves, their offsets in
+    the fused flat buffer, and the fusion slices of that buffer."""
+
+    cc: CompressionConfig
+    dtype: np.dtype
+    indices: Tuple[int, ...]
+    offsets: Tuple[int, ...]
+    fused_n: int
+    slices: Tuple[Tuple[int, int], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class _TreeLayout:
+    groups: Tuple[_GroupLayout, ...]
+
+
+_LAYOUT_CACHE: "OrderedDict" = OrderedDict()
+_LAYOUT_CACHE_MAX = 64
+_LAYOUT_STATS = {"hits": 0, "misses": 0}
+
+
+def layout_cache_stats() -> Dict[str, int]:
+    """Copy of the {hits, misses} counters (tests, diagnostics)."""
+    return dict(_LAYOUT_STATS)
+
+
+def layout_cache_clear() -> None:
+    _LAYOUT_CACHE.clear()
+    _LAYOUT_STATS.update(hits=0, misses=0)
+
+
+def _layout_key(paths_leaves, treedef, compress_small: bool):
+    """Everything the layout is a function of: tree structure + leaf
+    shapes/dtypes, plus every config input the grouping reads (the pattern
+    registry via its version; the env-derived default config and
+    thresholds re-read per call — cheap to read, included so an env flip
+    between calls can never hit a stale plan)."""
+    return (
+        treedef,
+        tuple(
+            (tuple(l.shape), np.dtype(l.dtype).str) for _, l in paths_leaves
+        ),
+        bool(compress_small),
+        cfg_mod.registry_version(),
+        cfg_mod.default_compression_config(),
+        cfg_mod.minimal_size(),
+        cfg_mod.standalone_layer_elems(),
+        cfg_mod.fusion_threshold_elems(1),
+    )
+
+
+def _tree_layout(paths_leaves, treedef, compress_small: bool) -> _TreeLayout:
+    key = _layout_key(paths_leaves, treedef, compress_small)
+    hit = _LAYOUT_CACHE.get(key)
+    if hit is not None:
+        _LAYOUT_CACHE.move_to_end(key)
+        _LAYOUT_STATS["hits"] += 1
+        metrics.add("cgx.trace.layout_cache_hits")
+        return hit
+    _LAYOUT_STATS["misses"] += 1
+    metrics.add("cgx.trace.layout_cache_misses")
+    groups: List[_GroupLayout] = []
+    for g in _group_leaves(paths_leaves, compress_small):
+        offsets: List[int] = []
+        off = 0
+        for i in g.indices:
+            offsets.append(off)
+            off += int(paths_leaves[i][1].size)
+        groups.append(
+            _GroupLayout(
+                cc=g.cc,
+                dtype=g.dtype,
+                indices=g.indices,
+                offsets=tuple(offsets),
+                fused_n=off,
+                slices=tuple(
+                    _fusion_slices(off, np.dtype(g.dtype).itemsize)
+                ),
+            )
+        )
+    layout = _TreeLayout(groups=tuple(groups))
+    _LAYOUT_CACHE[key] = layout
+    if len(_LAYOUT_CACHE) > _LAYOUT_CACHE_MAX:
+        _LAYOUT_CACHE.popitem(last=False)
+    return layout
+
+
 def allreduce_flat(
     flat: jax.Array,
     cc: CompressionConfig,
@@ -187,10 +290,13 @@ def allreduce_flat(
     topology: Optional[TopologyConfig] = None,
     key: Optional[jax.Array] = None,
     return_roundtrip: bool = False,
+    slices: Optional[Sequence[Tuple[int, int]]] = None,
 ):
     """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
     shard_map). Slicing by the fusion threshold happens here so oversized
-    buffers are chunked like performOperationSingle (.cc:187-199).
+    buffers are chunked like performOperationSingle (.cc:187-199);
+    ``slices`` lets allreduce_tree hand in the layout-cache's precomputed
+    plan instead of re-deriving it per call.
 
     ``return_roundtrip=True`` also returns this device's wire decode (the
     error-feedback residual base) as a second array. On the single-axis
@@ -206,12 +312,17 @@ def allreduce_flat(
     if ratio is not None and cc.enabled and n > 1:
         # Debug traffic shaping (mpi_allreduce_operations.cc:130-144): only
         # the leading ratio*n elements travel; the tail stays un-reduced.
+        # The cached plan covered the full buffer — recompute for the
+        # shaped prefix.
         m = max(1, int(np.ceil(ratio * n)))
         tail = lax.slice(flat, (m,), (n,))
         flat, n = lax.slice(flat, (0,), (m,)), m
+        slices = None
+    if slices is None:
+        slices = _fusion_slices(n, np.dtype(flat.dtype).itemsize)
     pieces = []
     rt_pieces = []
-    for off, ln in _fusion_slices(n, np.dtype(flat.dtype).itemsize):
+    for off, ln in slices:
         piece = lax.slice(flat, (off,), (off + ln,))
         k = jax.random.fold_in(key, off) if key is not None else None
         if len(axes) == 1:
@@ -416,7 +527,7 @@ def allreduce_tree(
             (l / ws_total if _is_float(l) else l) for l in flat_leaves
         ]
 
-    groups = _group_leaves(paths_leaves, compress_small)
+    groups = _tree_layout(paths_leaves, treedef, compress_small).groups
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     rt_out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     for gi, g in enumerate(groups):
@@ -468,12 +579,12 @@ def allreduce_tree(
                 if return_roundtrip or qerr:
                     reduced, rt_flat = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
-                        key=g_key, return_roundtrip=True,
+                        key=g_key, return_roundtrip=True, slices=g.slices,
                     )
                 else:
                     reduced = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
-                        key=g_key,
+                        key=g_key, slices=g.slices,
                     )
             else:
                 metrics.add("cgx.trace.allreduce.raw_elems", float(fused.shape[0]))
@@ -484,8 +595,7 @@ def allreduce_tree(
                 for a in axes:
                     if mesh.shape[a] > 1:
                         reduced = lax.psum(reduced, a)
-        off = 0
-        for i, leaf in zip(g.indices, leaves):
+        for i, leaf, off in zip(g.indices, leaves, g.offsets):
             n = leaf.size
             out[i] = lax.slice(reduced, (off,), (off + n,)).reshape(leaf.shape)
             if return_roundtrip or (qerr and g.cc.enabled):
@@ -496,7 +606,6 @@ def allreduce_tree(
                     rt_out[i] = rt_leaf
                 if qerr and g.cc.enabled:
                     _report_qerr(paths_leaves[i][0], leaf, rt_leaf)
-            off += n
     result = jax.tree_util.tree_unflatten(treedef, out)
     if return_roundtrip:
         return result, jax.tree_util.tree_unflatten(treedef, rt_out)

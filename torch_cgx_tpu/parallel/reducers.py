@@ -8,7 +8,9 @@ pure function **inside ``shard_map``** composed from XLA collectives:
 
 * SRA (Scatter-Reduce-AllGather, the flagship,
   scatter_reduce_allgather.cc:94-202)  ->  ``lax.all_to_all`` of quantized
-  chunk payloads + f32 decompress-accumulate + requantize +
+  chunk payloads + a dispatched decompress-accumulate-requantize epilogue
+  (``ops.dispatch.reduce_rows_requantize``: ONE fused Pallas HBM pass on
+  TPU, staged reference ops elsewhere — wire bytes identical) +
   ``lax.all_gather``.
 * Ring (ring.cc:139-226)  ->  ``lax.ppermute`` ring with per-hop
   requantization in the scatter-reduce phase and a circulate-once-quantized
@@ -113,21 +115,34 @@ def _phase_key(key, salt: int, axis_name: str):
     return jax.random.fold_in(jax.random.fold_in(key, salt), lax.axis_index(axis_name))
 
 
-def _sra_stage1(x, axis_name: str, ws: int, cc, key):
-    """Shared SRA stage-1 body: quantize the padded (ws, chunk) rows with
-    the phase-1 key, all_to_all, decompress-accumulate into the RAW own
-    chunk. Returns ``(reduced_chunk, q, xs, own)`` so the EF variant can
-    decode the SAME payload ``q`` the wire sent (one implementation — the
-    reducer and its wire mirror cannot drift)."""
+def _sra_exchange(x, axis_name: str, ws: int, cc, key):
+    """SRA stage-1 wire: quantize the padded (ws, chunk) rows with the
+    phase-1 key and exchange via all_to_all. Returns
+    ``(q, q_recv, xs, own_idx)`` — the sent payload, the received peer
+    payloads (row j = this device's chunk as peer j quantized it), the raw
+    padded rows, and this device's axis position. Factored so every SRA
+    variant (plain / with-wire / reduce-scatter) shares ONE wire
+    implementation and the epilogue can be dispatched fused or staged."""
     xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
     q = _quantize_rows(xs, cc, _phase_key(key, 1, axis_name))
     q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
-    vals = _dequantize_rows(q_recv)  # (ws, chunk) f32: row j = chunk from peer j
-    # The row arriving from oneself is one's own quantized chunk — swap in
-    # the raw values instead (free accuracy the SPMD form doesn't forfeit).
-    own = (jnp.arange(ws) == lax.axis_index(axis_name))[:, None]
-    vals = jnp.where(own, xs.astype(jnp.float32), vals)
-    return jnp.sum(vals, axis=0), q, xs, own
+    return q, q_recv, xs, lax.axis_index(axis_name)
+
+
+def _sra_stage1(x, axis_name: str, ws: int, cc, key):
+    """Shared SRA stage-1 body: :func:`_sra_exchange` +
+    decompress-accumulate into the RAW own chunk (the row arriving from
+    oneself is one's own quantized chunk — the raw values are swapped in
+    instead, free accuracy the SPMD form doesn't forfeit). The epilogue
+    runs through ``dispatch.reduce_rows`` — fused single-pass kernel on
+    TPU, the staged decode/select/sum elsewhere. Returns
+    ``(reduced_chunk, q, xs, own)`` so the EF variant can decode the SAME
+    payload ``q`` the wire sent (one implementation — the reducer and its
+    wire mirror cannot drift)."""
+    q, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
+    reduced = dispatch.reduce_rows(q_recv, raw_rows=xs, own_idx=own_idx)
+    own = (jnp.arange(ws) == own_idx)[:, None]
+    return reduced, q, xs, own
 
 
 def reduce_scatter_quantized(
@@ -168,6 +183,23 @@ def allgather_quantized(
     return vals.reshape(-1)[:n].astype(out_dtype)
 
 
+def _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, out_dtype):
+    """Shared SRA epilogue: the stage-2 wire payload of the reduced chunk,
+    via ``dispatch.reduce_rows_requantize`` — ONE fused
+    dequant-accumulate-requantize HBM pass on TPU (the (ws, chunk) f32
+    intermediate of the staged form never materializes), the staged
+    reference ops elsewhere. Wire bytes identical across lowerings on the
+    default ``div`` encode (jaxpr-guarded in test_reducers)."""
+    return dispatch.reduce_rows_requantize(
+        q_recv,
+        cc,
+        raw_rows=xs,
+        own_idx=own_idx,
+        key=_phase_key(key, 2, axis_name) if cc.stochastic else None,
+        out_dtype=out_dtype,
+    )
+
+
 def sra_allreduce(
     x: jax.Array,
     axis_name: str,
@@ -176,10 +208,20 @@ def sra_allreduce(
     key: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Quantized Scatter-Reduce-AllGather allreduce (the flagship algorithm,
-    ``MPI_Allreduce_ScatterReduceAllgather::AllreduceCompressed``)."""
+    ``MPI_Allreduce_ScatterReduceAllgather::AllreduceCompressed``).
+
+    Stage 1 quantizes + all_to_alls the peer chunks; the epilogue
+    (decompress-accumulate + requantize-reduced,
+    scatter_reduce_allgather.cc:116-160) is a single dispatched op; stage 2
+    all_gathers the requantized chunk and decodes every row — including
+    one's own, realizing the requant+self-dequant error-symmetry trick
+    (scatter_reduce_allgather.cc:157-160)."""
     n = x.shape[0]
-    reduced = reduce_scatter_quantized(x, axis_name, ws, cc, key)
-    return allgather_quantized(reduced, axis_name, ws, cc, n, x.dtype, key)
+    _, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
+    q_own = _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, x.dtype)
+    gathered = _gather_rows(q_own, axis_name)
+    vals = _dequantize_rows(gathered)  # (ws, chunk)
+    return vals.reshape(-1)[:n].astype(x.dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -235,7 +277,11 @@ def ring_allreduce(
         q = _quantize_1d(seg_out, cc, k)
         q_in = _shift_right(q, axis_name, ws)
         recv_idx = (rank - step - 1) % ws
-        updated = _dequantize_1d(q_in, add_to=row(acc, recv_idx))
+        # Per-hop decompress-add through the dispatcher (the rows=1
+        # accumulate form — UnpackArray<ADD>): byte-identical to
+        # _dequantize_1d(add_to=...) by construction, and the unrolled
+        # oracle below keeps the direct spelling so the two stay honest.
+        updated = dispatch.reduce_rows(q_in, add_to=row(acc, recv_idx))
         return lax.dynamic_update_slice(acc, updated[None], (recv_idx, 0)), None
 
     acc, _ = lax.scan(scatter_step, acc, jnp.arange(ws - 1))
@@ -351,14 +397,18 @@ def sra_allreduce_with_wire(
     (the mirror had to replicate ``_phase_key`` exactly or the residual
     measured a different random draw than the wire's)."""
     n = x.shape[0]
-    reduced, q, xs, own = _sra_stage1(x, axis_name, ws, cc, key)
+    q, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
+    own = (jnp.arange(ws) == own_idx)[:, None]
     rt_rows = _dequantize_rows(q)
     rt = (
         jnp.where(own, xs.astype(rt_rows.dtype), rt_rows)
         .reshape(-1)[:n]
         .astype(x.dtype)
     )
-    return allgather_quantized(reduced, axis_name, ws, cc, n, x.dtype, key), rt
+    q_own = _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, x.dtype)
+    gathered = _gather_rows(q_own, axis_name)
+    out = _dequantize_rows(gathered).reshape(-1)[:n].astype(x.dtype)
+    return out, rt
 
 
 def alltoall_allreduce_with_wire(
@@ -377,8 +427,7 @@ def alltoall_allreduce_with_wire(
     q = _quantize_1d(x, cc, k)
     rt = _dequantize_1d(q).astype(x.dtype)
     gathered = _gather_rows(q, axis_name)
-    vals = _dequantize_rows(gathered)
-    return jnp.sum(vals, axis=0).astype(x.dtype), rt
+    return dispatch.reduce_rows(gathered).astype(x.dtype), rt
 
 
 def sra_stage1_wire(
@@ -486,14 +535,31 @@ def quantized_allreduce(
         if cc.enabled and cfg_mod.force_codec():
             # CGX_DEBUG_FORCE_CODEC: emulate the per-rank codec work of a
             # real SRA step so one chip can measure codec overhead in a
-            # real train step. Per rank at world size ws, SRA quantizes
+            # real train step.
+            q = _quantize_1d(x, cc, key)
+            if dispatch.fused_epilogue_would_run(
+                q, stochastic=cc.stochastic and key is not None
+            ):
+                # Fused-epilogue era: a real rank runs stage-1 quantize ->
+                # ONE fused dequant-accumulate-requantize pass over the
+                # arriving payloads (~n packed values across the ws rows)
+                # -> allgather decode. Emulate exactly that kernel
+                # sequence (rows=1 epilogue over the full payload) so the
+                # train-step probe measures the production shape; the
+                # value is the double round trip decode(requant(decode)),
+                # still inside 2x the quantization envelope.
+                k2 = _phase_key(key, 2, axis_name) if cc.stochastic else None
+                q2 = dispatch.reduce_rows_requantize(
+                    q, cc, key=k2, out_dtype=x.dtype
+                )
+                return _dequantize_1d(q2).astype(x.dtype)
+            # Staged era. Per rank at world size ws, SRA quantizes
             # ~n*(1+1/ws) values (peer chunks + requantized own chunk) and
             # dequantizes ~n*(2-1/ws) (decompress-add in reduce-scatter,
             # decode in allgather) — so the proxy runs ONE quantize and
             # TWO decodes (one through the add_to accumulate path, like
             # phase 1). Averaging the two identical decodes keeps both
             # live without changing the value beyond float round-off.
-            q = _quantize_1d(x, cc, key)
             dec_assign = _dequantize_1d(q)
             dec_acc = _dequantize_1d(q, add_to=x) - x.astype(jnp.float32)
             return ((dec_assign + dec_acc) * 0.5).astype(x.dtype)

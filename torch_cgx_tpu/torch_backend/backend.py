@@ -260,6 +260,67 @@ def _decompress_frames(
         )
 
 
+def _requantize_frames(
+    fused: np.ndarray, segs: Sequence[_Segment], dummy: bool,
+    rng: Optional[np.random.Generator], wire_dtype=np.float32,
+) -> bytes:
+    """The SRA/Ring epilogue in one pass: requantize the reduced chunk and
+    self-dequantize it back into ``fused`` (the error-symmetry rule —
+    every replica must carry the identical quantization error,
+    scatter_reduce_allgather.cc:157-160). Host mirror of the jax-side
+    fused ``sra_epilogue`` kernel: wire bytes and written-back values are
+    identical to the staged ``_compress_frames`` + ``_decompress_frames``
+    pair it replaces, but the host codec decodes straight from the
+    in-memory QTensor (no wire re-parse) and the timeline carries ONE
+    ``codec.sra_epilogue`` span where the staged pair emitted two
+    ``codec.compress``/``codec.decompress`` spans."""
+    from . import device_codec
+
+    t0 = time.perf_counter()
+    parts: List[np.ndarray] = []
+    for s in segs:
+        sl = slice(s.start, s.start + s.numel)
+        x = np.ascontiguousarray(fused[sl], np.float32)
+        if dummy:
+            parts.append(x.view(np.uint8))
+            fused[sl] = x  # raw-bytes self-decode is the identity
+            continue
+        if device_codec.enabled(s.numel):
+            wire = device_codec.quantize(
+                x,
+                s.bits,
+                s.bucket_size,
+                stochastic_seed=(
+                    int(rng.integers(2**31 - 1)) if rng is not None else None
+                ),
+                meta_dtype=wire_dtype,
+            )
+            buf = np.frombuffer(wire, np.uint8)
+            parts.append(buf)
+            fused[sl] = device_codec.dequantize(
+                buf, s.numel, s.bits, s.bucket_size, meta_dtype=wire_dtype
+            )
+            continue
+        q = hcodec.quantize(
+            x,
+            s.bits,
+            s.bucket_size,
+            stochastic=rng is not None,
+            rng=rng,
+            meta_dtype=wire_dtype,
+        )
+        parts.append(q.to_bytes())
+        fused[sl] = hcodec.dequantize(q, out_dtype=np.float32)
+    out = np.concatenate(parts).tobytes() if parts else b""
+    if segs:
+        timeline.record(
+            "codec.sra_epilogue", timeline.CAT_QUANTIZE, t0,
+            time.perf_counter() - t0,
+            elems=sum(s.numel for s in segs), bytes=len(out),
+        )
+    return out
+
+
 def _chunk_split(
     n: int, ws: int, layers=None
 ) -> Tuple[List[int], List[int]]:
@@ -1150,17 +1211,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
             if j != me:
                 buf = self._take(f"{pfx}/s{j}>{me}", local=local)
                 _decompress_frames(buf, segs[me], fused, dummy, add=True, wire_dtype=wdt)
-        # Requantize the reduced chunk, then self-dequantize so every replica
-        # carries the identical quantization error
-        # (scatter_reduce_allgather.cc:157-160 — load-bearing for the
-        # bit-exactness oracle).
+        # Requantize the reduced chunk + self-dequantize in ONE fused pass
+        # (error symmetry, scatter_reduce_allgather.cc:157-160 —
+        # load-bearing for the bit-exactness oracle).
         t1 = time.perf_counter()
-        wire = _compress_frames(fused, segs[me], dummy, rng, wdt)
+        wire = _requantize_frames(fused, segs[me], dummy, rng, wdt)
         wire_out += len(wire)
-        _decompress_frames(
-            np.frombuffer(wire, np.uint8), segs[me], fused, dummy, add=False,
-            wire_dtype=wdt,
-        )
         self._put(f"{pfx}/g{me}", wire, readers=ws - 1, local=local)
         # Round 2: gather every reduced chunk (allgather).
         for j in range(ws):
@@ -1195,13 +1251,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
             buf = self._take(f"{pfx}/r{step}>{me}", local=local)
             _decompress_frames(buf, segs[r_idx], fused, dummy, add=True, wire_dtype=wdt)
         # Our fully-reduced chunk is (me+1) % ws; requantize + self-dequantize
-        # it once (error symmetry, ring.cc:190-199), then circulate.
+        # it once, in one fused pass (error symmetry, ring.cc:190-199),
+        # then circulate.
         t1 = time.perf_counter()
-        hold = _compress_frames(fused, segs[(me + 1) % ws], dummy, rng, wdt)
-        _decompress_frames(
-            np.frombuffer(hold, np.uint8), segs[(me + 1) % ws], fused, dummy,
-            add=False, wire_dtype=wdt,
-        )
+        hold = _requantize_frames(fused, segs[(me + 1) % ws], dummy, rng, wdt)
         for step in range(ws - 1):
             r_idx = (me - step) % ws  # chunk arriving this step
             wire_out += len(hold)
@@ -1335,14 +1388,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 ranks=leaders, local=False,
                 force_raw=not topo.cross_compress,
             )
-        # Every leader requantizes + self-decodes, even one with no local
-        # peers: non-leaders on OTHER hosts hold decode(frame(stage-2)), so
-        # a leader keeping raw stage-2 values would break global symmetry.
-        wire = _compress_frames(fused, segs, dummy or intra_raw, rng3, wdt)
-        _decompress_frames(
-            np.frombuffer(wire, np.uint8), segs, fused, dummy or intra_raw,
-            add=False, wire_dtype=wdt,
-        )
+        # Every leader requantizes + self-decodes (one fused pass), even one
+        # with no local peers: non-leaders on OTHER hosts hold
+        # decode(frame(stage-2)), so a leader keeping raw stage-2 values
+        # would break global symmetry.
+        wire = _requantize_frames(fused, segs, dummy or intra_raw, rng3, wdt)
         if len(locals_) > 1:
             self._put(f"{pfx}/h3.{leader}", wire, readers=len(locals_) - 1, local=True)
 

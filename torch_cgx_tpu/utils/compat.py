@@ -67,6 +67,42 @@ def set_mesh(mesh):
     return contextlib.nullcontext(mesh) if mesh is None else mesh
 
 
+def ensure_cpu_collectives() -> None:
+    """Arm cross-process collectives for CPU-backend multi-process runs.
+
+    jaxlib ships a Gloo CPU-collectives implementation, but jax 0.4.x
+    defaults the ``jax_cpu_collectives_implementation`` flag to none — a
+    multi-process CPU program then fails every collective with
+    "Multiprocess computations aren't implemented on the CPU backend"
+    (newer jax defaults to gloo). Called only when a distributed runtime
+    is about to initialize (``mesh.init_distributed`` behind its
+    coordinator check — gloo needs the distributed client; arming it on a
+    single-host process fails CPU backend init outright). A no-op when
+    the platform is explicitly pinned away from CPU, when the flag is
+    already set (an explicit mpi/gloo choice is respected), or on
+    runtimes without the flag (initialize() surfaces the gap there).
+    An UNSET platform still arms it: jax may auto-select the CPU backend
+    (CPU-only hosts), and on accelerator pods the secondary CPU client
+    takes gloo harmlessly once the distributed client exists."""
+    import os
+
+    plats = str(
+        getattr(jax.config, "jax_platforms", None)
+        or os.environ.get("JAX_PLATFORMS")
+        or ""
+    ).lower()
+    if plats and "cpu" not in plats:
+        return
+    try:
+        from jax._src import xla_bridge as _xb
+
+        flag = getattr(_xb, "CPU_COLLECTIVES_IMPLEMENTATION", None)
+        if flag is not None and flag.value in (None, "none"):
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+
+
 def distributed_is_initialized() -> bool:
     """``jax.distributed.is_initialized`` with a state-probe fallback for
     runtimes that predate the accessor."""
