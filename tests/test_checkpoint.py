@@ -71,6 +71,40 @@ def test_restore_missing_raises(tmp_path):
         ckpt.restore(str(tmp_path / "nope"))
 
 
+def test_registry_restore_under_shrunk_world(tmp_path):
+    """ISSUE 5 satellite: a registry snapshot saved by a ws=4 run
+    re-installs cleanly in a ws=3 survivor world. The registry stores
+    per-(bucket, layer) facts only — nothing world-size shaped — so the
+    restore must succeed verbatim, and the bucket's chunk layout must
+    RE-DERIVE for the shrunk world rather than replay any ws=4 plan
+    (``_chunk_split``/``chunk_layout`` are pure functions of (n, ws))."""
+    from torch_cgx_tpu.parallel.reducers import chunk_layout
+    from torch_cgx_tpu.torch_backend.backend import _chunk_split
+
+    # The registry as a ws=4 bridge run (DDP hook) would fill it.
+    cfg.register_layer(0, 0, 4096, 4, 128)
+    cfg.register_layer(0, 1, 2048, 2, 64)
+    cfg.register_layer(1, 0, 300, 8, 0)
+    ckpt.save(str(tmp_path), _tree(), step=3)
+    total = 4096 + 2048
+    sizes4, offs4 = _chunk_split(total, 4)
+    # Simulated eviction-restart: statics wiped, restored at ws=3.
+    torch_cgx_tpu.clear_registry()
+    ckpt.restore(str(tmp_path), target=jax.tree.map(jnp.zeros_like, _tree()))
+    assert cfg.registered_layer_sizes(0) == [4096, 2048]
+    assert cfg.registered_layer_sizes(1) == [300]
+    # No stale layer indices: every registered (bucket, layer) resolves.
+    assert cfg.get_layer_config((0, 0)).bits == 4
+    assert cfg.get_layer_config((0, 1)).bucket_size == 64
+    assert cfg.get_layer_config((1, 0)).bits == 8
+    # The bucket layout is derived fresh for the survivor world.
+    sizes3, offs3 = _chunk_split(total, 3)
+    assert len(sizes3) == 3 and sum(sizes3) == total
+    assert sizes3 != sizes4
+    assert offs3 == [0] + list(np.cumsum(sizes3)[:-1])
+    assert chunk_layout(total, 3) != chunk_layout(total, 4)
+
+
 def test_training_resume_equivalence(tmp_path):
     """Train 4 steps, checkpoint at 2, resume, and match the uninterrupted
     run bit-for-bit (the actual resume contract)."""

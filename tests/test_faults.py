@@ -68,6 +68,37 @@ def test_fault_grammar_full_spec():
     assert s.delay_ms == 2000.0 and s.rank == 1
 
 
+def test_fault_grammar_slow_rank_and_flap():
+    # ISSUE 5 satellite: the retry rung's rehearsal faults — a straggler
+    # (slow_rank) and a transient drop-then-recover (flap).
+    specs = parse_faults("slow_rank:1@800ms,flap:120ms@step=2")
+    by_mode = {s.mode: s for s in specs}
+    assert by_mode["slow_rank"] == FaultSpec(
+        mode="slow_rank", rank=1, delay_ms=800.0
+    )
+    assert by_mode["flap"].delay_ms == pytest.approx(120.0)
+    assert by_mode["flap"].step == 2
+    # bare-int rank shorthand works for slow_rank like kill_rank
+    (s,) = parse_faults("slow_rank:3@250ms")
+    assert s.rank == 3 and s.delay_ms == pytest.approx(250.0)
+    # both modes ARE their delay: omitting the duration would inject
+    # nothing, so the parser fails loud instead of going vacuously green
+    with pytest.raises(ValueError):
+        parse_faults("slow_rank:3")
+    with pytest.raises(ValueError):
+        parse_faults("flap:step=2")
+
+
+def test_flap_delay_helper_fires_on_its_step():
+    inj = faults.FaultInjector(
+        parse_faults("flap:50ms@step=1"), seed=0, rank=0
+    )
+    assert inj.flap_delay() is None  # event 0: gated off
+    assert inj.flap_delay() == pytest.approx(0.05)  # event 1 fires
+    assert inj.flap_delay() is None  # event 2: gated off again
+    assert metrics.get("cgx.faults.flap") == 1
+
+
 def test_fault_grammar_rejects_junk():
     with pytest.raises(ValueError):
         parse_faults("explode_randomly:1.0")  # unknown mode

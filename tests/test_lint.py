@@ -100,6 +100,106 @@ def test_linter_wait_gate_scoped_to_transport_dirs(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_linter_flags_swallowed_exception_in_data_plane(tmp_path):
+    # ISSUE 5 satellite: `except Exception: pass` in the transport dirs
+    # digests exactly the failures the recovery supervisor exists to see.
+    bdir = tmp_path / "robustness"
+    bdir.mkdir()
+    bad = bdir / "bad.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "swallowed exception" in proc.stdout
+
+
+def test_linter_flags_bare_except_pass_too(tmp_path):
+    bdir = tmp_path / "torch_backend"
+    bdir.mkdir()
+    bad = bdir / "bad.py"
+    bad.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except:\n"
+        "        pass\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "swallowed exception" in proc.stdout
+
+
+def test_linter_accepts_narrow_swallow_and_out_of_scope(tmp_path):
+    # Narrow types may pass (best-effort close paths), and the rule is
+    # scoped to the transport dirs — elsewhere the pattern is legal.
+    bdir = tmp_path / "torch_backend"
+    bdir.mkdir()
+    ok = bdir / "ok.py"
+    ok.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except (OSError, ValueError):\n"
+        "        pass\n"
+    )
+    assert _run_lint(ok).returncode == 0
+    other = tmp_path / "elsewhere.py"
+    other.write_text(
+        "def f(ch):\n"
+        "    try:\n"
+        "        ch.close()\n"
+        "    except Exception:\n"
+        "        pass\n"
+    )
+    assert _run_lint(other).returncode == 0
+
+
+def test_linter_flags_digested_bridge_timeout(tmp_path):
+    # A BridgeTimeoutError caught without re-raising or telling the
+    # supervisor/black box silently reverts the failure semantics.
+    bdir = tmp_path / "robustness"
+    bdir.mkdir()
+    bad = bdir / "bad.py"
+    bad.write_text(
+        "from .errors import BridgeTimeoutError\n"
+        "def f(take):\n"
+        "    try:\n"
+        "        return take()\n"
+        "    except BridgeTimeoutError:\n"
+        "        return None\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "without" in proc.stdout and "supervisor" in proc.stdout
+
+
+def test_linter_accepts_notified_or_reraised_bridge_timeout(tmp_path):
+    bdir = tmp_path / "robustness"
+    bdir.mkdir()
+    ok = bdir / "ok.py"
+    ok.write_text(
+        "from .errors import BridgeTimeoutError\n"
+        "from ..observability import flightrec\n"
+        "def f(take):\n"
+        "    try:\n"
+        "        return take()\n"
+        "    except BridgeTimeoutError as e:\n"
+        "        flightrec.record_failure(e)\n"
+        "        return None\n"
+        "def g(take):\n"
+        "    try:\n"
+        "        return take()\n"
+        "    except (BridgeTimeoutError, OSError):\n"
+        "        raise\n"
+    )
+    assert _run_lint(ok).returncode == 0, _run_lint(ok).stdout
+
+
 def test_linter_flags_bare_print_in_library(tmp_path):
     # Observability satellite (ISSUE 2): printf-only observability is the
     # reference gap this codebase closes — a bare print() in library code

@@ -67,7 +67,8 @@ def load_dir(directory: str) -> dict:
 def summarize(data: dict) -> dict:
     summary: dict = {"ranks": sorted(data["flight"]), "failures": [],
                      "faults": {}, "collectives": {}, "compression": {},
-                     "suspected_dead": [], "counters": {}}
+                     "suspected_dead": [], "counters": {}, "recovery": {}}
+    recovery_events: List[dict] = []
     coll_time: Dict[str, float] = defaultdict(float)
     coll_n: Dict[str, int] = defaultdict(int)
     ratios: Dict[str, List[float]] = defaultdict(list)
@@ -144,6 +145,22 @@ def summarize(data: dict) -> dict:
             elif kind == "heartbeat_suspect":
                 for pid in ev.get("pids") or []:
                     suspects.add(f"pid:{pid}")
+            elif kind in ("recovery", "recovery_retry"):
+                row = {"rank": rank, "ts": ev.get("ts")}
+                row.update(
+                    {
+                        k: v for k, v in ev.items()
+                        if k in ("phase", "generation", "evicted",
+                                 "survivors", "degrade_vote", "error",
+                                 "from_step", "to_step", "epoch",
+                                 "abandoned_regions", "key", "op",
+                                 "remaining", "ws", "step")
+                        and v is not None
+                    }
+                )
+                if kind == "recovery_retry":
+                    row["phase"] = "retry"
+                recovery_events.append(row)
     # Newest exporter line per rank folds in counters the dumps may miss.
     for rank, lines in data["metrics"].items():
         if not lines:
@@ -171,6 +188,29 @@ def summarize(data: dict) -> dict:
         for k, v in ratios.items() if v
     }
     summary["suspected_dead"] = sorted(suspects, key=str)
+    # Recovery section: the ladder's audit trail. Counters give the
+    # cluster totals (generation bumps, evictions, replayed steps); the
+    # event rows give the per-rank story in time order.
+    evicted: set = set()
+    for ev in recovery_events:
+        for g in ev.get("evicted") or []:
+            evicted.add(g)
+    rec_counters = {
+        k: v for k, v in totals.items() if k.startswith("cgx.recovery.")
+    }
+    if recovery_events or rec_counters:
+        gens = [
+            ev["generation"] for ev in recovery_events
+            if isinstance(ev.get("generation"), (int, float))
+        ]
+        summary["recovery"] = {
+            "events": sorted(
+                recovery_events, key=lambda e: (e.get("ts") or 0)
+            ),
+            "generation": int(max(gens)) if gens else 0,
+            "evicted": sorted(evicted),
+            "counters": rec_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -233,6 +273,34 @@ def render(summary: dict) -> str:
             for k, d in sorted(summary["compression"].items())
         ]
         parts.append(_fmt_table(rows, ("path", "n", "mean", "min", "max")))
+    if summary.get("recovery"):
+        rec = summary["recovery"]
+        parts.append(
+            f"\n== recovery (generation {rec['generation']}, "
+            f"evicted {rec['evicted'] or 'none'}) =="
+        )
+        for k, v in sorted(rec["counters"].items()):
+            parts.append(f"  {k}: {v:g}")
+        rows = [
+            (
+                ev.get("rank"),
+                ev.get("phase", "?"),
+                ev.get("generation", ""),
+                ev.get("evicted") or ev.get("key") or ev.get("error") or "",
+                (
+                    f"{ev.get('from_step')}->{ev.get('to_step')}"
+                    if ev.get("from_step") is not None
+                    else ev.get("step", "")
+                ),
+            )
+            for ev in rec["events"]
+        ]
+        if rows:
+            parts.append(
+                _fmt_table(rows, ("rank", "phase", "gen", "detail", "step"))
+            )
+    # cgx.recovery.* counters are NOT repeated here — the recovery
+    # section above is their home.
     interesting = {
         k: v for k, v in summary["counters"].items()
         if any(t in k for t in (

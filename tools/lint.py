@@ -284,6 +284,93 @@ def check_unbounded_waits(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+_BROAD_EXC_NAMES = {"Exception", "BaseException"}
+_SUPERVISED_EXC_NAMES = {"BridgeTimeoutError", "WireCorruptionError"}
+_SUPERVISOR_CALL_MARKERS = (
+    "record_failure", "notify", "recover", "handle_failure", "supervisor",
+)
+
+
+def _exc_type_names(node: ast.expr | None) -> list[str]:
+    """Exception class names a handler catches: bare except -> [""],
+    Name/Attribute taken directly, tuples flattened."""
+    if node is None:
+        return [""]
+    if isinstance(node, ast.Name):
+        return [node.id]
+    if isinstance(node, ast.Attribute):
+        return [node.attr]
+    if isinstance(node, ast.Tuple):
+        out: list[str] = []
+        for e in node.elts:
+            out.extend(_exc_type_names(e))
+        return out
+    return []
+
+
+def check_exception_hygiene(path: Path, tree: ast.Module) -> list[str]:
+    """Recovery gate for the data plane (torch_backend/ + robustness/):
+
+    * ``except Exception: pass`` (or a bare ``except: pass``) silently
+      swallows the exact failures the recovery supervisor exists to see —
+      a dead peer or corrupted payload digested into nothing. Narrow the
+      type (``except OSError: pass`` is fine) or do something with it.
+    * a handler catching ``BridgeTimeoutError``/``WireCorruptionError``
+      must either re-raise or hand the event to the supervisor/black box
+      (a call mentioning record_failure/notify/recover/handle_failure/
+      supervisor) — digesting a detected fault without telling anyone
+      reverts the failure semantics to a silent hang-shaped bug.
+    """
+    if not any(d in path.parts for d in _WAIT_SCOPED_DIRS):
+        return []
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        names = _exc_type_names(node.type)
+        body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+        if body_is_pass and any(
+            n in _BROAD_EXC_NAMES or n == "" for n in names
+        ):
+            what = "bare except" if names == [""] else f"except {names[0]}"
+            findings.append(
+                f"{path}:{node.lineno}: swallowed exception: '{what}: "
+                "pass' in the data plane — narrow the exception type or "
+                "surface the failure (docs/ROBUSTNESS.md Recovery)"
+            )
+            continue
+        caught = [n for n in names if n in _SUPERVISED_EXC_NAMES]
+        if not caught:
+            continue
+        notified = False
+        for n in ast.walk(node):
+            if isinstance(n, ast.Raise):
+                notified = True
+                break
+            if isinstance(n, ast.Call):
+                fn = n.func
+                name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if any(m in name.lower() for m in _SUPERVISOR_CALL_MARKERS):
+                    notified = True
+                    break
+            if isinstance(n, (ast.Name, ast.Attribute)):
+                ident = n.attr if isinstance(n, ast.Attribute) else n.id
+                if "supervisor" in ident.lower():
+                    notified = True
+                    break
+        if not notified:
+            findings.append(
+                f"{path}:{node.lineno}: {'/'.join(caught)} caught without "
+                "re-raising or notifying the recovery supervisor/black "
+                "box — a detected data-plane fault must not be digested "
+                "silently (docs/ROBUSTNESS.md Recovery)"
+            )
+    return findings
+
+
 _LIB_DIR = "torch_cgx_tpu"
 _METRIC_WRITE_METHODS = {"add", "set", "observe"}
 _METRIC_RECEIVERS = {"metrics", "_metrics"}
@@ -480,6 +567,7 @@ def check_file(path: Path) -> list[str]:
     c = Checker(path, tree)
     out = [f"{path}:{line}: undefined name '{name}'" for line, name in c.findings]
     out.extend(check_unbounded_waits(path, tree))
+    out.extend(check_exception_hygiene(path, tree))
     out.extend(check_library_hygiene(path, tree))
     out.extend(check_worker_timeline_coverage(path, tree))
     out.extend(check_reducer_reduce_routing(path, tree))

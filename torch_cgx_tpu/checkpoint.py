@@ -89,6 +89,46 @@ def restore_registry(snap: Dict[str, Any]) -> None:
 
 
 # ---------------------------------------------------------------------------
+# In-memory step snapshots (recovery supervisor rollback — PR 5).
+#
+# The on-disk save/restore above is the durable cross-restart path; the
+# recovery supervisor needs something much cheaper: a host-side copy of the
+# training state it can roll back to WITHIN the process after evicting a
+# dead rank, without touching the filesystem on the hot path. Same contract
+# as the durable form — the compression-registry snapshot rides along, so a
+# rolled-back run replays *compressed* with the exact per-layer configs the
+# pre-fault steps used (the §5.4 gap, applied to in-process recovery).
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MemorySnapshot:
+    """One rollback point: the step index, a host copy of the training
+    pytree, and the compression-registry snapshot taken with it."""
+
+    step: int
+    tree: Any
+    registry: Dict[str, Any]
+
+
+def snapshot_in_memory(tree: Any, step: int) -> MemorySnapshot:
+    """Host-copy ``tree`` (device arrays fetched; every leaf owns its
+    memory, so later in-place training updates cannot mutate the
+    snapshot) and capture the registry alongside."""
+    host = jax.tree.map(lambda x: np.array(x, copy=True), tree)
+    return MemorySnapshot(
+        step=int(step), tree=host, registry=registry_snapshot()
+    )
+
+
+def restore_in_memory(snap: MemorySnapshot) -> Any:
+    """Return a fresh copy of the snapshot's tree (the snapshot itself
+    stays pristine for a second rollback) and re-install its registry."""
+    restore_registry(snap.registry)
+    return jax.tree.map(np.copy, snap.tree)
+
+
+# ---------------------------------------------------------------------------
 # Tree save/restore.
 # ---------------------------------------------------------------------------
 

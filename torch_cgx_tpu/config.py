@@ -69,6 +69,11 @@ SHM_MAX_MB = "CGX_SHM_MAX_MB"  # arena growth cap before pressure errors
 NONFINITE_GUARD = "CGX_NONFINITE_GUARD"  # off | skip | exact
 FAULTS = "CGX_FAULTS"  # fault-injection spec (robustness/faults.py grammar)
 FAULTS_SEED = "CGX_FAULTS_SEED"
+# Self-healing recovery supervisor (robustness/supervisor.py — PR 5):
+RECOVERY_RETRIES = "CGX_RECOVERY_RETRIES"  # bounded wait retries (rung 1)
+RECOVERY_BACKOFF_MS = "CGX_RECOVERY_BACKOFF_MS"  # retry backoff base
+RECOVERY_CORRUPT_THRESHOLD = "CGX_RECOVERY_CORRUPT_THRESHOLD"  # rung 2 gate
+SNAPSHOT_EVERY = "CGX_SNAPSHOT_EVERY"  # in-memory step snapshot cadence
 # Observability layer (docs/OBSERVABILITY.md):
 METRICS_DIR = "CGX_METRICS_DIR"  # flight-recorder dumps + metric exports
 METRICS_FLUSH_S = "CGX_METRICS_FLUSH_S"  # periodic exporter interval
@@ -405,6 +410,48 @@ def flightrec_cap() -> int:
     """CGX_FLIGHTREC_CAP: flight-recorder ring capacity in events."""
     v = _env.get_int_env_or_default(FLIGHTREC_CAP, 512)
     return v if v > 0 else 512
+
+
+def recovery_retries() -> int:
+    """CGX_RECOVERY_RETRIES: how many times an expired bounded bridge wait
+    is re-armed (exponential backoff + jitter, ``cgx.recovery.retries``)
+    before the error escalates to the supervisor's eviction rung. 0
+    (default) = recovery off — failures raise exactly as before, and no
+    staged program or wire byte changes (docs/ROBUSTNESS.md Recovery).
+    Waits whose heartbeat already names a dead suspect skip the retries:
+    a SIGKILL'd peer will not come back, and burning ``retries`` full
+    timeouts on it only delays the eviction rung."""
+    v = _env.get_int_env_or_default(RECOVERY_RETRIES, 0)
+    return max(v, 0)
+
+
+def recovery_backoff_ms() -> float:
+    """CGX_RECOVERY_BACKOFF_MS: base of the retry rung's exponential
+    backoff (doubled per retry, plus up-to-50% uniform jitter so retrying
+    ranks do not stampede the store in lockstep)."""
+    v = _env.get_float_env_or_default(RECOVERY_BACKOFF_MS, 100.0)
+    return v if v > 0 else 100.0
+
+
+def recovery_corrupt_threshold() -> int:
+    """CGX_RECOVERY_CORRUPT_THRESHOLD: after this many
+    ``WireCorruptionError`` incidents in one supervised run, the ladder's
+    degrade rung closes the shm byte plane and the whole group falls back
+    to the store transport (coordinated through the generation
+    rendezvous, so no rank keeps posting to a channel its peers stopped
+    reading)."""
+    v = _env.get_int_env_or_default(RECOVERY_CORRUPT_THRESHOLD, 2)
+    return v if v > 0 else 2
+
+
+def snapshot_every() -> int:
+    """CGX_SNAPSHOT_EVERY: cadence (in steps) of the in-memory training
+    state snapshot the supervisor rolls back to after a reconfiguration
+    (riding ``checkpoint.snapshot_in_memory``, compression-registry
+    snapshot included). 0 (default) = no snapshots — recovery resumes
+    from the current state without replay."""
+    v = _env.get_int_env_or_default(SNAPSHOT_EVERY, 0)
+    return max(v, 0)
 
 
 NONFINITE_POLICIES = ("off", "skip", "exact")

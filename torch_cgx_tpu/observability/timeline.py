@@ -71,6 +71,7 @@ CAT_WIRE = "wire"  # byte movement: shm/store put + take copy
 CAT_WAIT = "wait"  # queue wait: header/key waits
 CAT_SPAN = "span"  # generic trace_span bodies
 CAT_TRACE = "trace"  # JAX trace-time structure instants
+CAT_RECOVERY = "recovery"  # supervisor ladder: retries, rendezvous, rebuild
 
 _FLUSH_EVERY = 128  # buffered spans before an automatic flush
 

@@ -225,6 +225,20 @@ def layout_cache_clear() -> None:
     _LAYOUT_STATS.update(hits=0, misses=0)
 
 
+def invalidate_layout_cache(reason: str = "reconfigure") -> None:
+    """World-shrink invalidation entry point (recovery supervisor): every
+    cached plan keyed on the dead world's registry version can never hit
+    again (``survivor_mesh``/``reconfigure`` bump the version), so drop
+    them outright instead of letting them age out of the LRU while
+    holding their layouts live. Counted so a chaos run's report shows the
+    cache was actually cycled."""
+    layout_cache_clear()
+    metrics.add("cgx.trace.layout_cache_invalidations")
+    from ..utils.logging import get_logger
+
+    get_logger().info("allreduce layout cache invalidated (%s)", reason)
+
+
 def _layout_key(paths_leaves, treedef, compress_small: bool):
     """Everything the layout is a function of: tree structure + leaf
     shapes/dtypes, plus every config input the grouping reads (the pattern

@@ -349,8 +349,19 @@ def make_train_step(
     powersgd_rank: Optional[int] = None,
     topk_ratio: Optional[float] = None,
     nonfinite_guard: Optional[str] = None,
+    snapshot_every: Optional[int] = None,
 ):
     """Build a jitted compressed-DP train step.
+
+    ``snapshot_every`` (default: ``CGX_SNAPSHOT_EVERY`` env, 0 = off):
+    the recovery supervisor's rollback hook. Every N-th step the wrapper
+    host-copies the step's *inputs* (params, opt_state, compressor state
+    when present) via ``checkpoint.snapshot_in_memory`` — registry
+    snapshot included — BEFORE invoking the compiled program, so a
+    recovery can roll back to ``step.last_snapshot()`` / ``step.rollback()``
+    and deterministically replay. Pure Python around the jit boundary:
+    the staged program is unchanged, and with the knob unset nothing is
+    copied (docs/ROBUSTNESS.md Recovery).
 
     ``nonfinite_guard`` (default: ``CGX_NONFINITE_GUARD`` env, off):
     NaN/Inf gradients anywhere in the group are detected pre-quantization
@@ -728,16 +739,56 @@ def make_train_step(
             built[cache_key] = fn
         return fn
 
+    # Recovery rollback hook: in-memory snapshots of the step INPUTS at a
+    # fixed cadence, taken on the host before the jitted call (donation
+    # invalidates the device buffers afterwards, so the copy must happen
+    # here). Holder is shared by both signatures below.
+    snap_every = (
+        snapshot_every if snapshot_every is not None
+        else cfg_mod.snapshot_every()
+    )
+    snap_holder = {"snap": None}
+
+    def _maybe_snapshot(step_idx, tree) -> None:
+        if not snap_every:
+            return
+        idx = int(step_idx)
+        if idx % snap_every == 0:
+            from .. import checkpoint as ckpt
+
+            snap_holder["snap"] = ckpt.snapshot_in_memory(tree, idx)
+            metrics.add("cgx.recovery.snapshots")
+
     if error_feedback or powersgd_rank is not None or topk_ratio is not None:
 
         def step(params, opt_state, state, batch, step_idx):
+            _maybe_snapshot(step_idx, (params, opt_state, state))
             return _build(batch)(params, opt_state, state, batch, step_idx)
 
     else:
 
         def step(params, opt_state, batch, step_idx):
+            _maybe_snapshot(step_idx, (params, opt_state))
             return _build(batch)(params, opt_state, batch, step_idx)
 
+    def last_snapshot():
+        """The most recent in-memory snapshot (``checkpoint.
+        MemorySnapshot`` of the step's input tree), or None."""
+        return snap_holder["snap"]
+
+    def rollback():
+        """(step_idx, input tree) restored from the last snapshot —
+        registry snapshot re-installed; None when no snapshot exists."""
+        snap = snap_holder["snap"]
+        if snap is None:
+            return None
+        from .. import checkpoint as ckpt
+
+        metrics.add("cgx.recovery.rollbacks")
+        return snap.step, ckpt.restore_in_memory(snap)
+
+    step.last_snapshot = last_snapshot
+    step.rollback = rollback
     return step
 
 

@@ -116,6 +116,38 @@ def axis_size(mesh: Mesh, axis: str) -> int:
     return mesh.shape[axis]
 
 
+def survivor_mesh(mesh: Mesh, survivors: Sequence[int], axis: str = DP_AXIS) -> Mesh:
+    """Re-derive a mesh after the recovery supervisor evicted ranks: keep
+    only the ``survivors`` positions along ``axis`` (sorted — survivor
+    order must be identical on every rank or the reassembled meshes
+    disagree), preserving every other axis.
+
+    Also bumps the config registry version: the layout LRU
+    (``allreduce._tree_layout``) and ``make_train_step``'s trace cache
+    both key on it, so every plan derived for the dead world size is
+    invalidated rather than silently reused — SRA/Ring chunking is a pure
+    function of the axis size (``reducers.chunk_layout``) and re-derives
+    at the next trace.
+    """
+    from .. import config as cfg
+
+    names = list(mesh.axis_names)
+    idx = names.index(axis)
+    keep = sorted(int(s) for s in survivors)
+    extent = mesh.devices.shape[idx]
+    bad = [s for s in keep if not 0 <= s < extent]
+    if bad:
+        raise ValueError(
+            f"survivor positions {bad} out of range for axis {axis!r} "
+            f"(extent {extent})"
+        )
+    if not keep:
+        raise ValueError("survivor_mesh: empty survivor set")
+    arr = np.take(mesh.devices, keep, axis=idx)
+    cfg._bump_registry_version()
+    return Mesh(arr, tuple(names))
+
+
 def make_training_mesh(
     n_devices: Optional[int] = None,
     *,

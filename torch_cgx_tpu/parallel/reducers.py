@@ -37,7 +37,7 @@ stay constant (exactness oracle).
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,18 @@ from ..utils.tree import round_up
 
 def _chunk_size(n: int, ws: int) -> int:
     return round_up(-(-n // ws), codec.LANE_GROUP) if n else codec.LANE_GROUP
+
+
+def chunk_layout(n: int, ws: int) -> Tuple[int, int]:
+    """(chunk elements per rank, padded total) of the SRA/Ring wire layout
+    for ``n`` fused elements over ``ws`` ranks — a pure function of its
+    arguments, which is the survivor-re-derivation contract the recovery
+    supervisor relies on: after a world shrink nothing here is cached, so
+    the next trace (forced by the bumped registry version) derives the
+    ws-1 layout from scratch. Exposed for the shrunk-world tests and for
+    tooling that wants to reason about wire bytes without tracing."""
+    chunk = _chunk_size(n, ws)
+    return chunk, chunk * ws
 
 
 def _pad_rows(x: jax.Array, ws: int, chunk: int) -> jax.Array:

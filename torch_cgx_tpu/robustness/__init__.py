@@ -1,20 +1,35 @@
-"""Fault injection + the defenses it exercises.
+"""Fault injection + the defenses it exercises + the recovery supervisor.
 
-Three legs (see ``docs/ROBUSTNESS.md``):
+Five legs (see ``docs/ROBUSTNESS.md``):
 
 * :mod:`.faults` — the deterministic ``CGX_FAULTS`` injector threaded
   through the shm channel, the torch backend, and the train step.
 * :mod:`.heartbeat` — per-rank liveness files that let a bounded wait
   name its suspected dead peer instead of just expiring.
 * :mod:`.errors` — the failure taxonomy (:class:`BridgeTimeoutError`,
-  :class:`WireCorruptionError`), both ``RuntimeError`` subclasses.
+  :class:`WireCorruptionError`, :class:`StaleGenerationError`,
+  :class:`EvictedError`, :class:`RecoveryFailedError`), all
+  ``RuntimeError`` subclasses.
+* :mod:`.supervisor` — the per-rank recovery state machine (retry →
+  degrade → evict/reconfigure → rollback/replay policy ladder) that
+  turns the detected failures above into recoverable events.
+* :mod:`.rendezvous` — the store-based generation agreement the
+  supervisor's eviction rung runs (survivor set, degrade flag, ack
+  barrier).
 
 :mod:`.guard` (the JAX-side ``nan_grad`` staging) is imported lazily by
-``parallel/grad_sync`` — this package root stays importable without a
-working accelerator runtime.
+``parallel/grad_sync``; :mod:`.supervisor` / :mod:`.rendezvous` load
+lazily too — this package root stays importable without the
+observability package (and certainly without an accelerator runtime).
 """
 
-from .errors import BridgeTimeoutError, WireCorruptionError
+from .errors import (
+    BridgeTimeoutError,
+    EvictedError,
+    RecoveryFailedError,
+    StaleGenerationError,
+    WireCorruptionError,
+)
 from .faults import (
     FaultInjector,
     FaultSpec,
@@ -24,9 +39,28 @@ from .faults import (
 )
 from .heartbeat import Heartbeat, ensure_heartbeat, suspect_dead_pids
 
+# Only modules NOT already bound by the eager imports above: the import
+# system sets `faults`/`heartbeat`/`errors` as package attributes when
+# the from-imports run, so __getattr__ never fires for those.
+_LAZY = ("supervisor", "rendezvous", "retry")
+
+
+def __getattr__(name: str):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "BridgeTimeoutError",
     "WireCorruptionError",
+    "StaleGenerationError",
+    "EvictedError",
+    "RecoveryFailedError",
     "FaultInjector",
     "FaultSpec",
     "get_injector",
@@ -35,4 +69,6 @@ __all__ = [
     "Heartbeat",
     "ensure_heartbeat",
     "suspect_dead_pids",
+    "supervisor",
+    "rendezvous",
 ]

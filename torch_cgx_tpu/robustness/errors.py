@@ -39,3 +39,41 @@ class WireCorruptionError(RuntimeError):
     included): the bytes in the shared-memory arena do not match what the
     writer framed. Distinct from quantization error — this is transport
     damage, and the collective's result would be garbage."""
+
+
+class StaleGenerationError(RuntimeError):
+    """A message (or queued work entry) from a pre-recovery generation
+    reached a group that has since reconfigured. Discarding it instead of
+    decoding it is the whole point of the generation tag: a stale payload
+    aliasing into the new group's matching collective would silently
+    corrupt the reduction.
+
+    ``found``/``current`` carry the message's and the group's generation.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        found: Optional[int] = None,
+        current: Optional[int] = None,
+    ):
+        super().__init__(message)
+        self.found = found
+        self.current = current
+
+
+class EvictedError(RuntimeError):
+    """The recovery rendezvous converged on a survivor set that does not
+    include this rank: a quorum of peers suspected it dead (stale
+    heartbeat during their bounded waits). The correct reaction is to
+    exit — the group has already moved to a new generation without us,
+    and rejoining is not supported (``docs/ROBUSTNESS.md`` Recovery)."""
+
+
+class RecoveryFailedError(RuntimeError):
+    """The recovery ladder ran out of rungs: retries exhausted, and the
+    generation rendezvous could not converge (survivors stopped voting /
+    acking within its deadline). The job is no longer recoverable
+    in-place — surface the original failure semantics (die loudly) rather
+    than risk a split-brain group."""

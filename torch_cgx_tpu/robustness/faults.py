@@ -25,12 +25,21 @@ mode                      effect at its injection site
                           the jitted train step at trace time)
 ``stall_ack``             reader acks are never observed by the writer's
                           arena — drives the pressure/backoff path
+``slow_rank``             sleep ``delay`` at a collective entry on the
+                          gated rank — peers' bounded waits expire while
+                          the straggler is merely slow, not dead (the
+                          recovery retry rung's rehearsal)
+``flap``                  transient drop-then-recover: the message header
+                          is published ``delay`` late instead of never —
+                          the first bounded wait may expire, a retry
+                          succeeds
 ========================  =====================================================
 
 Spec tokens: a bare float is a per-event probability; ``NNms``/``NNs`` a
 delay; ``step=N`` fires only on the mode's N-th event (0-based; for
 ``nan_grad`` the training step index); ``rank=N`` restricts to one rank
-(``kill_rank``'s bare integer is shorthand for ``rank=N``).
+(a bare integer on ``kill_rank``/``slow_rank`` is shorthand for
+``rank=N``).
 
 Determinism: probabilistic gates draw from a per-rank stream seeded by
 ``CGX_FAULTS_SEED`` (default 0), so a failing chaos run replays exactly.
@@ -64,6 +73,8 @@ MODES = (
     "kill_rank",
     "nan_grad",
     "stall_ack",
+    "slow_rank",
+    "flap",
 )
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)$")
@@ -89,6 +100,14 @@ class FaultSpec:
                 f"CGX_FAULTS: {self.mode} probability must be in (0, 1], "
                 f"got {self.prob}"
             )
+        if self.mode in ("slow_rank", "flap") and self.delay_ms <= 0:
+            # These modes ARE their delay — without one the injection
+            # sites never fire and the chaos run is vacuously green,
+            # exactly what this parser's fail-loud contract forbids.
+            raise ValueError(
+                f"CGX_FAULTS: {self.mode} needs a duration, e.g. "
+                f"'{self.mode}:800ms'"
+            )
 
 
 def parse_faults(raw: str) -> List[FaultSpec]:
@@ -112,7 +131,7 @@ def parse_faults(raw: str) -> List[FaultSpec]:
                 kw["step"] = int(tok[len("step="):])
             elif tok.startswith("rank="):
                 kw["rank"] = int(tok[len("rank="):])
-            elif mode == "kill_rank" and "." not in tok:
+            elif mode in ("kill_rank", "slow_rank") and "." not in tok:
                 kw["rank"] = int(tok)  # kill_rank:2 == kill_rank:rank=2
             else:
                 try:
@@ -182,6 +201,17 @@ class FaultInjector:
         s = self._specs.get(mode)
         if s is not None and s.delay_ms > 0 and self.fire(mode):
             time.sleep(s.delay_ms / 1000.0)
+
+    def flap_delay(self, mode: str = "flap") -> Optional[float]:
+        """Seconds to delay a header publication when the ``flap`` fault
+        fires for this event, else None. The caller publishes late (a
+        timer thread), modeling a transient drop that recovers — the
+        defense under test is the recovery retry rung, which re-arms the
+        expired bounded wait instead of escalating."""
+        s = self._specs.get(mode)
+        if s is not None and s.delay_ms > 0 and self.fire(mode):
+            return s.delay_ms / 1000.0
+        return None
 
     def maybe_kill(self) -> None:
         """``kill_rank``: die the way SIGKILL/OOM does — no atexit, no

@@ -69,11 +69,22 @@ class Heartbeat:
         return self
 
     def _touch(self) -> None:
+        # Temp-file + os.replace: a reader never observes a truncated or
+        # half-written file (the plain open(.., "w") rewrite had a torn
+        # window where the file existed but was empty — under heavy tmpfs
+        # contention suspect_dead_pids could read it mid-write and the
+        # judgement then rested on whatever mtime the truncation left).
+        tmp = f"{self._path}.tmp{os.getpid()}"
         try:
-            with open(self._path, "w") as f:
+            with open(tmp, "w") as f:
                 f.write(str(os.getpid()))
+            os.replace(tmp, self._path)
         except OSError:
-            pass  # liveness is best-effort; never fail the data plane
+            # liveness is best-effort; never fail the data plane
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
 
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
@@ -112,14 +123,29 @@ def suspect_dead_pids(
     stale_s: float = DEFAULT_STALE_S,
 ) -> List[int]:
     """Pids whose heartbeat file is missing or older than ``stale_s``.
-    Also reaps heartbeat litter older than an hour (crash leftovers)."""
+    Also reaps heartbeat litter older than an hour (crash leftovers).
+
+    Tolerant of the writer's atomic-replace window: a ``stat`` that fails
+    while the file is being swapped is retried once after a short pause —
+    a live peer mid-``os.replace`` must not be declared dead on a single
+    racy probe (the file reappears within microseconds; a genuinely
+    missing file fails both probes). Content is never required: an
+    empty/partial read (pre-fix writers, exotic filesystems) does not
+    mark a pid dead — the mtime is the liveness signal, and only a stale
+    mtime (or a twice-confirmed missing file) suspects the peer."""
     now = time.time()
     out = []
     for pid in pids:
         path = heartbeat_path(directory, pid)
-        try:
-            st = os.stat(path)
-        except OSError:
+        st = None
+        for attempt in range(2):
+            try:
+                st = os.stat(path)
+                break
+            except OSError:
+                if attempt == 0:
+                    time.sleep(0.01)  # ride out a concurrent os.replace
+        if st is None:
             out.append(pid)
             continue
         if now - st.st_mtime > stale_s:
@@ -129,6 +155,21 @@ def suspect_dead_pids(
                     os.unlink(path)
                 except OSError:
                     pass
+    # Reap orphaned atomic-write temps too: a writer SIGKILLed between
+    # its tmp write and the os.replace leaves '<hb>.tmp<pid>' behind on
+    # the RAM-backed tmpfs forever otherwise.
+    try:
+        for name in os.listdir(directory):
+            if ".tmp" not in name or not name.startswith(_HB_PREFIX):
+                continue
+            p = os.path.join(directory, name)
+            try:
+                if now - os.stat(p).st_mtime > _REAP_S:
+                    os.unlink(p)
+            except OSError:
+                pass
+    except OSError:
+        pass
     out = sorted(set(out))
     if out:
         # Lazy imports: liveness stays dependency-free until it actually

@@ -1,0 +1,231 @@
+"""Store-based generation rendezvous for the recovery supervisor.
+
+When a rank's recovery ladder reaches the eviction rung it must not act
+alone: evicting a peer, degrading the transport, or replaying a step is
+only safe if every survivor does the same thing at the same generation —
+otherwise half the group posts to key namespaces the other half never
+reads (exactly the aliasing the generation tag exists to kill).
+
+This module is the agreement protocol, built from the only primitives
+every c10d store (TCPStore, FileStore, test doubles) shares: atomic
+``set``/``get`` per key and an atomic ``add`` counter. Notably it never
+issues a *blocking* ``get`` on a key that may not exist (a FileStore
+``get`` parks for the store timeout): presence is signalled through an
+``add``-based flag written after the payload, so every poll is
+non-blocking and the whole negotiation is bounded by ``timeout_s``.
+
+Protocol, per target ``generation`` (keys under ``cgxrdz/g<N>/``):
+
+1. **Vote** — each arriving rank publishes its local view: the suspects
+   its bounded waits named (global ranks), whether it wants the
+   transport degraded (repeated wire corruption), and the step of its
+   newest in-memory rollback snapshot.
+2. **Converge** — each rank polls the votes present so far, unions every
+   voter's suspects, and derives ``expected = participants - suspects``.
+   When all *expected* ranks have voted, the survivor set is ``expected``
+   and ``degrade`` is the OR of the votes. All ranks are stuck in (or
+   just failed out of) the same collective, so every survivor reaches
+   this rung within one bridge timeout of the first.
+3. **Decide** — the first converged rank claims the decision slot with
+   an atomic counter and publishes the record (no standing leader: the
+   claim elects a writer per generation, so two ranks converging with
+   different vote subsets cannot publish divergent records). Every other
+   rank — including a late, falsely-suspected live one — adopts the
+   published decision instead of re-deriving it; if the decision
+   excludes it, it raises :class:`EvictedError`.
+4. **Ack barrier** — survivors bump a counter and wait until every
+   survivor has acked, so nobody starts generation N+1 collectives while
+   a peer is still tearing down generation N.
+
+A rendezvous that cannot converge within ``timeout_s`` (survivors died
+mid-negotiation, store gone) raises :class:`RecoveryFailedError` — the
+job falls back to the pre-supervisor failure semantics: die loudly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ..utils.logging import get_logger, metrics
+from .errors import EvictedError, RecoveryFailedError
+
+log = get_logger()
+
+KEY_PREFIX = "cgxrdz"
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    """The converged outcome of one generation rendezvous. All rank ids
+    are GLOBAL (original-world) ranks. ``replay_step`` is the agreed
+    rollback point — the MINIMUM of the survivors' voted snapshot steps
+    (None when no survivor holds a snapshot): survivors can drift apart
+    by whole steps around a fault (a rank whose collectives are
+    send-only never blocks on the dead peer), and replaying from
+    per-rank local snapshots would pair wrong-step payloads under
+    identical post-recovery keys."""
+
+    generation: int
+    survivors: Tuple[int, ...]
+    evicted: Tuple[int, ...]
+    degrade: bool
+    replay_step: Optional[int] = None
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "generation": self.generation,
+                "survivors": list(self.survivors),
+                "evicted": list(self.evicted),
+                "degrade": self.degrade,
+                "replay_step": self.replay_step,
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "Decision":
+        d = json.loads(raw)
+        rs = d.get("replay_step")
+        return cls(
+            generation=int(d["generation"]),
+            survivors=tuple(d["survivors"]),
+            evicted=tuple(d["evicted"]),
+            degrade=bool(d["degrade"]),
+            replay_step=int(rs) if rs is not None else None,
+        )
+
+
+def _flag_set(store, key: str) -> bool:
+    """Non-blocking presence probe via the add-counter flag convention
+    (``<key>/flag``). Never issues a blocking get."""
+    try:
+        return int(store.add(key + "/flag", 0)) > 0
+    except Exception as e:
+        log.warning("rendezvous: flag probe for %r failed: %s", key, e)
+        return False
+
+
+def _publish(store, key: str, payload: str) -> None:
+    """Payload first, flag second: a reader that sees the flag is
+    guaranteed a complete payload under every c10d store's per-key
+    atomicity."""
+    store.set(key, payload.encode())
+    store.add(key + "/flag", 1)
+
+
+def _read(store, key: str) -> str:
+    return bytes(store.get(key)).decode()
+
+
+def negotiate(
+    store,
+    *,
+    generation: int,
+    me: int,
+    participants: Sequence[int],
+    suspects: Sequence[int] = (),
+    degrade: bool = False,
+    snapshot_step: Optional[int] = None,
+    timeout_s: float = 30.0,
+    poll_s: float = 0.05,
+    key_prefix: str = KEY_PREFIX,
+) -> Decision:
+    """Run one generation rendezvous; returns the agreed :class:`Decision`.
+
+    ``me``/``participants``/``suspects`` are GLOBAL ranks; ``participants``
+    is the CURRENT survivor set (pre-shrink). ``snapshot_step`` is this
+    rank's newest in-memory rollback point (None = holds none); the
+    decision pins ``replay_step`` to the minimum across the survivor
+    votes so every survivor replays the SAME steps. Raises
+    :class:`EvictedError` when the group converges on a survivor set
+    excluding ``me``, and :class:`RecoveryFailedError` when no decision
+    lands within ``timeout_s``.
+    """
+    participants = sorted(participants)
+    if me not in participants:
+        raise ValueError(f"rank {me} not in participants {participants}")
+    base = f"{key_prefix}/g{generation}"
+    deadline = time.monotonic() + timeout_s
+    _publish(
+        store,
+        f"{base}/v{me}",
+        json.dumps(
+            {"suspects": sorted(set(int(s) for s in suspects)),
+             "degrade": bool(degrade),
+             "snap": int(snapshot_step) if snapshot_step is not None
+             else None},
+            sort_keys=True,
+        ),
+    )
+    metrics.add("cgx.recovery.rendezvous_started")
+    votes: Dict[int, dict] = {}
+    decision: Optional[Decision] = None
+    while True:
+        # A published decision always wins — late arrivals (including a
+        # falsely suspected live rank) adopt it instead of re-deriving.
+        if _flag_set(store, f"{base}/decision"):
+            decision = Decision.from_json(_read(store, f"{base}/decision"))
+            break
+        for p in participants:
+            if p not in votes and _flag_set(store, f"{base}/v{p}"):
+                votes[p] = json.loads(_read(store, f"{base}/v{p}"))
+        union: set = set()
+        for v in votes.values():
+            union.update(int(s) for s in v.get("suspects", ()))
+        expected = [p for p in participants if p not in union]
+        if expected and all(p in votes for p in expected):
+            # Claim the decision slot atomically before publishing: two
+            # ranks can reach convergence holding DIFFERENT vote subsets
+            # (a late vote landing between their polls), so concurrent
+            # publishes could write divergent records over the same key
+            # and split-brain the group. Only the claim winner derives
+            # and publishes; losers loop back and adopt its record (the
+            # winner's publish is at most one poll away).
+            if int(store.add(f"{base}/decision/claim", 1)) == 1:
+                snaps = [
+                    votes[p]["snap"] for p in expected
+                    if votes[p].get("snap") is not None
+                ]
+                decision = Decision(
+                    generation=generation,
+                    survivors=tuple(expected),
+                    evicted=tuple(p for p in participants if p in union),
+                    degrade=any(v.get("degrade") for v in votes.values()),
+                    replay_step=min(snaps) if snaps else None,
+                )
+                _publish(store, f"{base}/decision", decision.to_json())
+                break
+        if time.monotonic() > deadline:
+            metrics.add("cgx.recovery.rendezvous_failed")
+            raise RecoveryFailedError(
+                f"recovery rendezvous for generation {generation} did not "
+                f"converge within {timeout_s:.1f}s: votes from "
+                f"{sorted(votes)}, expected {expected or participants} "
+                "(survivors died mid-negotiation, or the store is gone)"
+            )
+        time.sleep(poll_s)
+    if me not in decision.survivors:
+        metrics.add("cgx.recovery.self_evicted")
+        raise EvictedError(
+            f"recovery rendezvous for generation {generation} converged on "
+            f"survivors {list(decision.survivors)} — this rank ({me}) was "
+            "evicted by its peers"
+        )
+    # Ack barrier: nobody proceeds into generation-N collectives until
+    # every survivor has adopted the decision.
+    store.add(f"{base}/ack", 1)
+    while int(store.add(f"{base}/ack", 0)) < len(decision.survivors):
+        if time.monotonic() > deadline:
+            metrics.add("cgx.recovery.rendezvous_failed")
+            raise RecoveryFailedError(
+                f"recovery rendezvous for generation {generation}: "
+                "decision reached but the ack barrier did not fill within "
+                f"{timeout_s:.1f}s (a survivor died after voting)"
+            )
+        time.sleep(poll_s)
+    metrics.add("cgx.recovery.rendezvous_converged")
+    return decision

@@ -1,0 +1,64 @@
+"""Rung 1 of the recovery ladder: the bounded-wait retry policy.
+
+Shared by every bounded wait in the data plane — the backend's
+``_wait_key`` store park and the standalone ``ShmChannel``'s header poll
+— so the backoff curve, the telemetry, and the named-dead-suspect
+short-circuit live in exactly one place (the two call sites had started
+to diverge when each carried its own copy).
+
+The rung is local by construction: re-arming an expired wait needs no
+cross-rank coordination and changes no wire byte, which is why it sits
+below the rendezvous-coordinated rungs in ``docs/ROBUSTNESS.md``. With
+``CGX_RECOVERY_RETRIES`` unset (the default) :meth:`WaitRetry.attempt`
+always returns False and the wait raises exactly as it did pre-recovery.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Sequence
+
+from .. import config as cfg
+from ..observability import flightrec
+from ..observability import timeline
+from ..utils.logging import metrics
+
+_BACKOFF_CAP_S = 5.0
+
+
+class WaitRetry:
+    """Per-wait retry state (``CGX_RECOVERY_RETRIES`` /
+    ``CGX_RECOVERY_BACKOFF_MS``): exponential backoff with up-to-50%
+    uniform jitter so retrying ranks do not stampede the store in
+    lockstep. Construct one per logical wait; every expired deadline
+    calls :meth:`attempt` once."""
+
+    def __init__(self, op: str):
+        self._op = op
+        self.remaining = cfg.recovery_retries()
+        self._backoff_s = cfg.recovery_backoff_ms() / 1000.0
+
+    def attempt(self, key: str, suspects: Sequence[int] = ()) -> bool:
+        """One expired bounded wait. True: a backoff was slept and the
+        caller re-arms its deadline and waits again. False: the rung is
+        exhausted — or a heartbeat-named ``suspects`` short-circuits it
+        (a SIGKILL'd peer will not come back, and the supervisor's
+        eviction rung needs the error promptly) — and the caller raises.
+        """
+        if self.remaining <= 0 or suspects:
+            return False
+        self.remaining -= 1
+        pause = self._backoff_s * (1.0 + random.random() * 0.5)
+        self._backoff_s = min(self._backoff_s * 2, _BACKOFF_CAP_S)
+        metrics.add("cgx.recovery.retries")
+        flightrec.record(
+            "recovery_retry", op=self._op, key=key,
+            remaining=self.remaining, backoff_s=round(pause, 4),
+        )
+        timeline.record(
+            "recovery.retry", timeline.CAT_RECOVERY,
+            time.perf_counter(), pause, key=key,
+        )
+        time.sleep(pause)
+        return True

@@ -65,7 +65,12 @@ from .. import config as cfg
 from ..observability import flightrec
 from ..observability import timeline
 from ..robustness import faults as faults_mod
-from ..robustness.errors import BridgeTimeoutError, WireCorruptionError
+from ..robustness import retry as retry_mod
+from ..robustness.errors import (
+    BridgeTimeoutError,
+    StaleGenerationError,
+    WireCorruptionError,
+)
 from ..utils.logging import get_logger, metrics
 
 log = get_logger()
@@ -218,8 +223,8 @@ class _GenFile:
     def close(self, unlink: bool = True) -> None:
         try:
             self.mm.close()
-        except Exception:
-            pass
+        except (OSError, ValueError, BufferError):
+            pass  # exported buffers may pin the map; fd close still runs
         try:
             os.close(self.fd)  # releases the ownership flock
         except OSError:
@@ -421,6 +426,28 @@ class ShmArena:
             time.sleep(min(backoff, deadline - now if deadline > now else 0))
             backoff = min(backoff * 2, 0.2)
 
+    def abandon_pending(self) -> int:
+        """Mark every pending region freed and reclaim — the epoch-bump
+        drain: messages framed under a pre-recovery generation will never
+        be acked (their readers were evicted, died, or discarded the
+        stale header), so their bytes must not pin the ring forever.
+        Returns the number of regions abandoned."""
+        with self._lock:
+            n = 0
+            drop: List[str] = []
+            for r in self._pending:
+                if not r.freed:
+                    r.freed = True
+                    n += 1
+                    if r.ack_key:
+                        drop.append(r.ack_key)
+                        drop.append(r.ack_key[: -len("/ack")])
+            # With every region freed, _reclaim's ack polls all skip and
+            # its tail-advance/generation-close passes do the drain.
+            self._reclaim()
+            self._drop_keys(drop)
+        return n
+
     def close(self) -> None:
         with self._lock:
             for gf in self._gens.values():
@@ -458,6 +485,12 @@ class ShmChannel:
         name = f"cgx-{uuid.uuid4().hex[:12]}-p{os.getpid()}-r{rank}"
         self._injector = faults_mod.get_injector(rank)
         self._checksum = cfg.wire_checksum()
+        # Recovery generation (epoch) of the group this channel serves.
+        # 0 = never reconfigured: headers keep the legacy 5-field format
+        # byte-for-byte. After a bump, headers carry a trailing ``e<N>``
+        # field and takes discard any message tagged with an older epoch
+        # instead of decoding it into the new group (supervisor.py).
+        self._epoch = 0
         bt = cfg.bridge_timeout_ms()
         self._timeout_s = bt / 1000.0 if bt else 300.0
         self._arena = ShmArena(
@@ -517,7 +550,20 @@ class ShmChannel:
         if inj is not None and inj.fire("drop_put"):
             return  # header never published: the reader's bounded wait fires
         path = self._arena.path_of(gen)
-        self._store.set(hkey, f"{path}:{gen}:{off}:{size}:{crc}".encode())
+        hdr = f"{path}:{gen}:{off}:{size}:{crc}"
+        if self._epoch:
+            hdr += f":e{self._epoch}"  # generation tag (parsed by take)
+        if inj is not None:
+            flap_s = inj.flap_delay()
+            if flap_s is not None:
+                # Transient drop-then-recover: publish the header LATE from
+                # a timer thread. The reader's first bounded wait may
+                # expire; the recovery retry rung's re-armed wait succeeds.
+                threading.Timer(
+                    flap_s, self._store.set, (hkey, hdr.encode())
+                ).start()
+                return
+        self._store.set(hkey, hdr.encode())
         dt = time.perf_counter() - t0
         metrics.observe("cgx.shm.put_s", dt)
         metrics.add("cgx.shm.put_bytes", float(size))
@@ -555,6 +601,28 @@ class ShmChannel:
             "shm.take.wait", timeline.CAT_WAIT, t0, t_hdr - t0, key=key
         )
         hdr = bytes(hdr_raw).decode()
+        # Optional trailing generation tag (``:e<N>``): unambiguous against
+        # the legacy 5-field format because the crc field is a plain int.
+        epoch = 0
+        head, _, tail = hdr.rpartition(":")
+        if tail.startswith("e") and tail[1:].isdigit():
+            epoch = int(tail[1:])
+            hdr = head
+        if epoch != self._epoch:
+            # A message from another generation must be DISCARDED, never
+            # decoded: its bytes describe a group (chunking, survivor set)
+            # that no longer exists. Ack it so the writer's arena drains.
+            metrics.add("cgx.recovery.stale_discards")
+            self._store.add(hkey + "/ack", 1)
+            err = StaleGenerationError(
+                f"cgx shm: message {key!r} is tagged generation {epoch} "
+                f"but this channel is at generation {self._epoch} — "
+                "stale pre-recovery traffic discarded",
+                found=epoch,
+                current=self._epoch,
+            )
+            flightrec.record_failure(err, op="shm.take", key=key)
+            raise err
         path, _gen, off_s, size_s, crc_s = hdr.rsplit(":", 4)
         off, size, crc = int(off_s), int(size_s), int(crc_s)
         try:
@@ -617,9 +685,19 @@ class ShmChannel:
         timeout, which would let that timeout trump ours — so when the
         store supports ``wait(keys, timeout)`` the park happens in 200 ms
         slices with our deadline checked between them; stores without
-        ``wait`` (test doubles) are polled with exponential backoff."""
+        ``wait`` (test doubles) are polled with exponential backoff.
+
+        With ``CGX_RECOVERY_RETRIES`` set, an expired deadline is re-armed
+        through the shared :class:`~..robustness.retry.WaitRetry` rung
+        before the error raises — the recovery ladder's rung 1, which
+        absorbs transient ``flap``/straggler faults without any
+        cross-rank coordination. (A standalone channel has no heartbeat
+        peer map, so the suspect short-circuit never engages here.)"""
         import datetime as _dt
 
+        # Lazy: the env-derived retry policy is only read on an expired
+        # deadline, never on the per-message fast path.
+        retry: Optional[retry_mod.WaitRetry] = None
         deadline = time.monotonic() + self._timeout_s
         backoff = 0.0005
         slice_ = _dt.timedelta(milliseconds=200)
@@ -636,9 +714,15 @@ class ShmChannel:
             else:
                 try:
                     return self._store.get(hkey)
-                except Exception:
-                    pass
+                except (KeyError, IndexError, OSError, RuntimeError,
+                        ValueError):
+                    pass  # key not there yet: poll again below
             if time.monotonic() >= deadline:
+                if retry is None:
+                    retry = retry_mod.WaitRetry("shm.take")
+                if retry.attempt(hkey):
+                    deadline = time.monotonic() + self._timeout_s
+                    continue
                 metrics.add("cgx.bridge_timeout")
                 err = BridgeTimeoutError(
                     f"cgx shm: timed out after {self._timeout_s:.1f}s "
@@ -702,17 +786,44 @@ class ShmChannel:
                     del self._attached[p]
             return np.frombuffer(mm, np.uint8, count=size, offset=off).copy()
 
+    def bump_epoch(self, epoch: int) -> None:
+        """Advance this channel's recovery generation: newly framed
+        headers carry the tag, takes discard older-tagged messages, every
+        cached reader mapping is dropped (a peer may be rebuilding its
+        arena), and the writer's own pending regions are abandoned — the
+        drain-on-epoch-bump contract (docs/ROBUSTNESS.md Recovery)."""
+        if epoch <= self._epoch:
+            return
+        self._epoch = epoch
+        abandoned = self._arena.abandon_pending()
+        with self._attach_lock:
+            for mm in self._attached.values():
+                try:
+                    mm.close()
+                except (OSError, ValueError, BufferError):
+                    pass
+            self._attached.clear()
+        metrics.add("cgx.recovery.epoch_bumps")
+        flightrec.record(
+            "recovery", phase="shm_epoch_bump", epoch=epoch,
+            abandoned_regions=abandoned,
+        )
+        log.info(
+            "cgx shm: channel advanced to generation %d (%d stale pending "
+            "regions abandoned)", epoch, abandoned,
+        )
+
     def close(self) -> None:
         try:  # drop the crash-path safety net: a closed channel must not
             # be pinned (store handle + mmap cache) for the process life
             atexit.unregister(self.close)
-        except Exception:
-            pass
+        except (ValueError, RuntimeError):
+            pass  # never registered / interpreter shutting down
         self._arena.close()
         with self._attach_lock:
             for mm in self._attached.values():
                 try:
                     mm.close()
-                except Exception:
+                except (OSError, ValueError, BufferError):
                     pass
             self._attached.clear()
