@@ -120,3 +120,86 @@ def test_published_floor_wins_over_history():
                 "unit": "GB/s"}]
     b = gate.build_baselines(history, published={"m": 0.8})
     assert b["m"] == 0.8
+
+
+# ---------------------------------------------------------------------------
+# CPU-placeholder separation (ISSUE 6 satellite): rows that ran on the CPU
+# stand-in during the flaky-transport rounds (BENCH_r05's
+# device_init_failure incident) must form their own trajectory and never
+# dilute — or be judged against — chip truth.
+# ---------------------------------------------------------------------------
+
+
+def _chip_row(value, **kw):
+    return {"tool": "bench", "metric": "pallas_codec_roundtrip",
+            "value": value, "unit": "GB/s", "chip": "TPU v5 lite",
+            "backend": "tpu", **kw}
+
+
+def _cpu_row(value, **kw):
+    return {"tool": "bench", "metric": "pallas_codec_roundtrip",
+            "value": value, "unit": "GB/s", "chip": "cpu",
+            "backend": "cpu", **kw}
+
+
+def test_placeholder_rows_key_into_their_own_trajectory():
+    gate = _load_gate()
+    assert gate.normalize(_chip_row(100.0)) == (
+        "pallas_codec_roundtrip", 100.0)
+    assert gate.normalize(_cpu_row(2.0)) == (
+        "pallas_codec_roundtrip@cpu", 2.0)
+    # detail.chip tagging (older rows carried the chip inside detail)
+    rec = {"tool": "bench", "metric": "pallas_codec_roundtrip",
+           "value": 3.0, "unit": "GB/s", "detail": {"chip": "cpu"}}
+    assert gate.normalize(rec) == ("pallas_codec_roundtrip@cpu", 3.0)
+    # host-side tools are genuinely host metrics, NOT placeholders
+    host = {"tool": "shm_bench", "metric": "bridge_put_take",
+            "value": 1.0, "unit": "GB/s", "backend": "host"}
+    assert gate.normalize(host) == ("bridge_put_take", 1.0)
+
+
+def test_placeholder_rows_never_dilute_chip_median():
+    gate = _load_gate()
+    # three cpu stand-ins around two real chip rows: the chip baseline
+    # must stay the chip median, not collapse toward the placeholders
+    hist = [_chip_row(100.0), _cpu_row(2.0), _chip_row(110.0),
+            _cpu_row(2.1), _cpu_row(1.9)]
+    b = gate.build_baselines(hist)
+    assert b["pallas_codec_roundtrip"] == pytest.approx(105.0)
+    assert b["pallas_codec_roundtrip@cpu"] == pytest.approx(2.0)
+
+
+def test_published_floor_is_a_chip_promise_never_cpu():
+    gate = _load_gate()
+    b = gate.build_baselines(
+        [_cpu_row(2.0)],
+        published={"pallas_codec_roundtrip": 90.0,
+                   "pallas_codec_roundtrip@cpu": 50.0},
+    )
+    # the floor lands on the chip key; a floor on a placeholder key is
+    # refused outright (nothing could ever meet it honestly)
+    assert b["pallas_codec_roundtrip"] == 90.0
+    assert b["pallas_codec_roundtrip@cpu"] == pytest.approx(2.0)
+
+
+def test_placeholder_candidate_never_meets_chip_floor():
+    gate = _load_gate()
+    regs, checks = gate.gate(
+        [_cpu_row(2.0)], {"pallas_codec_roundtrip": 100.0}, 30.0)
+    # different trajectory key: not compared at all, not a regression
+    assert not regs and not checks
+
+
+def test_smoke_skips_placeholder_only_trajectories():
+    gate = _load_gate()
+    # a placeholder trajectory with a sustained 10x cliff: smoke must not
+    # gate it (it proves the code path runs, it defends no floor)...
+    hist = [_cpu_row(2.0), _cpu_row(2.1), _cpu_row(0.2), _cpu_row(0.2),
+            _cpu_row(0.2)]
+    regs, checks = gate.smoke(hist, threshold_pct=30.0)
+    assert regs == [] and checks == []
+    # ...while the same cliff on chip truth still fails loudly
+    hist = [_chip_row(100.0), _chip_row(101.0), _chip_row(10.0),
+            _chip_row(10.0), _chip_row(10.0)]
+    regs, _ = gate.smoke(hist, threshold_pct=30.0)
+    assert regs and regs[0]["metric"] == "pallas_codec_roundtrip"

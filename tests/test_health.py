@@ -1,0 +1,697 @@
+"""Live health plane tests (ISSUE 6).
+
+Unit suite: online estimators (EWMA, P² quantiles) against numpy oracles,
+straggler scoring from synthetic collective-phase skew, sustained-gate +
+cooldown event semantics, SLO breach events over the live qerr stream,
+Prometheus text exposition (pure render + a real scrape over the stdlib
+endpoint), the leader-side cluster health merge, `cgx_top` rendering, and
+inertness with every `CGX_HEALTH_*` / `CGX_PROM_PORT` knob unset.
+
+Chaos acceptance (`torch_bridge`): a 2-rank bridge run with a `slow_rank`
+fault — the health plane flags the lagging rank strictly before the
+bridge timeout could fire (the bounded wait never expires at all), the
+recovery supervisor records the straggler as suspect evidence, and a live
+scrape of the Prometheus port returns parseable exposition with ``cgx_``
+samples. The inertness half of the acceptance (env unset ⇒ grad_sync
+bit-identity unchanged) is carried by the existing test_grad_sync suite,
+which runs with all CGX_* env cleared.
+"""
+
+from __future__ import annotations
+
+import json
+import multiprocessing as mp
+import os
+import re
+import sys
+import tempfile
+import time
+import traceback
+import types
+import urllib.request
+
+import numpy as np
+import pytest
+
+from torch_cgx_tpu.observability import health, watch
+from torch_cgx_tpu.utils.logging import metrics
+
+from test_faults import FakeStore
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    metrics.reset()
+    yield
+    health.stop()
+    watch.stop_prom()
+    metrics.reset()
+
+
+# ---------------------------------------------------------------------------
+# Online estimators vs numpy oracles.
+# ---------------------------------------------------------------------------
+
+
+def test_ewma_matches_numpy_recurrence_oracle():
+    rng = np.random.default_rng(0)
+    xs = rng.exponential(scale=0.3, size=200)
+    hl = 8.0
+    e = health.Ewma(half_life=hl)
+    alpha = 1.0 - 2.0 ** (-1.0 / hl)
+    oracle = xs[0]
+    for x in xs:
+        e.update(x)
+    for x in xs[1:]:
+        oracle = oracle + alpha * (x - oracle)
+    assert e.value == pytest.approx(float(oracle), rel=1e-12)
+    assert e.n == len(xs)
+
+
+def test_ewma_halflife_semantics():
+    # after exactly half_life samples of 0 from a start of 1.0 the value
+    # has halved — that IS the definition of the half-life
+    e = health.Ewma(half_life=16.0)
+    e.update(1.0)
+    for _ in range(16):
+        e.update(0.0)
+    assert e.value == pytest.approx(0.5, rel=1e-9)
+
+
+@pytest.mark.parametrize("q,tol", [(0.5, 0.02), (0.9, 0.02), (0.99, 0.02)])
+def test_p2_quantile_vs_numpy_uniform(q, tol):
+    rng = np.random.default_rng(7)
+    xs = rng.uniform(size=5000)
+    est = health.P2Quantile(q)
+    for x in xs:
+        est.update(x)
+    assert abs(est.value() - np.percentile(xs, q * 100)) < tol
+
+
+def test_p2_quantile_vs_numpy_exponential():
+    # heavier tail than uniform: the estimator must still track p99
+    rng = np.random.default_rng(11)
+    xs = rng.exponential(scale=1.0, size=8000)
+    est = health.P2Quantile(0.99)
+    for x in xs:
+        est.update(x)
+    true = float(np.percentile(xs, 99))
+    assert abs(est.value() - true) < 0.15 * true
+
+
+def test_p2_quantile_exact_below_five_observations():
+    est = health.P2Quantile(0.5)
+    assert est.value() == 0.0
+    for x in (5.0, 1.0, 3.0):
+        est.update(x)
+    assert est.value() == 3.0  # exact: sorted()[1] of three samples
+    with pytest.raises(ValueError):
+        health.P2Quantile(1.5)
+
+
+# ---------------------------------------------------------------------------
+# Straggler scoring from synthetic collective-phase skew.
+# ---------------------------------------------------------------------------
+
+
+def _skewed_engine(monkeypatch, **kw):
+    """Engine with peers 1/2 answering in 10 ms and peer 3's wait
+    in-flight and 1.2 s old, on a fully controlled clock."""
+    eng = health.HealthEngine(0, straggler_factor=3.0, **kw)
+    clock = {"t": 100.0}
+    monkeypatch.setattr(health.time, "perf_counter", lambda: clock["t"])
+    for peer in (1, 2):
+        for _ in range(4):
+            tok = eng.wait_begin(peer, "k")
+            clock["t"] += 0.01
+            eng.wait_end(tok)
+    eng.wait_begin(3, "k")
+    clock["t"] += 1.2
+    return eng, clock
+
+
+def test_straggler_scores_from_synthetic_skew(monkeypatch):
+    eng, clock = _skewed_engine(monkeypatch)
+    scores = eng.straggler_scores(clock["t"])
+    # peer 3: 1.2 s in-flight over the floored 10 ms median = way past 3x
+    assert scores[3] >= 3.0
+    # the healthy peers are judged against the straggler's signal in
+    # their median — nowhere near the gate
+    assert scores[1] < 1.0 and scores[2] < 1.0
+
+
+def test_straggler_event_sustained_gate_and_cooldown(monkeypatch):
+    eng, _ = _skewed_engine(monkeypatch)
+    got = []
+    eng.add_consumer(got.append)  # plain function: held strongly
+    assert eng.sample() == []  # tick 1: firing but not yet sustained
+    out = eng.sample()  # tick 2: sustained -> emitted
+    assert [e.kind for e in out] == ["straggler"]
+    ev = out[0]
+    assert ev.suspect == 3 and ev.rank == 0
+    assert ev.value >= ev.threshold == 3.0
+    assert dict(ev.detail)["wait_s"] >= 1.2
+    assert got == [ev]  # consumer saw exactly the emitted event
+    # cooldown: the sustained condition stays ONE event stream
+    assert eng.sample() == []
+    assert metrics.get("cgx.health.events") == 1
+    assert metrics.get("cgx.health.events.straggler") == 1
+    # per-peer gauges are exported every tick regardless
+    assert metrics.get("cgx.health.straggler.r3") >= 3.0
+
+
+def test_forget_peers_clears_straggler_state(monkeypatch):
+    eng, _ = _skewed_engine(monkeypatch)
+    eng.sample()
+    assert eng.sample()  # sustained -> emitted
+    eng.forget_peers()
+    # per-peer signals, sustain bookkeeping and gauges are all gone: a
+    # new generation starts clean instead of re-emitting the evicted
+    # peer's frozen wait EWMA every cooldown window
+    assert eng.straggler_scores() == {}
+    assert eng.sample() == []
+    assert metrics.get("cgx.health.straggler.r3") == 0.0
+
+
+def test_invalidate_trace_caches_forgets_health_peers(monkeypatch):
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    eng = health.maybe_start(0)
+    tok = eng.wait_begin(3, "k")
+    from torch_cgx_tpu.robustness import supervisor as sup_mod
+
+    sup_mod.invalidate_trace_caches()
+    with eng._lock:
+        assert eng._peers == {} and eng._inflight == {}
+    eng.wait_end(tok)  # dead-generation token: no-op, not a crash
+
+
+def test_dead_weak_consumer_is_dropped(monkeypatch):
+    eng, _ = _skewed_engine(monkeypatch)
+
+    class Sink:
+        def __init__(self):
+            self.got = []
+
+        def cb(self, ev):
+            self.got.append(ev)
+
+    sink = Sink()
+    eng.add_consumer(sink.cb)  # bound method: held weakly
+    del sink
+    eng.sample()
+    assert eng.sample()  # emits without raising into the dead ref
+    with eng._lock:
+        assert eng._consumers == []
+
+
+def test_raising_consumer_does_not_kill_emission(monkeypatch):
+    eng, _ = _skewed_engine(monkeypatch)
+    got = []
+
+    def bad(ev):
+        raise RuntimeError("consumer bug")
+
+    eng.add_consumer(bad)
+    eng.add_consumer(got.append)
+    eng.sample()
+    assert eng.sample()
+    assert len(got) == 1
+
+
+# ---------------------------------------------------------------------------
+# Step-time regression, qerr SLO, arena pressure.
+# ---------------------------------------------------------------------------
+
+
+def test_step_regression_event_fast_vs_slow_ewma():
+    eng = health.HealthEngine(0, step_factor=2.0)
+    for _ in range(20):
+        eng.note_step(0.1)
+    for _ in range(10):
+        eng.note_step(1.0)
+    assert eng.sample() == []  # sustain gate
+    out = eng.sample()
+    assert [e.kind for e in out] == ["step_regression"]
+    d = dict(out[0].detail)
+    assert d["fast_s"] > d["slow_s"] > 0
+    st = eng.status()["step"]
+    assert st["n"] == 30
+    assert st["p50_s"] > 0 and st["p99_s"] >= st["p50_s"]
+
+
+def test_no_step_regression_on_steady_cadence():
+    eng = health.HealthEngine(0, step_factor=2.0)
+    for _ in range(40):
+        eng.note_step(0.1)
+    assert eng.sample() == [] and eng.sample() == []
+
+
+def test_qerr_slo_breach_event():
+    eng = health.HealthEngine(0, qerr_slo=0.05)
+    for _ in range(10):
+        metrics.observe("cgx.qerr.dense/kernel", 0.2)
+    eng.sample()
+    out = eng.sample()
+    assert [e.kind for e in out] == ["qerr_slo"]
+    assert dict(out[0].detail)["layer"] == "dense/kernel"
+    assert out[0].value == pytest.approx(0.2)
+
+
+def test_qerr_slo_quiet_below_threshold():
+    eng = health.HealthEngine(0, qerr_slo=0.5)
+    for _ in range(10):
+        metrics.observe("cgx.qerr.dense/kernel", 0.2)
+    assert eng.sample() == [] and eng.sample() == []
+
+
+def test_arena_pressure_trend_event():
+    eng = health.HealthEngine(0)
+    metrics.add("cgx.arena_pressure_waits")
+    assert eng.sample() == []  # first tick establishes the window
+    metrics.add("cgx.arena_pressure_waits", 2.0)
+    out = eng.sample()
+    assert [e.kind for e in out] == ["arena_pressure"]
+    assert out[0].value == 2.0
+    # no further movement -> no further events
+    assert eng.sample() == []
+
+
+# ---------------------------------------------------------------------------
+# Event/status files (what cgx_top and the chaos suite read).
+# ---------------------------------------------------------------------------
+
+
+def test_event_and_status_files_written(tmp_path, monkeypatch):
+    monkeypatch.setenv("CGX_METRICS_DIR", str(tmp_path))
+    eng, _ = _skewed_engine(monkeypatch)
+    eng.sample()
+    eng.sample()
+    events = [
+        json.loads(line)
+        for line in open(tmp_path / "health-rank0.jsonl")
+    ]
+    assert [e["kind"] for e in events] == ["straggler"]
+    assert events[0]["suspect"] == 3
+    status = json.load(open(tmp_path / "health-status-rank0.json"))
+    assert status["rank"] == 0
+    assert float(status["straggler_scores"]["3"]) >= 3.0
+    assert status["events_recent"][-1]["kind"] == "straggler"
+
+
+def test_cgx_top_renders_synthetic_dir(tmp_path, monkeypatch):
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "cgx_top", os.path.join(_REPO, "tools", "cgx_top.py")
+    )
+    cgx_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cgx_top)
+    # one metrics export line + a health status + a flightrec failure
+    with open(tmp_path / "metrics-rank0.jsonl", "w") as f:
+        f.write(json.dumps({
+            "ts": 1000.0,
+            "counters": {"cgx.step.count": 10.0,
+                         "cgx.sra.bytes_in": 800.0,
+                         "cgx.sra.wire_bytes_out": 100.0},
+            "gauges": {"cgx.recovery.generation": 1.0},
+            "histograms": {"cgx.collective.allreduce_s": {
+                "count": 10, "p50": 0.002, "p99": 0.004}},
+        }) + "\n")
+    with open(tmp_path / "health-status-rank0.json", "w") as f:
+        json.dump({"rank": 0, "straggler_scores": {"1": 5.2},
+                   "step": {}, "events_recent": [
+                       {"kind": "straggler", "value": 5.2,
+                        "threshold": 3.0, "suspect": 1}]}, f)
+    with open(tmp_path / "flightrec-rank0.jsonl", "w") as f:
+        f.write(json.dumps({"kind": "failure",
+                            "error": "BridgeTimeoutError",
+                            "op": "allreduce"}) + "\n")
+    state: dict = {}
+    first = cgx_top.render(str(tmp_path), state)
+    assert "5.2→r1" in first  # worst straggler score
+    assert "8.0x" in first  # wire ratio 800/100
+    assert "BridgeTimeoutError(allreduce)" in first
+    assert "straggler" in first  # recent events block
+    # second frame with a step-count delta computes a rate
+    with open(tmp_path / "metrics-rank0.jsonl", "a") as f:
+        f.write(json.dumps({
+            "ts": 1002.0, "counters": {"cgx.step.count": 14.0},
+            "gauges": {}, "histograms": {},
+        }) + "\n")
+    second = cgx_top.render(str(tmp_path), state)
+    assert "2.00" in second  # (14-10)/(1002-1000) steps/s
+    # empty dir renders the hint, not a crash
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    assert "no metrics-rank" in cgx_top.render(str(empty), {})
+
+
+# ---------------------------------------------------------------------------
+# Prometheus exposition: pure render + a real scrape.
+# ---------------------------------------------------------------------------
+
+_SAMPLE_RE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? "
+    r"(NaN|[+-]Inf|[+-]?[0-9.eE+-]+)$"
+)
+
+
+def _assert_parses(body: str) -> None:
+    for line in body.strip().splitlines():
+        if line.startswith("#"):
+            assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
+                            r"(counter|gauge|summary)$", line), line
+        else:
+            assert _SAMPLE_RE.match(line), line
+
+
+def test_render_prometheus_text_exposition():
+    metrics.add("cgx.health.events", 3.0)
+    metrics.set("cgx.recovery.generation", 2.0)
+    metrics.observe("cgx.collective.allreduce_s", 0.002)
+    metrics.observe("cgx.collective.allreduce_s", 0.004)
+    status = {"straggler_scores": {"1": 4.5},
+              "step": {"ewma_fast_s": 0.1, "p99_s": 0.2}}
+    body = watch.render_prometheus(status=status, rank=3)
+    _assert_parses(body)
+    assert "cgx_health_events 3.0" in body
+    assert "cgx_recovery_generation 2.0" in body
+    assert '# TYPE cgx_collective_allreduce_s summary' in body
+    assert 'cgx_collective_allreduce_s{quantile="0.50"}' in body
+    assert "cgx_collective_allreduce_s_count 2.0" in body
+    assert 'cgx_health_straggler_score{peer="1"} 4.5' in body
+    assert 'cgx_up{rank="3"} 1.0' in body
+
+
+def test_prom_name_mangling():
+    assert watch._prom_name("cgx.sra.wire_bytes_out") == (
+        "cgx_sra_wire_bytes_out")
+    assert watch._prom_name("cgx.qerr.dense/kernel") == (
+        "cgx_qerr_dense_kernel")
+    assert watch._prom_name("0weird").startswith("_")
+
+
+def test_prom_server_scrape_and_port_publish(tmp_path, monkeypatch):
+    monkeypatch.setenv("CGX_METRICS_DIR", str(tmp_path))
+    metrics.add("cgx.health.events")
+    srv = watch.PromServer(0, rank=0).start()
+    try:
+        assert srv.port and srv.port > 0
+        published = json.load(open(tmp_path / "prom-rank0.json"))
+        assert published["port"] == srv.port
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ).read().decode()
+        _assert_parses(body)
+        assert "cgx_health_events" in body
+        assert metrics.get("cgx.health.prom_scrapes") == 1
+        hz = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/healthz", timeout=10
+        ).read().decode())
+        assert hz == {"rank": 0, "health_engine": "off"}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_maybe_start_prom_requires_knob_and_survives_bind_conflict(
+    monkeypatch,
+):
+    assert watch.maybe_start_prom() is None  # knob unset: no socket
+    srv = watch.PromServer(0, rank=0).start()
+    try:
+        # an occupied port degrades to a warning, never an exception
+        monkeypatch.setenv("CGX_PROM_PORT", str(srv.port))
+        assert watch.maybe_start_prom() is None
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leader-side cluster health merge over the store control plane.
+# ---------------------------------------------------------------------------
+
+
+def test_aggregate_health_over_store(tmp_path, monkeypatch):
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    monkeypatch.setenv("CGX_METRICS_DIR", str(tmp_path))
+    eng = health.maybe_start(0)
+    assert eng is not None
+    eng.note_step(0.25)
+    store = FakeStore()
+    # non-leader publishes and returns None
+    assert watch.aggregate_health_over_store(store, 1, 2) is None
+    view = watch.aggregate_health_over_store(store, 0, 2, timeout_s=2.0)
+    assert view is not None
+    assert view["world_size"] == 2
+    assert view["ranks_reporting"] == [0, 1]
+    assert view["missing_ranks"] == []
+    assert view["step_per_rank"][0]["n"] == 1
+    logged = [json.loads(line)
+              for line in open(tmp_path / "cluster-health.jsonl")]
+    assert logged[-1]["ranks_reporting"] == [0, 1]
+    # a silent rank is named within the bounded deadline, never waited on
+    assert watch.aggregate_health_over_store(store, 1, 3, round_id=1) is None
+    t0 = time.monotonic()
+    view = watch.aggregate_health_over_store(
+        store, 0, 3, round_id=1, timeout_s=0.3)
+    assert time.monotonic() - t0 < 5.0
+    assert view["missing_ranks"] == [2]
+
+
+def test_aggregate_is_noop_without_engine():
+    assert watch.aggregate_health_over_store(FakeStore(), 0, 2) is None
+
+
+# ---------------------------------------------------------------------------
+# Supervisor handoff (unit): a straggler event becomes suspect evidence.
+# ---------------------------------------------------------------------------
+
+
+def _stub_supervisor():
+    from torch_cgx_tpu.robustness.supervisor import RecoverySupervisor
+
+    group = types.SimpleNamespace(
+        global_rank=0, global_ranks=[0, 1], generation=0)
+    return RecoverySupervisor(FakeStore(), group)
+
+
+def _ev(kind="straggler", suspect=1, value=9.5):
+    return health.HealthEvent(
+        kind=kind, rank=0, value=value, threshold=3.0, suspect=suspect)
+
+
+def test_supervisor_records_straggler_hint():
+    sup = _stub_supervisor()
+    sup.note_health_event(_ev())
+    assert sup.suspect_hints == {1: 9.5}
+    assert metrics.get("cgx.recovery.health_hints") == 1
+    # non-straggler kinds and self-references are not evidence
+    sup.note_health_event(_ev(kind="step_regression", suspect=None))
+    sup.note_health_event(_ev(suspect=0))
+    assert sup.suspect_hints == {1: 9.5}
+
+
+def test_supervisor_hint_expires_after_ttl(monkeypatch):
+    sup = _stub_supervisor()
+    sup.note_health_event(_ev())
+    assert 1 in sup.suspect_hints
+    real = time.monotonic
+    monkeypatch.setattr(
+        "torch_cgx_tpu.robustness.supervisor.time.monotonic",
+        lambda: real() + sup.HINT_TTL_S + 1.0,
+    )
+    assert sup.suspect_hints == {}
+
+
+def test_supervisor_consumer_registered_with_live_engine(monkeypatch):
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    eng = health.maybe_start(0)
+    sup = _stub_supervisor()
+    eng._notify(_ev())  # engine-side delivery, not a direct call
+    assert sup.suspect_hints == {1: 9.5}
+
+
+# ---------------------------------------------------------------------------
+# Inertness: every knob unset (the conftest autouse fixture clears CGX_*).
+# ---------------------------------------------------------------------------
+
+
+def test_engine_inert_with_env_unset():
+    assert health.maybe_start(0) is None
+    assert not health.active()
+    assert health.get_engine() is None
+    assert health.wait_begin(1, "k") is None
+    health.wait_end(None)
+    health.note_step(0.1)  # no engine: pure no-op
+    assert health.add_consumer(lambda ev: None) is False
+    assert watch.maybe_start_prom() is None
+    # nothing leaked into the registry
+    assert metrics.snapshot("cgx.health.") == {}
+
+
+def test_engine_lifecycle_and_background_thread(monkeypatch):
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    monkeypatch.setenv("CGX_HEALTH_INTERVAL_S", "0.02")
+    eng = health.maybe_start(2)
+    assert eng is not None and health.active()
+    assert health.maybe_start(2) is eng  # idempotent
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if metrics.snapshot("cgx.health.step_ratio"):
+            break
+        time.sleep(0.02)
+    assert metrics.snapshot("cgx.health.step_ratio") != {}
+    health.stop()
+    assert not health.active()
+
+
+def test_maybe_start_rebinds_unknown_rank(monkeypatch):
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    eng = health.maybe_start(None)  # make_train_step before dist init
+    assert eng.rank == 0
+    assert health.maybe_start(3) is eng  # PG init passes the real rank
+    assert eng.rank == 3
+    assert health.maybe_start(1) is eng  # first real rank wins
+    assert eng.rank == 3
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: slow_rank flagged BEFORE the bridge timeout, the
+# supervisor holds the hint, and the Prometheus port scrapes live.
+# ---------------------------------------------------------------------------
+
+_CHAOS_STALL_MS = 2500
+_CHAOS_TIMEOUT_MS = 8000  # the bounded wait must never expire
+
+
+def _health_chaos_main(rank: int, ws: int, initfile: str, mdir: str, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, _REPO)
+        os.environ["CGX_BRIDGE_TIMEOUT_MS"] = str(_CHAOS_TIMEOUT_MS)
+        os.environ["CGX_HEALTH"] = "1"
+        os.environ["CGX_HEALTH_INTERVAL_S"] = "0.1"
+        os.environ["CGX_PROM_PORT"] = "0"
+        os.environ["CGX_METRICS_DIR"] = mdir
+        os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+        # rank 1 stalls 2.5 s entering its second collective — far below
+        # the 8 s bounded wait, far above the 0.1 s evaluator ticks
+        os.environ["CGX_FAULTS"] = (
+            f"slow_rank:1@{_CHAOS_STALL_MS}ms@step=1"
+        )
+        import datetime
+
+        import torch
+        import torch.distributed as dist
+
+        from torch_cgx_tpu.torch_backend.backend import ProcessGroupCGX
+        from torch_cgx_tpu.robustness.supervisor import RecoverySupervisor
+        from torch_cgx_tpu.utils.logging import metrics as m
+
+        store = dist.FileStore(initfile, ws)
+        pg = ProcessGroupCGX(store, rank, ws, datetime.timedelta(seconds=60))
+        sup = RecoverySupervisor(store, pg)
+        problems = []
+        for _step in range(2):
+            t = torch.full((4096,), float(rank + 1))
+            pg.allreduce([t]).wait()
+        expect = sum(float(r + 1) for r in range(ws))
+        if not bool(torch.allclose(
+            t, torch.full((4096,), expect), atol=0.5
+        )):
+            problems.append("wrong reduction")
+        if rank == 0:
+            # "strictly before the bridge timeout fires": the bounded
+            # wait never expired at all — zero timeouts, zero retries —
+            # yet the straggler event exists and reached the supervisor.
+            if m.get("cgx.bridge_timeout") != 0:
+                problems.append("bridge timeout fired")
+            if m.get("cgx.recovery.retries") != 0:
+                problems.append("retry rung engaged")
+            if m.get("cgx.health.events.straggler") < 1:
+                problems.append("no straggler event emitted")
+            hints = sup.suspect_hints
+            if 1 not in hints:
+                problems.append(f"supervisor missed the hint: {hints}")
+            if m.get("cgx.recovery.health_hints") < 1:
+                problems.append("health_hints counter untouched")
+            # live scrape while the job is still up
+            try:
+                port = json.load(
+                    open(os.path.join(mdir, "prom-rank0.json"))
+                )["port"]
+                body = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10
+                ).read().decode()
+                if "cgx_" not in body:
+                    problems.append("no cgx_ samples in exposition")
+                for line in body.strip().splitlines():
+                    if not line.startswith("#") and not _SAMPLE_RE.match(
+                        line
+                    ):
+                        problems.append(f"unparseable sample: {line!r}")
+                        break
+            except Exception as e:
+                problems.append(f"prometheus scrape failed: {e}")
+        pg.shutdown()
+        q.put((rank, "; ".join(problems) or None))
+    except Exception:
+        q.put((rank, traceback.format_exc()))
+
+
+@pytest.mark.torch_bridge
+def test_chaos_slow_rank_flagged_before_bridge_timeout(tmp_path):
+    """ISSUE 6 chaos acceptance (see module docstring)."""
+    mdir = str(tmp_path / "metrics")
+    initfile = tempfile.mktemp(prefix="cgx_health_store_")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(
+            target=_health_chaos_main, args=(r, 2, initfile, mdir, q)
+        )
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    results = {}
+    for _ in range(2):
+        rank, err = q.get(timeout=180)
+        results[rank] = err
+    for p in procs:
+        p.join(timeout=60)
+        if p.is_alive():
+            p.terminate()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    for rank, err in sorted(results.items()):
+        assert err is None, f"rank {rank}: {err}"
+    # on-disk audit trail: the straggler event stream names global rank 1
+    events = [
+        json.loads(line)
+        for line in open(os.path.join(mdir, "health-rank0.jsonl"))
+    ]
+    stragglers = [e for e in events if e["kind"] == "straggler"]
+    assert stragglers and stragglers[0]["suspect"] == 1, events
+    # the stall the event measured sits strictly inside the timeout
+    assert dict(stragglers[0]["detail"])["wait_s"] * 1000 < _CHAOS_TIMEOUT_MS
+    # the supervisor's black box recorded the handoff
+    flight = [
+        json.loads(line)
+        for line in open(os.path.join(mdir, "flightrec-rank0.jsonl"))
+    ]
+    assert any(
+        e.get("kind") == "recovery" and e.get("phase") == "health_hint"
+        and e.get("suspect") == 1
+        for e in flight
+    ), [e.get("phase") for e in flight if e.get("kind") == "recovery"]
+    # the leader folded a cluster health view at shutdown
+    cluster = os.path.join(mdir, "cluster-health.jsonl")
+    assert os.path.exists(cluster)
+    view = json.loads(open(cluster).readlines()[-1])
+    assert 0 in view["ranks_reporting"]

@@ -307,6 +307,78 @@ def test_linter_accepts_namespaced_metrics_and_fstrings(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_linter_flags_undocumented_cgx_subnamespace(tmp_path):
+    # ISSUE 6 satellite: dotted `cgx.<sub>.` families must come from the
+    # documented set (now including cgx.health.*) — a typo'd family falls
+    # out of every report/dashboard prefix scan silently.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from .utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.helth.events')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "undocumented cgx sub-namespace" in proc.stdout
+    assert "helth" in proc.stdout
+
+
+def test_linter_accepts_health_subnamespace_and_flat_names(tmp_path):
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "good.py"
+    good.write_text(
+        "from .utils.logging import metrics\n"
+        "def f(peer, score, kind):\n"
+        "    metrics.add('cgx.health.events')\n"
+        "    metrics.set(f'cgx.health.straggler.r{peer}', score)\n"
+        "    metrics.add('cgx.arena_pressure_waits')\n"  # flat: allowed
+        "    metrics.add(f'cgx.{kind}.wire_bytes_out')\n"  # dynamic sub
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_flags_unbounded_poll_in_observability(tmp_path):
+    # ISSUE 6 satellite: the poll rule now covers observability/ — its
+    # background threads (health evaluator, Prometheus server) must park
+    # on a stop event or deadline, never free-spin.
+    odir = tmp_path / "torch_cgx_tpu" / "observability"
+    odir.mkdir(parents=True)
+    bad = odir / "bad.py"
+    bad.write_text(
+        "import time\n"
+        "def watch(q):\n"
+        "    while True:\n"
+        "        if q.poll():\n"
+        "            return q.get()\n"
+        "        time.sleep(0.1)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "unbounded wait" in proc.stdout
+
+
+def test_linter_accepts_bounded_poll_in_observability(tmp_path):
+    odir = tmp_path / "torch_cgx_tpu" / "observability"
+    odir.mkdir(parents=True)
+    good = odir / "good.py"
+    good.write_text(
+        "import time\n"
+        "def watch(q, deadline):\n"
+        "    while True:\n"
+        "        if q.poll():\n"
+        "            return q.get()\n"
+        "        if time.monotonic() >= deadline:\n"
+        "            return None\n"
+        "        time.sleep(0.1)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
 def _reducers_tree(tmp_path, body: str) -> Path:
     ldir = tmp_path / "torch_cgx_tpu" / "parallel"
     ldir.mkdir(parents=True)

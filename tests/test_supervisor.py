@@ -293,6 +293,20 @@ def test_invalidate_trace_caches_bumps_registry_version():
     assert cfg.registry_version() == v0 + 1
 
 
+def test_invalidate_trace_caches_resets_qerr_sampling():
+    # ISSUE 6 satellite: the flightrec qerr subsample cadence
+    # (allreduce._QERR_SEEN) must restart with the registry-version bump —
+    # post-recovery programs are a NEW qerr stream, and a stale per-layer
+    # counter would skip its first observations on the dead generation's
+    # phase.
+    from torch_cgx_tpu.parallel import allreduce as ar
+
+    ar._QERR_SEEN.clear()
+    ar._QERR_SEEN.update({"layer0/w": 17, "layer1/b": 3})
+    invalidate_trace_caches()
+    assert ar._QERR_SEEN == {}
+
+
 # ---------------------------------------------------------------------------
 # Generation-tagged shm headers + drain-on-epoch-bump.
 # ---------------------------------------------------------------------------

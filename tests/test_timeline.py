@@ -454,3 +454,40 @@ def test_two_rank_chaos_run_merges_into_chrome_trace(tmp_path):
     )
     assert proc.returncode == 0
     assert "step-time attribution" in proc.stdout
+
+
+def test_attribution_overlap_fraction_from_synthetic_spans(tmp_path):
+    # ISSUE 6 satellite: overlap fraction = share of collective wall time
+    # during which recorded trace_span compute was simultaneously live —
+    # computed on interval unions so nested spans don't double-count.
+    cgx_trace = _load_cgx_trace()
+    ev0 = [
+        _span("allreduce", "collective", 1.0, 0.5, seq=1),
+        _span("allreduce", "collective", 2.0, 0.5, seq=2),
+        # compute overlapping [1.25, 1.5) -> 0.25 s
+        _span("fwd", "span", 1.25, 0.5),
+        # compute overlapping [2.0, 2.1) -> 0.1 s ...
+        _span("bwd", "span", 1.9, 0.2),
+        # ... with a nested span inside the same window (union: no change)
+        _span("bwd.inner", "span", 2.0, 0.05),
+    ]
+    ev1 = [_span("allreduce", "collective", 1.0, 1.0, seq=1)]
+    _synthetic_rank_file(tmp_path / "spans-rank0.jsonl", 0, ev0)
+    _synthetic_rank_file(tmp_path / "spans-rank1.jsonl", 1, ev1)
+    proc = subprocess.run(
+        [sys.executable, _CGX_TRACE, str(tmp_path), "--json"],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    # (0.25 + 0.1) s hidden under compute of 1.0 s collective time
+    assert report["per_rank"]["0"]["overlap_frac"] == pytest.approx(0.35)
+    # no recorded compute at all -> fully serialized communication
+    assert report["per_rank"]["1"]["overlap_frac"] == 0.0
+    # the human table carries the new column
+    proc = subprocess.run(
+        [sys.executable, _CGX_TRACE, str(tmp_path)],
+        capture_output=True, text=True, cwd=_REPO,
+    )
+    assert proc.returncode == 0
+    assert "overlap" in proc.stdout

@@ -1,0 +1,329 @@
+#!/usr/bin/env python3
+"""Live terminal dashboard over a running job's CGX_METRICS_DIR.
+
+``top`` for the compressed data plane: every refresh re-reads the files
+the observability stack already writes — the periodic metrics exports
+(``metrics-rank<N>.jsonl``, last line per rank), the health engine's
+atomically-replaced status snapshots (``health-status-rank<N>.json``),
+health event streams (``health-rank<N>.jsonl``) and flight-recorder
+dumps — and renders one row per rank:
+
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  straggler  gen  last fault
+
+* **steps/s** — delta of the ``cgx.step.count`` counter between two
+  refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
+  step loop) fall back to the allreduce count delta.
+* **wire ratio** — ``bytes_in / wire_bytes_out`` over the SRA/Ring
+  counters: the live compression ratio actually achieved on the wire.
+* **straggler** — the health engine's worst per-peer skew score as
+  ``score→peer`` (needs CGX_HEALTH on the ranks).
+* **gen** — the recovery generation gauge (``cgx.recovery.generation``).
+* **last fault** — newest ``failure`` event in the rank's flight dump.
+
+Plain-refresh by default (ANSI clear + redraw — works over any ssh);
+``--curses`` uses the curses alternate screen when a real terminal is
+attached. ``--once`` prints a single frame and exits (scripts, tests).
+
+    python tools/cgx_top.py <dir>          # default: $CGX_METRICS_DIR
+    python tools/cgx_top.py --once         # one frame, no clear
+    python tools/cgx_top.py -n 0.5         # refresh every 0.5 s
+
+Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+_RANK_RE = re.compile(r"rank(\d+)\.jsonl?$")
+
+
+def _read_last_jsonl(path: str) -> Optional[dict]:
+    """Last parseable JSON object in a JSONL file (torn tail tolerated)."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - 65536))
+            tail = f.read().decode("utf-8", "replace")
+    except OSError:
+        return None
+    for line in reversed(tail.strip().splitlines()):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    return None
+
+
+def _read_json(path: str) -> Optional[dict]:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _ranks_in(directory: str) -> List[int]:
+    ranks = set()
+    for pat in ("metrics-rank*.jsonl", "health-status-rank*.json",
+                "flightrec-rank*.jsonl", "spans-rank*.jsonl"):
+        for p in glob.glob(os.path.join(directory, pat)):
+            m = re.search(r"rank(\d+)\.", os.path.basename(p))
+            if m:
+                ranks.add(int(m.group(1)))
+    return sorted(ranks)
+
+
+def _flat(snapshot: dict) -> Dict[str, float]:
+    """Flatten one typed metrics export line: counters/gauges as-is,
+    histogram stats dotted (the instruments.snapshot convention)."""
+    out: Dict[str, float] = {}
+    out.update(snapshot.get("counters", {}))
+    out.update(snapshot.get("gauges", {}))
+    for name, stats in (snapshot.get("histograms") or {}).items():
+        for k, v in stats.items():
+            out[f"{name}.{k}"] = v
+    return out
+
+
+def _last_failure(path: str, cache: dict) -> Optional[dict]:
+    """Newest ``failure`` flightrec event (needs a scan, not just the
+    last line). Scanning a long dump every frame would make each refresh
+    O(file size) per rank, so the result is cached against the file's
+    (mtime, size) and only re-scanned when those change."""
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    sig = (st.st_mtime_ns, st.st_size)
+    hit = cache.get(path)
+    if hit is not None and hit[0] == sig:
+        return hit[1]
+    last_fault = None
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    ev = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if ev.get("kind") == "failure":
+                    last_fault = ev
+    except OSError:
+        return None
+    cache[path] = (sig, last_fault)
+    return last_fault
+
+
+def collect(directory: str, cache: Optional[dict] = None) -> Dict[int, dict]:
+    """Per-rank view of the newest on-disk state. ``cache`` (a dict the
+    caller keeps across frames) avoids re-scanning unchanged flightrec
+    dumps."""
+    view: Dict[int, dict] = {}
+    fr_cache = cache if cache is not None else {}
+    for rank in _ranks_in(directory):
+        metrics_line = _read_last_jsonl(
+            os.path.join(directory, f"metrics-rank{rank}.jsonl")
+        )
+        status = _read_json(
+            os.path.join(directory, f"health-status-rank{rank}.json")
+        )
+        view[rank] = {
+            "metrics": _flat(metrics_line) if metrics_line else {},
+            "ts": (metrics_line or {}).get("ts"),
+            "status": status,
+            "last_fault": _last_failure(
+                os.path.join(directory, f"flightrec-rank{rank}.jsonl"),
+                fr_cache,
+            ),
+        }
+    return view
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return f"{v * 1e3:.1f}" if isinstance(v, (int, float)) and v else "-"
+
+
+def _steps_per_s(
+    rank: int, m: Dict[str, float], ts: Optional[float], state: dict
+) -> str:
+    """Counter-delta rate between two frames (state carries the previous
+    sample per rank)."""
+    count = m.get("cgx.step.count")
+    if count is None:
+        count = m.get("cgx.collective.allreduce_s.count")
+    now = ts if isinstance(ts, (int, float)) else time.time()
+    prev = state.get(rank)
+    state[rank] = (now, count)
+    if count is None or prev is None or prev[1] is None:
+        return "-"
+    dt = now - prev[0]
+    if dt <= 0:
+        return "-"
+    return f"{(count - prev[1]) / dt:.2f}"
+
+
+def _wire_ratio(m: Dict[str, float]) -> str:
+    bytes_in = sum(m.get(f"cgx.{k}.bytes_in", 0.0) for k in ("sra", "ring"))
+    out = sum(m.get(f"cgx.{k}.wire_bytes_out", 0.0) for k in ("sra", "ring"))
+    if not out:
+        return "-"
+    return f"{bytes_in / out:.1f}x"
+
+
+def _straggler(status: Optional[dict]) -> str:
+    scores = (status or {}).get("straggler_scores") or {}
+    if not scores:
+        return "-"
+    peer, score = max(scores.items(), key=lambda kv: kv[1])
+    return f"{score:.1f}→r{peer}"
+
+
+def _last_fault(fault: Optional[dict]) -> str:
+    if not fault:
+        return "-"
+    err = fault.get("error", "?")
+    op = fault.get("op")
+    return f"{err}({op})" if op else str(err)
+
+
+def render(directory: str, state: dict) -> str:
+    """One dashboard frame as text (pure function of the on-disk state +
+    the steps/s delta state — unit-testable)."""
+    view = collect(directory, state.setdefault("_fr_cache", {}))
+    lines = [
+        f"cgx_top — {directory}   "
+        f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
+    ]
+    headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
+               "straggler", "gen", "last_fault")
+    rows: List[Tuple[str, ...]] = []
+    events: List[str] = []
+    for rank, d in sorted(view.items()):
+        m = d["metrics"]
+        rows.append((
+            str(rank),
+            _steps_per_s(rank, m, d.get("ts"), state),
+            _fmt_ms(m.get("cgx.collective.allreduce_s.p50")),
+            _fmt_ms(m.get("cgx.collective.allreduce_s.p99")),
+            _wire_ratio(m),
+            _straggler(d["status"]),
+            str(int(m.get("cgx.recovery.generation", 0))),
+            _last_fault(d["last_fault"]),
+        ))
+        for ev in ((d["status"] or {}).get("events_recent") or [])[-3:]:
+            events.append(
+                f"  r{rank}: {ev.get('kind')} "
+                f"value={ev.get('value')} threshold={ev.get('threshold')}"
+                + (f" suspect=r{ev.get('suspect')}"
+                   if ev.get("suspect") is not None else "")
+            )
+    if not rows:
+        lines.append(
+            "(no metrics-rank*/health-status-rank* files yet — is the job "
+            "running with CGX_METRICS_DIR set?)"
+        )
+        return "\n".join(lines)
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) for i, h in enumerate(headers)
+    ]
+
+    def fmt(cells):
+        return "  ".join(c.rjust(w) for c, w in zip(cells, widths))
+
+    lines.append(fmt(headers))
+    lines.append(fmt(tuple("-" * w for w in widths)))
+    lines.extend(fmt(r) for r in rows)
+    if events:
+        lines.append("")
+        lines.append("recent health events:")
+        lines.extend(events[-8:])
+    return "\n".join(lines)
+
+
+def _loop_plain(directory: str, interval: float) -> int:
+    state: dict = {}
+    try:
+        while True:
+            frame = render(directory, state)
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+def _loop_curses(directory: str, interval: float) -> int:
+    import curses
+
+    state: dict = {}
+
+    def body(scr):
+        curses.use_default_colors()
+        scr.nodelay(True)
+        while True:
+            scr.erase()
+            for i, line in enumerate(render(directory, state).splitlines()):
+                try:
+                    scr.addnstr(i, 0, line, curses.COLS - 1)
+                except curses.error:
+                    break  # frame taller than the terminal
+            scr.refresh()
+            t_end = time.time() + interval
+            while time.time() < t_end:
+                if scr.getch() in (ord("q"), 27):
+                    return
+                time.sleep(0.05)
+
+    curses.wrapper(body)
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "directory", nargs="?", default=os.environ.get("CGX_METRICS_DIR"),
+        help="metrics dir of the running job (default: $CGX_METRICS_DIR)",
+    )
+    ap.add_argument(
+        "-n", "--interval", type=float, default=2.0,
+        help="refresh interval seconds (default 2)",
+    )
+    ap.add_argument(
+        "--once", action="store_true",
+        help="print one frame and exit (no screen clear)",
+    )
+    ap.add_argument(
+        "--curses", action="store_true",
+        help="curses alternate-screen mode (q to quit)",
+    )
+    args = ap.parse_args(argv)
+    if not args.directory:
+        print("cgx_top: no directory given and CGX_METRICS_DIR unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.directory):
+        print(f"cgx_top: {args.directory!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    if args.once:
+        print(render(args.directory, {}))
+        return 0
+    if args.curses and sys.stdout.isatty():
+        return _loop_curses(args.directory, args.interval)
+    return _loop_plain(args.directory, args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -333,6 +333,38 @@ def _quantiles(vals: List[float]) -> Dict[str, float]:
     }
 
 
+def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Union of [start, end) intervals as a sorted disjoint list."""
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(iv):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _overlap_len(
+    a: List[Tuple[float, float]], b: List[Tuple[float, float]]
+) -> float:
+    """Total length of the intersection of two disjoint sorted interval
+    lists (two-pointer sweep)."""
+    total = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            total += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    return total
+
+
 def attribution(per_rank: Dict[int, dict]) -> dict:
     """Per-collective p50/p99 and per-rank category decomposition:
     collective wall time split into quantize / wire / queue-wait /
@@ -340,22 +372,38 @@ def attribution(per_rank: Dict[int, dict]) -> dict:
     threads (``cgx-p2p*`` — send/recv bypass the collective worker
     loop) are tallied separately as ``p2p``: subtracting their wire/
     wait time from collective time they were never part of would
-    falsely zero the ``other`` bucket on pipeline workloads."""
+    falsely zero the ``other`` bucket on pipeline workloads.
+
+    Also reports the **overlap fraction** per rank: the share of
+    collective wall time during which recorded compute (``trace_span``
+    bodies — cat ``span``, which run on user threads, never the
+    collective worker) was simultaneously executing. This is the
+    communication/compute-overlap measurement the schedule-compiled
+    overlap work (ROADMAP item 2) gates on: 0.0 = fully serialized
+    communication, 1.0 = every collective second hidden under compute.
+    Computed on interval unions, so nested/overlapping spans are not
+    double-counted."""
     per_op: Dict[str, List[float]] = defaultdict(list)
     per_rank_cat: Dict[int, Dict[str, float]] = {}
     for rank, data in per_rank.items():
         cats = {"collective": 0.0, "quantize": 0.0, "wire": 0.0,
                 "wait": 0.0, "p2p": 0.0}
+        coll_iv: List[Tuple[float, float]] = []
+        compute_iv: List[Tuple[float, float]] = []
         for ev in data["events"]:
             if ev.get("kind") != "span":
                 continue
             dur = float(ev.get("dur_s", 0.0))
             cat = ev.get("cat")
+            t0 = float(ev.get("t_mono", 0.0))
             if str(ev.get("tname", "")).startswith("cgx-p2p"):
                 cats["p2p"] += dur
                 continue
             if cat == "collective":
                 per_op[ev["name"]].append(dur)
+                coll_iv.append((t0, t0 + dur))
+            elif cat == "span":
+                compute_iv.append((t0, t0 + dur))
             if cat in cats:
                 cats[cat] += dur
         cats["other"] = max(
@@ -363,7 +411,14 @@ def attribution(per_rank: Dict[int, dict]) -> dict:
             cats["collective"]
             - cats["quantize"] - cats["wire"] - cats["wait"],
         )
+        coll_u = _merge_intervals(coll_iv)
+        coll_total = sum(e - s for s, e in coll_u)
+        overlap = (
+            _overlap_len(coll_u, _merge_intervals(compute_iv)) / coll_total
+            if coll_total > 0 else 0.0
+        )
         per_rank_cat[rank] = {k: round(v, 6) for k, v in cats.items()}
+        per_rank_cat[rank]["overlap_frac"] = round(overlap, 4)
     return {
         "per_op": {op: _quantiles(v) for op, v in sorted(per_op.items())},
         "per_rank": per_rank_cat,
@@ -408,19 +463,21 @@ def render_report(
         parts.append("\n== step-time attribution (s, per rank) ==")
         rows = [
             (r, c["collective"], c["quantize"], c["wire"], c["wait"],
-             c["other"], c.get("p2p", 0.0))
+             c["other"], c.get("p2p", 0.0), c.get("overlap_frac", 0.0))
             for r, c in sorted(att["per_rank"].items())
         ]
         parts.append(_fmt_table(
             rows,
             ("rank", "collective", "quantize", "wire", "queue-wait",
-             "other(compute)", "p2p"),
+             "other(compute)", "p2p", "overlap"),
         ))
         parts.append(
             "  (quantize = codec frames; wire = byte movement; queue-wait "
             "= header/key waits; other = collective time not in those "
             "buckets — compute overlap and bookkeeping; p2p = send/recv "
-            "pool time, outside the collective decomposition)"
+            "pool time, outside the collective decomposition; overlap = "
+            "fraction of collective wall time hidden under recorded "
+            "trace_span compute — the ROADMAP item 2 gate measurement)"
         )
     return "\n".join(parts)
 

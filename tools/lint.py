@@ -230,6 +230,11 @@ class Checker:
 _BOUND_MARKERS = ("deadline", "timeout")
 _POLL_CALLS = {"sleep", "wait"}
 _WAIT_SCOPED_DIRS = ("torch_backend", "robustness")
+# The polling rule additionally covers observability/: the live health
+# plane (PR 6) runs background evaluator/exposition threads beside
+# training, and an unbounded spin there would hang teardown exactly like
+# a transport wait — park on a stop event or carry a deadline.
+_POLL_SCOPED_DIRS = _WAIT_SCOPED_DIRS + ("observability",)
 
 
 def _const_true(test: ast.expr) -> bool:
@@ -243,8 +248,9 @@ def check_unbounded_waits(path: Path, tree: ast.Module) -> list[str]:
     raise. An unbounded poll turns a dead peer into a hang; the hardened
     data plane's contract is that every wait is bounded
     (docs/ROBUSTNESS.md). Scoped to torch_backend/ and robustness/, where
-    the blocking waits live."""
-    if not any(d in path.parts for d in _WAIT_SCOPED_DIRS):
+    the blocking waits live, plus observability/ (its health/exposition
+    background threads must never outlive a stop request)."""
+    if not any(d in path.parts for d in _POLL_SCOPED_DIRS):
         return []
     findings = []
     for node in ast.walk(tree):
@@ -375,6 +381,16 @@ _LIB_DIR = "torch_cgx_tpu"
 _METRIC_WRITE_METHODS = {"add", "set", "observe"}
 _METRIC_RECEIVERS = {"metrics", "_metrics"}
 _METRIC_NAMESPACES = ("cgx.", "span.")
+# Documented `cgx.<sub>.` sub-namespaces (docs/OBSERVABILITY.md "Metric
+# namespaces" + "Live health plane"). A dotted name under `cgx.` outside
+# this set is a typo'd family the report/dashboard prefix scans (and the
+# Prometheus exposition grouping) would silently miss. Flat names
+# (`cgx.arena_pressure_waits`) and dynamic prefixes that stop at `cgx.`
+# stay uncheckable and pass.
+_METRIC_CGX_SUBNAMESPACES = frozenset({
+    "collective", "faults", "flightrec", "health", "heartbeat", "qerr",
+    "recovery", "ring", "runtime", "shm", "sra", "step", "trace",
+})
 
 
 def _literal_metric_name(arg: ast.expr) -> str | None:
@@ -403,6 +419,10 @@ def check_library_hygiene(path: Path, tree: ast.Module) -> list[str]:
       the documented ``cgx.`` / ``span.`` namespaces
       (docs/OBSERVABILITY.md) — an off-namespace name is invisible to the
       exporter's dashboards and the report tool's prefix scans.
+    * dotted families under ``cgx.`` must use a documented sub-namespace
+      (``_METRIC_CGX_SUBNAMESPACES`` — ``cgx.health.*`` joined the list
+      with the live health plane): ``cgx.helth.events`` would silently
+      fall out of every prefix scan.
     """
     if _LIB_DIR not in path.parts:
         return []
@@ -424,12 +444,23 @@ def check_library_hygiene(path: Path, tree: ast.Module) -> list[str]:
             and node.args
         ):
             name = _literal_metric_name(node.args[0])
-            if name is not None and not name.startswith(_METRIC_NAMESPACES):
+            if name is None:
+                continue
+            if not name.startswith(_METRIC_NAMESPACES):
                 findings.append(
                     f"{path}:{node.lineno}: metric name {name!r} outside "
                     f"the documented namespaces {_METRIC_NAMESPACES} "
                     "(docs/OBSERVABILITY.md)"
                 )
+            elif name.startswith("cgx.") and "." in name[len("cgx."):]:
+                sub = name[len("cgx."):].split(".", 1)[0]
+                if sub not in _METRIC_CGX_SUBNAMESPACES:
+                    findings.append(
+                        f"{path}:{node.lineno}: metric name {name!r} uses "
+                        f"undocumented cgx sub-namespace {sub!r} — add it "
+                        "to the documented families (docs/OBSERVABILITY.md"
+                        " Metric namespaces) or fix the name"
+                    )
     return findings
 
 

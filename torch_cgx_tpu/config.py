@@ -79,6 +79,13 @@ METRICS_DIR = "CGX_METRICS_DIR"  # flight-recorder dumps + metric exports
 METRICS_FLUSH_S = "CGX_METRICS_FLUSH_S"  # periodic exporter interval
 QERR_STATS = "CGX_QERR_STATS"  # per-layer relative-L2 quantization error
 FLIGHTREC_CAP = "CGX_FLIGHTREC_CAP"  # flight-recorder ring capacity
+# Live health plane (observability/health.py + watch.py — PR 6):
+HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
+HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
+HEALTH_STRAGGLER_FACTOR = "CGX_HEALTH_STRAGGLER_FACTOR"  # skew score gate
+HEALTH_STEP_FACTOR = "CGX_HEALTH_STEP_FACTOR"  # step-time regression gate
+HEALTH_QERR_SLO = "CGX_HEALTH_QERR_SLO"  # compression-quality SLO (rel-L2)
+PROM_PORT = "CGX_PROM_PORT"  # Prometheus text exposition endpoint
 
 # Defaults — reference values (common.h:24-41, compressor.h:32,
 # mpi_allreduce_operations.h:32).
@@ -410,6 +417,68 @@ def flightrec_cap() -> int:
     """CGX_FLIGHTREC_CAP: flight-recorder ring capacity in events."""
     v = _env.get_int_env_or_default(FLIGHTREC_CAP, 512)
     return v if v > 0 else 512
+
+
+def health_enabled() -> bool:
+    """CGX_HEALTH: run the per-rank streaming health engine
+    (``observability/health.py``) — online EWMA/P² estimators over the
+    typed instruments, straggler scoring from collective-phase skew, and
+    typed ``HealthEvent`` publication to the supervisor/Prometheus/
+    ``cgx_top`` consumers. Off by default: with it unset no thread runs,
+    no hot-path hook fires, and the clean path stays bit-identical
+    (docs/OBSERVABILITY.md "Live health plane")."""
+    return _env.get_bool_env_or_default(HEALTH, False)
+
+
+def health_interval_s() -> float:
+    """CGX_HEALTH_INTERVAL_S: sample interval of the health evaluator
+    thread. Each tick is a registry read + pure-Python estimator update
+    (microseconds), so sub-second intervals are safe."""
+    v = _env.get_float_env_or_default(HEALTH_INTERVAL_S, 1.0)
+    return v if v > 0 else 1.0
+
+
+def health_straggler_factor() -> float:
+    """CGX_HEALTH_STRAGGLER_FACTOR: a peer whose collective-phase wait
+    signal exceeds the median peer's by this factor (sustained over two
+    consecutive samples) is flagged as a straggler."""
+    v = _env.get_float_env_or_default(HEALTH_STRAGGLER_FACTOR, 3.0)
+    return v if v > 0 else 3.0
+
+
+def health_step_factor() -> float:
+    """CGX_HEALTH_STEP_FACTOR: step-time regression gate — the fast EWMA
+    of step time exceeding the slow (baseline) EWMA by this factor raises
+    a ``step_regression`` event."""
+    v = _env.get_float_env_or_default(HEALTH_STEP_FACTOR, 2.0)
+    return v if v > 0 else 2.0
+
+
+def health_qerr_slo() -> Optional[float]:
+    """CGX_HEALTH_QERR_SLO: compression-quality SLO — a ``cgx.qerr.*``
+    relative-L2 p90 above this threshold raises a ``qerr_slo`` event
+    (requires CGX_QERR_STATS for the qerr stream to exist). Unset/0 =
+    no quality SLO."""
+    v = _env.get_float_env_or_default(HEALTH_QERR_SLO, 0.0)
+    return v if v > 0 else None
+
+
+def prom_port() -> Optional[int]:
+    """CGX_PROM_PORT: serve every ``cgx.*`` instrument plus the health
+    engine's state as Prometheus text exposition on
+    ``127.0.0.1:<port>/metrics`` (stdlib http.server; 0 = pick an
+    ephemeral port, published to ``CGX_METRICS_DIR/prom-rank<N>.json``).
+    Unset (default) = no endpoint."""
+    raw = _env.get_str_env_or_default(PROM_PORT, "")
+    if raw == "":
+        return None
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{PROM_PORT} must be an integer port, got {raw!r}")
+    if v < 0 or v > 65535:
+        raise ValueError(f"{PROM_PORT} out of range: {v}")
+    return v
 
 
 def recovery_retries() -> int:

@@ -15,6 +15,12 @@ Three layers (see ``docs/OBSERVABILITY.md``):
   (``spans-rank<N>.jsonl``) with monotonic clocks and collective
   seq/key correlation, merged by ``tools/cgx_trace.py`` into a Chrome
   trace-event file with cross-rank flow arrows.
+* :mod:`.health` — streaming per-rank health engine: online EWMA/P²
+  estimators over the instruments, straggler scoring from
+  collective-phase skew, typed ``HealthEvent`` publication to the
+  recovery supervisor and the files ``cgx_top`` renders (CGX_HEALTH).
+* :mod:`.watch` — health-plane consumers: Prometheus text exposition
+  endpoint (CGX_PROM_PORT) and the leader-side cluster health merge.
 
 ``instruments`` is imported eagerly (``utils.logging`` depends on it);
 ``flightrec``/``exporter`` load lazily so this package root stays
@@ -26,7 +32,7 @@ from __future__ import annotations
 from . import instruments
 from .instruments import Counter, Gauge, Histogram, Metrics, metrics
 
-_LAZY = ("flightrec", "exporter", "timeline")
+_LAZY = ("flightrec", "exporter", "timeline", "health", "watch")
 
 
 def __getattr__(name: str):
@@ -44,6 +50,8 @@ __all__ = [
     "flightrec",
     "exporter",
     "timeline",
+    "health",
+    "watch",
     "Counter",
     "Gauge",
     "Histogram",

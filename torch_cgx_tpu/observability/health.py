@@ -1,0 +1,652 @@
+"""Streaming per-rank health engine: the observability→control bridge.
+
+Everything PRs 2–3 built is post-hoc — metrics, flight-recorder dumps and
+Chrome traces are read by a human *after* the run. This module closes the
+loop while the job is still running: a low-overhead background evaluator
+maintains **online** estimates over the existing typed instruments and
+raises typed :class:`HealthEvent`\\ s the moment a trend crosses a gate:
+
+* **straggler** — per-peer score from collective-phase skew. The bridge's
+  takes are peer-attributed (``backend._take(peer=...)`` reports both
+  completed wait durations and the age of still-in-flight waits), so a
+  peer whose signal exceeds the median peer's by
+  ``CGX_HEALTH_STRAGGLER_FACTOR`` — *sustained* over two consecutive
+  samples — is flagged **before** its stall ever reaches
+  ``CGX_BRIDGE_TIMEOUT_MS``.
+* **step_regression** — fast EWMA of step time vs the slow baseline EWMA
+  (``CGX_HEALTH_STEP_FACTOR``).
+* **qerr_slo** — compression-quality SLO: any ``cgx.qerr.*`` histogram's
+  recent p90 above ``CGX_HEALTH_QERR_SLO`` (the live relative-L2 stream
+  ``CGX_QERR_STATS`` feeds).
+* **arena_pressure** — the shm arena pressure-wait counter moving within
+  a sample window (a dead/stalled reader trending toward the
+  ``CGX_SHM_MAX_MB`` cap).
+
+Events go to every registered **consumer** (the recovery supervisor turns
+sustained straggler scores into first-class suspect evidence for the PR 5
+policy ladder), to the ``cgx.health.*`` instruments, to the flight
+recorder, and — when ``CGX_METRICS_DIR`` is set — to
+``health-rank<N>.jsonl`` plus an atomically-replaced
+``health-status-rank<N>.json`` snapshot that ``tools/cgx_top.py`` and the
+Prometheus endpoint (:mod:`.watch`) render.
+
+With ``CGX_HEALTH`` unset the engine is **inert**: no thread starts, the
+hot-path hooks (:func:`wait_begin`/:func:`wait_end`/:func:`note_step`)
+are attribute-check no-ops, and nothing in the staged program or wire
+changes — the grad_sync bit-identity suite passes unchanged.
+
+Estimator notes: the EWMA pair uses fast/slow half-lives so a regression
+is judged against a baseline that forgets slowly; the quantile tracker is
+the classic P² algorithm (Jain & Chlamtac 1985) — five markers per
+quantile, O(1) update, no sample buffer — validated against numpy
+percentile oracles in ``tests/test_health.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import config as cfg
+from ..utils.logging import get_logger
+from .instruments import metrics
+
+log = get_logger()
+
+# Event kinds (the taxonomy docs/OBSERVABILITY.md documents).
+STRAGGLER = "straggler"
+STEP_REGRESSION = "step_regression"
+QERR_SLO = "qerr_slo"
+ARENA_PRESSURE = "arena_pressure"
+
+# Wait-signal floor: peer skew is judged relative to the median peer, but
+# a baseline of ~0 (healthy peers answer in microseconds) would make any
+# noise an infinite ratio — the floor is the smallest wait considered
+# operationally interesting at all.
+_WAIT_FLOOR_S = 0.05
+# A straggler/regression must hold for this many consecutive samples.
+_SUSTAIN = 2
+# Re-emission cooldown per (kind, suspect): a sustained condition stays
+# one event stream, not one event per tick.
+_COOLDOWN_S = 10.0
+
+
+class Ewma:
+    """Exponentially-weighted moving average with a configurable
+    half-life in *samples* (alpha = 1 - 2^(-1/half_life))."""
+
+    __slots__ = ("alpha", "value", "n")
+
+    def __init__(self, half_life: float = 8.0):
+        self.alpha = 1.0 - 2.0 ** (-1.0 / max(half_life, 1e-9))
+        self.value = 0.0
+        self.n = 0
+
+    def update(self, x: float) -> float:
+        x = float(x)
+        self.n += 1
+        if self.n == 1:
+            self.value = x
+        else:
+            self.value += self.alpha * (x - self.value)
+        return self.value
+
+
+class P2Quantile:
+    """P² streaming quantile estimator (Jain & Chlamtac 1985): five
+    markers, O(1) per observation, no stored samples. Exact below five
+    observations (falls back to sorting the seen values)."""
+
+    __slots__ = ("q", "n", "_init", "_h", "_pos", "_des")
+
+    def __init__(self, q: float):
+        if not 0.0 < q < 1.0:
+            raise ValueError(f"quantile must be in (0, 1), got {q}")
+        self.q = q
+        self.n = 0
+        self._init: List[float] = []
+        self._h: List[float] = []  # marker heights
+        self._pos: List[float] = []  # marker positions (1-based)
+        self._des: List[float] = []  # desired positions
+
+    def update(self, x: float) -> None:
+        x = float(x)
+        self.n += 1
+        if len(self._init) < 5:
+            self._init.append(x)
+            if len(self._init) == 5:
+                self._init.sort()
+                self._h = list(self._init)
+                self._pos = [1.0, 2.0, 3.0, 4.0, 5.0]
+                self._des = [
+                    1.0,
+                    1.0 + 2.0 * self.q,
+                    1.0 + 4.0 * self.q,
+                    3.0 + 2.0 * self.q,
+                    5.0,
+                ]
+            return
+        h, pos, des, q = self._h, self._pos, self._des, self.q
+        if x < h[0]:
+            h[0] = x
+            k = 0
+        elif x >= h[4]:
+            h[4] = x
+            k = 3
+        else:
+            k = 0
+            for i in range(1, 4):
+                if x < h[i]:
+                    break
+                k = i
+        for i in range(k + 1, 5):
+            pos[i] += 1.0
+        incr = (0.0, q / 2.0, q, (1.0 + q) / 2.0, 1.0)
+        for i in range(5):
+            des[i] += incr[i]
+        for i in (1, 2, 3):
+            d = des[i] - pos[i]
+            if (d >= 1.0 and pos[i + 1] - pos[i] > 1.0) or (
+                d <= -1.0 and pos[i - 1] - pos[i] < -1.0
+            ):
+                s = 1.0 if d >= 0 else -1.0
+                # parabolic (P²) candidate, linear fallback
+                hp = h[i] + s / (pos[i + 1] - pos[i - 1]) * (
+                    (pos[i] - pos[i - 1] + s)
+                    * (h[i + 1] - h[i]) / (pos[i + 1] - pos[i])
+                    + (pos[i + 1] - pos[i] - s)
+                    * (h[i] - h[i - 1]) / (pos[i] - pos[i - 1])
+                )
+                if h[i - 1] < hp < h[i + 1]:
+                    h[i] = hp
+                else:
+                    j = i + (1 if s > 0 else -1)
+                    h[i] += s * (h[j] - h[i]) / (pos[j] - pos[i])
+                pos[i] += s
+
+    def value(self) -> float:
+        if not self._h:
+            if not self._init:
+                return 0.0
+            s = sorted(self._init)
+            return s[min(int(self.q * len(s)), len(s) - 1)]
+        return self._h[2]
+
+
+@dataclasses.dataclass(frozen=True)
+class HealthEvent:
+    """One detected condition. ``suspect`` is a GLOBAL rank (stable
+    across reconfigurations — the identity eviction votes use) when the
+    event names a peer; ``value``/``threshold`` carry the measurement
+    that crossed the gate."""
+
+    kind: str
+    rank: int  # emitting rank
+    value: float
+    threshold: float
+    suspect: Optional[int] = None
+    severity: str = "warn"
+    detail: Tuple[Tuple[str, Any], ...] = ()
+    ts: float = 0.0
+    t_mono: float = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["detail"] = dict(self.detail)
+        return d
+
+
+class _PeerWaits:
+    """Per-peer wait signal: EWMA of completed take durations plus the
+    oldest still-in-flight wait's age (the straggler case the completed
+    stream cannot see — the wait that never finishes)."""
+
+    __slots__ = ("ewma", "last_t")
+
+    def __init__(self):
+        self.ewma = Ewma(half_life=4.0)
+        self.last_t = 0.0
+
+
+class HealthEngine:
+    """Per-rank streaming evaluator (one per process; see module funcs)."""
+
+    def __init__(
+        self,
+        rank: int = 0,
+        *,
+        interval_s: Optional[float] = None,
+        straggler_factor: Optional[float] = None,
+        step_factor: Optional[float] = None,
+        qerr_slo: Optional[float] = None,
+    ):
+        self.rank = rank
+        self._interval = (
+            interval_s if interval_s is not None else cfg.health_interval_s()
+        )
+        self._straggler_factor = (
+            straggler_factor if straggler_factor is not None
+            else cfg.health_straggler_factor()
+        )
+        self._step_factor = (
+            step_factor if step_factor is not None else cfg.health_step_factor()
+        )
+        self._qerr_slo = qerr_slo if qerr_slo is not None else cfg.health_qerr_slo()
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        # wait tracking: token -> (global peer, t0); per-peer aggregates
+        self._tok = 0
+        self._inflight: Dict[int, Tuple[int, float]] = {}
+        self._peers: Dict[int, _PeerWaits] = {}
+        # step-time estimators
+        self._step_fast = Ewma(half_life=4.0)
+        self._step_slow = Ewma(half_life=64.0)
+        self._step_p50 = P2Quantile(0.5)
+        self._step_p99 = P2Quantile(0.99)
+        # event plumbing
+        self._consumers: List[Any] = []  # WeakMethod | callable
+        self._events: List[HealthEvent] = []  # bounded recent ring
+        self._last_emit: Dict[Tuple[str, Optional[int]], float] = {}
+        self._sustain: Dict[Tuple[str, Optional[int]], int] = {}
+        self._last_counters: Dict[str, float] = {}
+        self._status: Dict[str, Any] = {}
+
+    # -- hot-path hooks (called only when the engine is running) ----------
+
+    def wait_begin(self, peer: int, key: str) -> int:
+        t0 = time.perf_counter()
+        with self._lock:
+            self._tok += 1
+            tok = self._tok
+            self._inflight[tok] = (int(peer), t0)
+        return tok
+
+    def wait_end(self, tok: int) -> None:
+        t1 = time.perf_counter()
+        with self._lock:
+            ent = self._inflight.pop(tok, None)
+            if ent is None:
+                return
+            peer, t0 = ent
+            pw = self._peers.get(peer)
+            if pw is None:
+                pw = self._peers[peer] = _PeerWaits()
+            pw.ewma.update(t1 - t0)
+            pw.last_t = t1
+
+    def note_step(self, dt: float) -> None:
+        with self._lock:
+            self._step_fast.update(dt)
+            self._step_slow.update(dt)
+            self._step_p50.update(dt)
+            self._step_p99.update(dt)
+
+    def rebind_rank(self, rank: int) -> None:
+        """Late rank bind (see ``maybe_start``): the engine may be
+        auto-started by ``make_train_step`` before the process knows its
+        distributed rank. Status/event writes after this go to the new
+        rank's files."""
+        with self._lock:
+            self.rank = int(rank)
+
+    def forget_peers(self) -> None:
+        """Recovery reconfiguration: drop all per-peer wait state plus the
+        straggler sustain/cooldown bookkeeping. Post-recovery waits are a
+        new stream (same contract as the qerr-cadence reset) — without
+        this an evicted peer's wait EWMA freezes at the timeout value and
+        re-emits a phantom straggler event every cooldown window forever.
+        Gauges for forgotten peers are zeroed so dashboards don't show a
+        stale maximal score."""
+        with self._lock:
+            # _inflight too: the canonical straggler never completes a
+            # wait, so it has no _peers entry — only an in-flight one.
+            dropped = set(self._peers) | {
+                p for p, _ in self._inflight.values()
+            }
+            self._peers.clear()
+            self._inflight.clear()
+            self._sustain = {
+                k: v for k, v in self._sustain.items() if k[0] != STRAGGLER
+            }
+            self._last_emit = {
+                k: v for k, v in self._last_emit.items() if k[0] != STRAGGLER
+            }
+        for peer in dropped:
+            metrics.set(f"cgx.health.straggler.r{peer}", 0.0)
+
+    # -- consumers ---------------------------------------------------------
+
+    def add_consumer(self, cb: Callable[[HealthEvent], None]) -> None:
+        """Register an event consumer. Bound methods are held weakly (a
+        supervisor must not be kept alive by the engine); plain functions
+        are held strongly."""
+        try:
+            ref: Any = weakref.WeakMethod(cb)  # type: ignore[arg-type]
+        except TypeError:
+            ref = cb
+        with self._lock:
+            self._consumers.append(ref)
+
+    def _notify(self, ev: HealthEvent) -> None:
+        with self._lock:
+            consumers = list(self._consumers)
+        dead = []
+        for ref in consumers:
+            cb = ref() if isinstance(ref, weakref.WeakMethod) else ref
+            if cb is None:
+                dead.append(ref)
+                continue
+            try:
+                cb(ev)
+            except Exception as e:  # a consumer must not kill the engine
+                log.warning("health consumer %r raised: %s", cb, e)
+        if dead:
+            with self._lock:
+                self._consumers = [
+                    r for r in self._consumers if r not in dead
+                ]
+
+    # -- evaluation --------------------------------------------------------
+
+    def _peer_signals(self, now: float) -> Dict[int, float]:
+        """Per-peer wait signal at ``now``: max(completed-wait EWMA,
+        oldest in-flight wait age)."""
+        with self._lock:
+            sig = {p: pw.ewma.value for p, pw in self._peers.items()}
+            for peer, t0 in self._inflight.values():
+                age = now - t0
+                if age > sig.get(peer, 0.0):
+                    sig[peer] = age
+        return sig
+
+    def straggler_scores(self, now: Optional[float] = None) -> Dict[int, float]:
+        """Per-peer skew score: signal over the median of the OTHER
+        peers' signals (floored — see ``_WAIT_FLOOR_S``). >= the
+        straggler factor means "this peer is holding the collective
+        back"."""
+        sig = self._peer_signals(now if now is not None else time.perf_counter())
+        scores: Dict[int, float] = {}
+        for peer, s in sig.items():
+            others = sorted(v for p, v in sig.items() if p != peer)
+            med = others[len(others) // 2] if others else 0.0
+            scores[peer] = s / max(med, _WAIT_FLOOR_S)
+        return scores
+
+    def _emit(self, ev: HealthEvent) -> bool:
+        """Publish one event unless its (kind, suspect) stream is inside
+        the cooldown window. True = actually emitted."""
+        key = (ev.kind, ev.suspect)
+        now = time.monotonic()
+        last = self._last_emit.get(key)
+        if last is not None and now - last < _COOLDOWN_S:
+            return False
+        self._last_emit[key] = now
+        with self._lock:
+            self._events.append(ev)
+            del self._events[:-64]
+        metrics.add("cgx.health.events")
+        metrics.add(f"cgx.health.events.{ev.kind}")
+        from . import flightrec
+
+        fields = ev.to_dict()
+        fields["event"] = fields.pop("kind")  # "kind" is flightrec's own
+        flightrec.record("health", **fields)
+        log.warning(
+            "health: %s (rank %d, value %.4g >= %.4g%s)",
+            ev.kind, ev.rank, ev.value, ev.threshold,
+            f", suspect global rank {ev.suspect}" if ev.suspect is not None
+            else "",
+        )
+        self._append_event(ev)
+        self._notify(ev)
+        return True
+
+    def _sustained(self, key: Tuple[str, Optional[int]], firing: bool) -> bool:
+        if not firing:
+            self._sustain.pop(key, None)
+            return False
+        n = self._sustain.get(key, 0) + 1
+        self._sustain[key] = n
+        return n >= _SUSTAIN
+
+    def sample(self) -> List[HealthEvent]:
+        """One evaluator tick (public for tests; the background thread
+        calls it every ``CGX_HEALTH_INTERVAL_S``). Returns the events
+        emitted this tick."""
+        out: List[HealthEvent] = []
+        now = time.perf_counter()
+        ts = time.time()
+
+        def mk(kind, value, threshold, suspect=None, **detail) -> HealthEvent:
+            return HealthEvent(
+                kind=kind, rank=self.rank, value=round(float(value), 6),
+                threshold=float(threshold), suspect=suspect,
+                detail=tuple(detail.items()), ts=round(ts, 6),
+                t_mono=round(now, 6),
+            )
+
+        # 1. straggler skew
+        scores = self.straggler_scores(now)
+        for peer, score in scores.items():
+            firing = score >= self._straggler_factor
+            metrics.set(f"cgx.health.straggler.r{peer}", round(score, 4))
+            if self._sustained((STRAGGLER, peer), firing):
+                sig = self._peer_signals(now).get(peer, 0.0)
+                out.append(mk(
+                    STRAGGLER, score, self._straggler_factor, suspect=peer,
+                    wait_s=round(sig, 4),
+                ))
+        # 2. step-time regression
+        with self._lock:
+            fast, slow = self._step_fast, self._step_slow
+            ratio = (
+                fast.value / slow.value
+                if slow.n >= 8 and slow.value > 0 else 0.0
+            )
+        metrics.set("cgx.health.step_ratio", round(ratio, 4))
+        if self._sustained((STEP_REGRESSION, None), ratio >= self._step_factor):
+            out.append(mk(
+                STEP_REGRESSION, ratio, self._step_factor,
+                fast_s=round(fast.value, 6), slow_s=round(slow.value, 6),
+            ))
+        # 3. compression-quality SLO over the live qerr stream
+        if self._qerr_slo is not None:
+            snap = metrics.snapshot_typed()
+            for name, h in snap.get("histograms", {}).items():
+                if not name.startswith("cgx.qerr."):
+                    continue
+                p90 = h.get("p90", 0.0)
+                if self._sustained((QERR_SLO, None), p90 > self._qerr_slo):
+                    out.append(mk(
+                        QERR_SLO, p90, self._qerr_slo,
+                        layer=name[len("cgx.qerr."):],
+                    ))
+                    break  # one SLO event per tick is enough
+        # 4. arena-pressure trend (pressure waits moving within a window)
+        cur = metrics.get("cgx.arena_pressure_waits")
+        prev = self._last_counters.get("cgx.arena_pressure_waits", cur)
+        self._last_counters["cgx.arena_pressure_waits"] = cur
+        if cur > prev:
+            out.append(mk(ARENA_PRESSURE, cur - prev, 0.0))
+        emitted = [ev for ev in out if self._emit(ev)]
+        self._write_status()
+        return emitted
+
+    # -- status/event files (cgx_top + Prometheus read these) -------------
+
+    def status(self) -> Dict[str, Any]:
+        """Current health view: straggler scores, step-time estimates,
+        recent events — the dict cgx_top renders and the Prometheus
+        endpoint exposes as gauges."""
+        with self._lock:
+            events = [e.to_dict() for e in self._events[-8:]]
+            step = {
+                "ewma_fast_s": round(self._step_fast.value, 6),
+                "ewma_slow_s": round(self._step_slow.value, 6),
+                "p50_s": round(self._step_p50.value(), 6),
+                "p99_s": round(self._step_p99.value(), 6),
+                "n": self._step_slow.n,
+            }
+        return {
+            "rank": self.rank,
+            "ts": round(time.time(), 6),
+            "straggler_scores": {
+                str(p): round(s, 4) for p, s in self.straggler_scores().items()
+            },
+            "step": step,
+            "events_recent": events,
+        }
+
+    def _events_path(self) -> Optional[str]:
+        d = cfg.metrics_dir()
+        if not d:
+            return None
+        return os.path.join(d, f"health-rank{self.rank}.jsonl")
+
+    def _append_event(self, ev: HealthEvent) -> None:
+        path = self._events_path()
+        if path is None:
+            return
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "a") as f:
+                f.write(json.dumps(ev.to_dict()) + "\n")
+        except OSError as e:
+            log.warning("health event write to %s failed: %s", path, e)
+
+    def _write_status(self) -> None:
+        d = cfg.metrics_dir()
+        if not d:
+            return
+        path = os.path.join(d, f"health-status-rank{self.rank}.json")
+        try:
+            os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(self.status(), f)
+            os.replace(tmp, path)  # readers never see a torn status
+        except OSError as e:
+            log.warning("health status write to %s failed: %s", path, e)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "HealthEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._run, name="cgx-health", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            try:
+                self.sample()
+            except Exception as e:  # the evaluator must never die silently
+                log.warning("health sample failed: %s", e)
+                metrics.add("cgx.health.sample_errors")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=2.0)
+        self._thread = None
+
+
+# ---------------------------------------------------------------------------
+# Process singleton + zero-cost hot-path shims.
+# ---------------------------------------------------------------------------
+
+_engine: Optional[HealthEngine] = None
+_engine_lock = threading.Lock()
+
+
+def active() -> bool:
+    """True iff the process health engine is running (the gate every
+    hot-path hook checks first — one global load when off)."""
+    return _engine is not None
+
+
+def get_engine() -> Optional[HealthEngine]:
+    return _engine
+
+
+def maybe_start(rank: Optional[int] = None) -> Optional[HealthEngine]:
+    """Start (idempotently) the process health engine iff ``CGX_HEALTH``
+    is set. Returns None — and starts nothing — otherwise.
+
+    ``rank`` may be unknown at the earliest call site
+    (``make_train_step`` can run before dist init): the first caller
+    that knows a nonzero rank rebinds an engine auto-started as rank 0
+    (flightrec's first-wins ``bind_rank`` convention), so per-rank
+    health files never collide on a shared metrics dir."""
+    global _engine
+    if not cfg.health_enabled():
+        return None
+    with _engine_lock:
+        if _engine is None:
+            _engine = HealthEngine(rank or 0).start()
+        elif rank and _engine.rank == 0:
+            _engine.rebind_rank(rank)
+        return _engine
+
+
+def stop() -> None:
+    """Stop and drop the process engine (tests / explicit teardown)."""
+    global _engine
+    with _engine_lock:
+        eng, _engine = _engine, None
+    if eng is not None:
+        eng.stop()
+
+
+def add_consumer(cb: Callable[[HealthEvent], None]) -> bool:
+    """Attach an event consumer to the running engine (False = engine
+    not running; the caller loses nothing — with health off there are no
+    events to consume)."""
+    eng = _engine
+    if eng is None:
+        return False
+    eng.add_consumer(cb)
+    return True
+
+
+def wait_begin(peer: Optional[int], key: str) -> Optional[int]:
+    """Hot-path hook: a peer-attributed bridge wait is starting. No-op
+    (None) when the engine is off or the peer is unknown."""
+    eng = _engine
+    if eng is None or peer is None or peer < 0:
+        return None
+    return eng.wait_begin(peer, key)
+
+
+def wait_end(tok: Optional[int]) -> None:
+    if tok is None:
+        return
+    eng = _engine
+    if eng is not None:
+        eng.wait_end(tok)
+
+
+def note_step(dt: float) -> None:
+    """Hot-path hook: one train step took ``dt`` seconds."""
+    eng = _engine
+    if eng is not None:
+        eng.note_step(dt)
+
+
+def forget_peers() -> None:
+    """Drop per-peer wait state on the running engine (no-op when off) —
+    called by ``supervisor.invalidate_trace_caches`` on recovery
+    reconfiguration."""
+    eng = _engine
+    if eng is not None:
+        eng.forget_peers()

@@ -1,0 +1,312 @@
+"""Health-plane consumers: Prometheus exposition + cluster health merge.
+
+Two ways the live health state (:mod:`.health`) and the instrument
+registry leave the process *while the job runs* — the post-hoc JSONL
+exporter's (:mod:`.exporter`) online siblings:
+
+* :class:`PromServer` — a stdlib ``http.server`` thread serving
+  Prometheus **text exposition format** on
+  ``127.0.0.1:$CGX_PROM_PORT/metrics``: every counter/gauge/histogram in
+  the registry (histograms as summaries with p50/p90/p99 quantile
+  samples) plus the health engine's straggler scores and step estimates
+  as gauges. Port 0 binds an ephemeral port; the bound port is published
+  to ``CGX_METRICS_DIR/prom-rank<N>.json`` so a scraper (or the chaos
+  suite) can find it without races.
+* :func:`aggregate_health_over_store` — the leader-side cluster health
+  view, riding the same store control plane (and bounded-get helper) as
+  the exporter's metrics merge: every rank publishes its health status,
+  rank 0 merges what arrives within the deadline into one line of
+  ``CGX_METRICS_DIR/cluster-health.jsonl`` (max straggler score across
+  the fleet, per-rank step estimates, ranks missing).
+
+Both are inert unless their knob is set (``CGX_PROM_PORT`` /
+``CGX_HEALTH``): with everything unset no socket is bound, no thread
+runs, and nothing changes on the clean path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .. import config as cfg
+from ..utils.logging import get_logger
+from . import health as health_mod
+from .instruments import metrics
+
+log = get_logger()
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    """Registry name -> Prometheus metric name (``cgx.sra.wire_bytes_out``
+    -> ``cgx_sra_wire_bytes_out``; leading digits guarded)."""
+    out = _NAME_RE.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f in (float("inf"), float("-inf")):
+        return "+Inf" if f > 0 else "-Inf"
+    return repr(f)
+
+
+def render_prometheus(
+    snapshot: Optional[Dict[str, Dict]] = None,
+    status: Optional[Dict[str, Any]] = None,
+    rank: int = 0,
+) -> str:
+    """Text exposition (version 0.0.4) of a typed registry snapshot plus
+    an optional health status dict. Pure function — unit-testable without
+    a socket."""
+    snap = snapshot if snapshot is not None else metrics.snapshot_typed()
+    lines: List[str] = []
+    for name, v in sorted(snap.get("counters", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {_fmt_value(v)}")
+    for name, v in sorted(snap.get("gauges", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {_fmt_value(v)}")
+    for name, h in sorted(snap.get("histograms", {}).items()):
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} summary")
+        for q in ("p50", "p90", "p99"):
+            if q in h:
+                lines.append(
+                    f'{pn}{{quantile="0.{q[1:]}"}} {_fmt_value(h[q])}'
+                )
+        lines.append(f"{pn}_sum {_fmt_value(h.get('sum', 0.0))}")
+        lines.append(f"{pn}_count {_fmt_value(h.get('count', 0.0))}")
+    if status is None:
+        eng = health_mod.get_engine()
+        status = eng.status() if eng is not None else None
+    if status:
+        lines.append("# TYPE cgx_health_straggler_score gauge")
+        for peer, score in sorted(
+            (status.get("straggler_scores") or {}).items()
+        ):
+            lines.append(
+                f'cgx_health_straggler_score{{peer="{peer}"}} '
+                f"{_fmt_value(score)}"
+            )
+        step = status.get("step") or {}
+        for k in ("ewma_fast_s", "ewma_slow_s", "p50_s", "p99_s"):
+            if k in step:
+                lines.append(f"# TYPE cgx_health_step_{k} gauge")
+                lines.append(
+                    f"cgx_health_step_{k} {_fmt_value(step[k])}"
+                )
+    lines.append("# TYPE cgx_up gauge")
+    lines.append(f'cgx_up{{rank="{rank}"}} 1.0')
+    return "\n".join(lines) + "\n"
+
+
+class PromServer:
+    """Per-process Prometheus endpoint (use :func:`maybe_start_prom`)."""
+
+    def __init__(self, port: int, rank: int = 0):
+        self.rank = rank
+        self._httpd = None
+        self._thread: Optional[threading.Thread] = None
+        self._requested_port = port
+        self.port: Optional[int] = None
+
+    def start(self) -> "PromServer":
+        import http.server
+
+        rank = self.rank
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server contract
+                if self.path not in ("/", "/metrics", "/healthz"):
+                    self.send_error(404)
+                    return
+                if self.path == "/healthz":
+                    eng = health_mod.get_engine()
+                    body = json.dumps(
+                        eng.status() if eng is not None
+                        else {"rank": rank, "health_engine": "off"}
+                    ).encode()
+                    ctype = "application/json"
+                else:
+                    metrics.add("cgx.health.prom_scrapes")
+                    body = render_prometheus(rank=rank).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # quiet: no stderr per scrape
+                pass
+
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", self._requested_port), Handler
+        )
+        self._httpd.daemon_threads = True
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="cgx-prom",
+            daemon=True,
+        )
+        self._thread.start()
+        self._publish_port()
+        log.info(
+            "cgx: Prometheus exposition on http://127.0.0.1:%d/metrics",
+            self.port,
+        )
+        return self
+
+    def _publish_port(self) -> None:
+        """Drop the bound port where scrapers/tests can find it (matters
+        for port 0 — the ephemeral-bind mode CI uses to avoid
+        collisions)."""
+        d = cfg.metrics_dir()
+        if not d:
+            return
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(d, f"prom-rank{self.rank}.json")
+            tmp = f"{path}.tmp{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {"port": self.port, "pid": os.getpid(),
+                     "rank": self.rank}, f,
+                )
+            os.replace(tmp, path)
+        except OSError as e:
+            log.warning("prom port publish failed: %s", e)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        self._thread = None
+
+
+_prom: Optional[PromServer] = None
+_prom_lock = threading.Lock()
+
+
+def maybe_start_prom(rank: int = 0) -> Optional[PromServer]:
+    """Start (idempotently) the process Prometheus endpoint iff
+    ``CGX_PROM_PORT`` is set. Bind failures degrade to a warning — an
+    occupied port must not take down training."""
+    port = cfg.prom_port()
+    if port is None:
+        return None
+    global _prom
+    with _prom_lock:
+        if _prom is None:
+            try:
+                _prom = PromServer(port, rank).start()
+            except OSError as e:
+                log.warning(
+                    "cgx: Prometheus endpoint bind on port %d failed: %s",
+                    port, e,
+                )
+                return None
+        return _prom
+
+
+def stop_prom() -> None:
+    global _prom
+    with _prom_lock:
+        srv, _prom = _prom, None
+    if srv is not None:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Leader-side cluster health view (the exporter merge's online sibling).
+# ---------------------------------------------------------------------------
+
+_HEALTH_PREFIX = "cgxhealth/agg"
+
+
+def aggregate_health_over_store(
+    store,
+    rank: int,
+    world_size: int,
+    round_id: int = 0,
+    timeout_s: float = 3.0,
+) -> Optional[Dict]:
+    """Merge every rank's health status into one cluster view on the
+    leader (same contract as ``exporter.aggregate_over_store``: bounded
+    deadline, missing ranks named, never raises). Returns the merged
+    view on rank 0 — also appended to
+    ``CGX_METRICS_DIR/cluster-health.jsonl`` when set — None elsewhere
+    or when this rank's engine is not running."""
+    from .exporter import _bounded_store_get
+
+    eng = health_mod.get_engine()
+    if eng is None:
+        return None
+    try:
+        key = f"{_HEALTH_PREFIX}/{round_id}/r{rank}"
+        store.set(key, json.dumps(eng.status()).encode())
+    except Exception as e:
+        log.warning("health aggregation publish failed: %s", e)
+        return None
+    if rank != 0:
+        return None
+    per_rank: Dict[int, Dict] = {}
+    missing: List[int] = []
+    deadline = time.monotonic() + timeout_s
+    for r in range(world_size):
+        raw = _bounded_store_get(
+            store, f"{_HEALTH_PREFIX}/{round_id}/r{r}", deadline
+        )
+        if raw is None:
+            missing.append(r)
+            continue
+        try:
+            per_rank[r] = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            missing.append(r)
+    worst: Optional[Dict[str, Any]] = None
+    for r, st in per_rank.items():
+        for peer, score in (st.get("straggler_scores") or {}).items():
+            if worst is None or score > worst["score"]:
+                worst = {"score": score, "suspect": int(peer),
+                         "reported_by": r}
+    view = {
+        "ts": round(time.time(), 6),
+        "round": round_id,
+        "world_size": world_size,
+        "ranks_reporting": sorted(per_rank),
+        "missing_ranks": missing,
+        "worst_straggler": worst,
+        "events": sum(
+            len(st.get("events_recent") or ()) for st in per_rank.values()
+        ),
+        "step_per_rank": {
+            r: st.get("step", {}) for r, st in per_rank.items()
+        },
+    }
+    directory = cfg.metrics_dir()
+    if directory:
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(
+                os.path.join(directory, "cluster-health.jsonl"), "a"
+            ) as f:
+                f.write(json.dumps(view) + "\n")
+        except OSError as e:
+            log.warning("cluster health write failed: %s", e)
+    return view

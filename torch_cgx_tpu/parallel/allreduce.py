@@ -130,6 +130,17 @@ def _report_qerr(path: str, leaf, rt) -> None:
 _QERR_SEEN: Dict[str, int] = {}
 
 
+def reset_qerr_sampling() -> None:
+    """Restart the flight-recorder qerr subsample cadence (the per-layer
+    every-32nd counters above). Called alongside the registry-version
+    bump (``supervisor.invalidate_trace_caches``): after a recovery
+    reconfiguration the retraced programs are a new qerr stream, and
+    keeping the dead generation's counters would subsample it on a stale
+    phase — the first post-recovery observation per layer must land in
+    the flight recorder, not be silently skipped."""
+    _QERR_SEEN.clear()
+
+
 @dataclasses.dataclass(frozen=True)
 class _Group:
     cc: CompressionConfig

@@ -17,6 +17,7 @@ TPU-native front door is functional instead:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Callable, Optional, Sequence
 
 import jax
@@ -759,15 +760,44 @@ def make_train_step(
             snap_holder["snap"] = ckpt.snapshot_in_memory(tree, idx)
             metrics.add("cgx.recovery.snapshots")
 
+    # Live health plane: step cadence measured host-side, dispatch to
+    # dispatch — under steady async pipelining the inter-call gap IS the
+    # step time (blocking on the result would serialize the pipeline).
+    # The histogram feeds cgx_top's step rate and the health engine's
+    # regression detector; pure host bookkeeping, nothing staged changes.
+    from ..observability import health as health_mod
+    from ..observability import watch as watch_mod
+
+    # process_index, not 0: on the multi-process JAX path this is the
+    # authoritative rank, and pinning 0 here would make every process
+    # write the same health-rank0 files on a shared metrics dir. A
+    # torch-bridge process that builds the step fn before dist init
+    # still gets rebound when ProcessGroupCGX passes the real rank.
+    _rank_hint = jax.process_index()
+    health_mod.maybe_start(_rank_hint)
+    watch_mod.maybe_start_prom(_rank_hint)
+    step_clock = {"t": None}
+
+    def _note_step_cadence() -> None:
+        t_now = time.perf_counter()
+        prev, step_clock["t"] = step_clock["t"], t_now
+        if prev is not None:
+            dt = t_now - prev
+            metrics.observe("cgx.step.time_s", dt)
+            health_mod.note_step(dt)
+        metrics.add("cgx.step.count")
+
     if error_feedback or powersgd_rank is not None or topk_ratio is not None:
 
         def step(params, opt_state, state, batch, step_idx):
+            _note_step_cadence()
             _maybe_snapshot(step_idx, (params, opt_state, state))
             return _build(batch)(params, opt_state, state, batch, step_idx)
 
     else:
 
         def step(params, opt_state, batch, step_idx):
+            _note_step_cadence()
             _maybe_snapshot(step_idx, (params, opt_state))
             return _build(batch)(params, opt_state, batch, step_idx)
 

@@ -46,10 +46,11 @@ from __future__ import annotations
 import dataclasses
 import sys
 import time
-from typing import Any, Callable, Dict, List, Optional, Sequence
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import config as cfg
 from ..observability import flightrec
+from ..observability import health as health_mod
 from ..observability import timeline
 from ..utils.logging import get_logger, metrics
 from . import rendezvous as rdz
@@ -101,13 +102,21 @@ def invalidate_trace_caches() -> None:
     """World-size shrink invalidation: bump the config registry version —
     the key every trace-time cache (``make_train_step``'s build cache,
     ``allreduce._tree_layout``'s LRU) already includes — and clear the
-    layout LRU outright when the JAX side is loaded. Lazy: a torch-only
-    bridge process must not import jax here."""
+    layout LRU outright when the JAX side is loaded, along with the
+    flightrec qerr subsample cadence (post-recovery programs are a new
+    qerr stream; stale per-layer counters would subsample it on the dead
+    generation's phase). Lazy: a torch-only bridge process must not
+    import jax here."""
     cfg._bump_registry_version()
     if "torch_cgx_tpu.parallel.allreduce" in sys.modules:
-        sys.modules["torch_cgx_tpu.parallel.allreduce"].invalidate_layout_cache(
-            "recovery reconfigure"
-        )
+        ar = sys.modules["torch_cgx_tpu.parallel.allreduce"]
+        ar.invalidate_layout_cache("recovery reconfigure")
+        ar.reset_qerr_sampling()
+    # The health engine's per-peer wait state is a pre-recovery stream
+    # too: an evicted peer whose wait EWMA froze at the timeout value
+    # would otherwise re-emit a phantom straggler event every cooldown
+    # window for the rest of the run.
+    health_mod.forget_peers()
     metrics.add("cgx.recovery.trace_cache_invalidations")
 
 
@@ -143,6 +152,12 @@ class RecoverySupervisor:
         # can run whole steps past a dead peer before anything blocks).
         self._snapshots: Dict[int, Any] = {}
         self._last_rollback_step: Optional[int] = None
+        # Live health plane (PR 6): sustained straggler scores arrive as
+        # suspect *hints* — evidence gathered BEFORE any bridge timeout
+        # fires, merged into the eviction vote when the ladder runs.
+        # global rank -> (monotonic receive time, score).
+        self._suspect_hints: Dict[int, Tuple[float, float]] = {}
+        health_mod.add_consumer(self.note_health_event)
 
     # -- introspection ----------------------------------------------------
 
@@ -175,6 +190,43 @@ class RecoverySupervisor:
     @property
     def last_rollback_step(self) -> Optional[int]:
         return self._last_rollback_step
+
+    # -- health hints (the observability→control handoff, PR 6) -----------
+
+    HINT_TTL_S = 60.0
+
+    def note_health_event(self, event) -> None:
+        """Health-engine consumer (registered in ``__init__`` when the
+        engine is running): a sustained straggler score against a peer
+        becomes suspect evidence for the next rendezvous — recorded in
+        the black box the moment it arrives, which is typically long
+        before any bounded wait expires."""
+        if getattr(event, "kind", None) != "straggler":
+            return
+        suspect = getattr(event, "suspect", None)
+        if suspect is None or suspect == self.global_rank:
+            return
+        self._suspect_hints[int(suspect)] = (
+            time.monotonic(), float(event.value),
+        )
+        metrics.add("cgx.recovery.health_hints")
+        flightrec.record(
+            "recovery", phase="health_hint", suspect=int(suspect),
+            score=float(event.value), generation=self.generation,
+        )
+
+    @property
+    def suspect_hints(self) -> Dict[int, float]:
+        """Fresh (within HINT_TTL_S) straggler hints: global rank ->
+        score."""
+        now = time.monotonic()
+        # list(): the health evaluator thread inserts concurrently, and a
+        # mid-iteration insert would raise exactly when a straggler event
+        # fires during an active recovery vote.
+        return {
+            g: score for g, (t, score) in list(self._suspect_hints.items())
+            if now - t <= self.HINT_TTL_S
+        }
 
     # -- snapshots (rung 4 substrate) -------------------------------------
 
@@ -229,6 +281,13 @@ class RecoverySupervisor:
         suspects = [
             globals_now[r] for r in suspects_local if 0 <= r < len(globals_now)
         ]
+        # Health-plane evidence: fresh sustained-straggler hints join the
+        # vote — crucially covering the case where the timeout names no
+        # suspect at all (cross-host peers have no heartbeat file).
+        for g in sorted(self.suspect_hints):
+            if g in globals_now and g not in suspects:
+                suspects.append(g)
+                metrics.add("cgx.recovery.health_hint_votes")
         degrade_vote = False
         if isinstance(exc, WireCorruptionError):
             self._corruptions += 1
@@ -266,6 +325,10 @@ class RecoverySupervisor:
             metrics.add("cgx.recovery.evictions", float(len(decision.evicted)))
         self._group.reconfigure(list(decision.survivors), new_gen)
         invalidate_trace_caches()
+        # Hints served their purpose in this vote; the new generation's
+        # evidence must come from post-recovery observations (an evicted
+        # rank's hint would otherwise linger for HINT_TTL_S).
+        self._suspect_hints.clear()
         timeline.record(
             "recovery.reconfigure", timeline.CAT_RECOVERY, t1,
             time.perf_counter() - t1, generation=new_gen,

@@ -68,7 +68,9 @@ from torch.futures import Future
 from .. import config as cfg
 from ..observability import exporter as obs_exporter
 from ..observability import flightrec
+from ..observability import health as health_mod
 from ..observability import timeline
+from ..observability import watch as watch_mod
 from ..ops import codec_host as hcodec
 from ..robustness import faults as faults_mod
 from ..robustness import heartbeat as hb_mod
@@ -414,6 +416,9 @@ def _record_qreduce_phases(
     metrics.observe(f"cgx.{kind}.scatter_reduce_s", t1 - t0)
     metrics.observe(f"cgx.{kind}.allgather_s", t2 - t1)
     metrics.add(f"cgx.{kind}.wire_bytes_out", float(wire_out))
+    # Raw-bytes sibling of wire_bytes_out: their ratio is the live wire
+    # compression ratio cgx_top and the Prometheus endpoint render.
+    metrics.add(f"cgx.{kind}.bytes_in", float(bytes_in))
     # Timeline: the two algorithm phases as spans keyed by the collective
     # prefix (the same key the wire messages carry — cross-rank linkable).
     timeline.record(
@@ -606,6 +611,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         flightrec.bind_rank(rank)
         timeline.bind_rank(rank)
         obs_exporter.start_exporter(rank)
+        # Live health plane (PR 6): the streaming evaluator (CGX_HEALTH)
+        # and the Prometheus endpoint (CGX_PROM_PORT) — both no-ops with
+        # their knobs unset, like the exporter above.
+        health_mod.maybe_start(rank)
+        watch_mod.maybe_start_prom(rank)
+        metrics.set("cgx.recovery.generation", float(generation))
         self._pid_by_rank: List[int] = []
         self._seq = 0  # collective sequence number (issued on calling thread)
         self._p2p_send = {}  # (dst, tag) -> count
@@ -1063,10 +1074,32 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 log.warning("store delete_key(%r) failed: %s", key, e)
 
     def _take(
-        self, key: str, readers: int = 1, local: Optional[bool] = None
+        self, key: str, readers: int = 1, local: Optional[bool] = None,
+        peer: Optional[int] = None,
     ) -> np.ndarray:
         """Blocking get + refcounted delete once all readers have read.
-        Abort-aware (waits poll the poison key) on both channels."""
+        Abort-aware (waits poll the poison key) on both channels.
+
+        ``peer`` is the GROUP-LOCAL rank this take waits on, when the
+        caller knows it (the SRA/Ring/alltoall exchanges always do): it
+        feeds the health engine's per-peer straggler scoring — attributed
+        by GLOBAL rank so scores survive reconfigurations. The hook is an
+        attribute check when CGX_HEALTH is off."""
+        if peer is not None and health_mod.active():
+            gpeer = (
+                self._global_ranks[peer]
+                if 0 <= peer < len(self._global_ranks) else None
+            )
+            tok = health_mod.wait_begin(gpeer, key)
+            try:
+                return self._take_inner(key, readers, local)
+            finally:
+                health_mod.wait_end(tok)
+        return self._take_inner(key, readers, local)
+
+    def _take_inner(
+        self, key: str, readers: int = 1, local: Optional[bool] = None
+    ) -> np.ndarray:
         if self._route_shm(local):
             return self._shm.take(key)
         t0 = time.perf_counter()
@@ -1301,7 +1334,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # Accumulate peers into our own chunk (TestRecv + decompress-add).
         for j in range(ws):
             if j != me:
-                buf = self._take(f"{pfx}/s{j}>{me}", local=local)
+                buf = self._take(
+                    f"{pfx}/s{j}>{me}", local=local, peer=_group[j]
+                )
                 _decompress_frames(buf, segs[me], fused, dummy, add=True, wire_dtype=wdt)
         # Requantize the reduced chunk + self-dequantize in ONE fused pass
         # (error symmetry, scatter_reduce_allgather.cc:157-160 —
@@ -1313,7 +1348,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # Round 2: gather every reduced chunk (allgather).
         for j in range(ws):
             if j != me:
-                buf = self._take(f"{pfx}/g{j}", readers=ws - 1, local=local)
+                buf = self._take(
+                    f"{pfx}/g{j}", readers=ws - 1, local=local,
+                    peer=_group[j],
+                )
                 _decompress_frames(buf, segs[j], fused, dummy, add=False, wire_dtype=wdt)
         _record_qreduce_phases("sra", pfx, ws, fused, wire_out, t0, t1)
 
@@ -1340,7 +1378,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
             frame = _compress_frames(fused, segs[s_idx], dummy, rng, wdt)
             wire_out += len(frame)
             self._put(f"{pfx}/r{step}>{right}", frame, local=local)
-            buf = self._take(f"{pfx}/r{step}>{me}", local=local)
+            buf = self._take(
+                f"{pfx}/r{step}>{me}", local=local,
+                peer=_group[(me - 1) % ws],
+            )
             _decompress_frames(buf, segs[r_idx], fused, dummy, add=True, wire_dtype=wdt)
         # Our fully-reduced chunk is (me+1) % ws; requantize + self-dequantize
         # it once, in one fused pass (error symmetry, ring.cc:190-199),
@@ -1351,7 +1392,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
             r_idx = (me - step) % ws  # chunk arriving this step
             wire_out += len(hold)
             self._put(f"{pfx}/a{step}>{right}", hold, local=local)
-            buf = self._take(f"{pfx}/a{step}>{me}", local=local)
+            buf = self._take(
+                f"{pfx}/a{step}>{me}", local=local,
+                peer=_group[(me - 1) % ws],
+            )
             _decompress_frames(buf, segs[r_idx], fused, dummy, add=False, wire_dtype=wdt)
             hold = buf.tobytes()  # forward verbatim next step
         _record_qreduce_phases("ring", pfx, ws, fused, wire_out, t0, t1)
@@ -1375,7 +1419,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
         for j in range(ws):
             if j == me:
                 continue
-            buf = self._take(f"{pfx}/x{j}", readers=ws - 1, local=local)
+            buf = self._take(
+                f"{pfx}/x{j}", readers=ws - 1, local=local, peer=_group[j]
+            )
             _decompress_frames(buf, segs, fused, dummy, add=True, wire_dtype=wdt)
 
     def _qreduce_flat(
@@ -1454,7 +1500,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 local=True,
             )
             buf = self._take(
-                f"{pfx}/h3.{leader}", readers=len(locals_) - 1, local=True
+                f"{pfx}/h3.{leader}", readers=len(locals_) - 1, local=True,
+                peer=leader,
             )
             _decompress_frames(
                 buf, segs, fused, dummy or intra_raw, add=False,
@@ -1462,7 +1509,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
             )
             return
         for idx in range(1, len(locals_)):
-            buf = self._take(f"{pfx}/h1.{leader}.{idx}", local=True)
+            buf = self._take(
+                f"{pfx}/h1.{leader}.{idx}", local=True, peer=locals_[idx]
+            )
             _decompress_frames(
                 buf, segs, fused, dummy or intra_raw, add=True,
                 wire_dtype=wdt,
@@ -1499,7 +1548,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         for j in range(ws):
             if j == me:
                 continue
-            buf = self._take(f"{pfx}/{j}", readers=ws - 1)
+            buf = self._take(f"{pfx}/{j}", readers=ws - 1, peer=j)
             arr += buf.view(np_dtype)
 
     def _allreduce_plain(self, t: torch.Tensor, op, seq: int) -> None:
@@ -1513,7 +1562,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             for j in range(ws):
                 if j == me:
                     continue
-                buf = self._take(f"{pfx}/{j}", readers=ws - 1)
+                buf = self._take(f"{pfx}/{j}", readers=ws - 1, peer=j)
                 parts.append(
                     torch.from_numpy(buf.copy()).view(torch.bfloat16)
                 )
@@ -1526,7 +1575,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             for j in range(ws):
                 if j == me:
                     continue
-                buf = self._take(f"{pfx}/{j}", readers=ws - 1)
+                buf = self._take(f"{pfx}/{j}", readers=ws - 1, peer=j)
                 parts.append(torch.from_numpy(buf.view(np_dtype).copy()))
             stack = torch.stack(parts)
         if op == dist.ReduceOp.SUM:
@@ -2354,6 +2403,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         obs_exporter.release_exporter()
         obs_exporter.aggregate_over_store(
             self._store, self._rank, self._size, timeout_s=3.0
+        )
+        # Cluster health view (no-op when the health engine is off): the
+        # leader folds every rank's final health status into
+        # cluster-health.jsonl over the same store control plane.
+        watch_mod.aggregate_health_over_store(
+            self._store, self._rank, self._size, timeout_s=2.0
         )
 
     def _gc_announce_tickets(self) -> None:
