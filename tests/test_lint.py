@@ -447,3 +447,102 @@ def test_linter_reduce_routing_escape_hatch_and_scope(tmp_path):
     )
     proc = _run_lint(other)
     assert proc.returncode == 0, proc.stdout
+
+
+def _staged_tree(tmp_path, name, body, manifest=None):
+    pdir = tmp_path / "torch_cgx_tpu" / "parallel"
+    pdir.mkdir(parents=True, exist_ok=True)
+    if manifest is not None:
+        (pdir / "xla_allreduce.py").write_text(manifest)
+    f = pdir / name
+    f.write_text(body)
+    return f
+
+
+_MANIFEST = (
+    'STAGED_PURE = (\n'
+    '    "torch_cgx_tpu/parallel/xla_allreduce.py",\n'
+    '    "torch_cgx_tpu/parallel/topology.py",\n'
+    ')\n'
+)
+
+
+def test_linter_flags_io_callback_in_staged_pure_module(tmp_path):
+    # The staged-purity gate (ISSUE 8 satellite): a host callback import
+    # inside the single-program allreduce silently reintroduces the host
+    # hop the staged path exists to remove — lint failure.
+    bad = _staged_tree(
+        tmp_path,
+        "xla_allreduce.py",
+        _MANIFEST
+        + "from jax.experimental import io_callback\n"
+        "def staged(x):\n"
+        "    io_callback(print, None, x)\n"
+        "    return x\n",
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "staged-pure" in proc.stdout and "io_callback" in proc.stdout
+
+
+def test_linter_flags_pure_callback_attribute_in_listed_module(tmp_path):
+    # Attribute-form references count too, in any module the manifest
+    # lists (topology.py here).
+    bad = _staged_tree(
+        tmp_path,
+        "topology.py",
+        "import jax\n"
+        "def classify(x):\n"
+        "    return jax.experimental.pure_callback(lambda v: v, x, x)\n",
+        manifest=_MANIFEST,
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert ".pure_callback" in proc.stdout
+
+
+def test_linter_staged_purity_scoped_to_manifest(tmp_path):
+    # Modules NOT listed (allreduce.py legitimately stages io_callback
+    # for the runtime-metrics knob) stay out of scope.
+    ok = _staged_tree(
+        tmp_path,
+        "allreduce.py",
+        "from jax.experimental import io_callback\n"
+        "def runtime_count(n):\n"
+        "    io_callback(print, None, n)\n",
+        manifest=_MANIFEST,
+    )
+    proc = _run_lint(ok)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_requires_staged_pure_manifest(tmp_path):
+    # xla_allreduce.py without a STAGED_PURE declaration cannot be
+    # checked — the missing manifest is itself a finding (the rule must
+    # not silently disarm).
+    bad = _staged_tree(
+        tmp_path,
+        "xla_allreduce.py",
+        "def staged(x):\n    return x\n",
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "STAGED_PURE" in proc.stdout
+
+
+def test_linter_staged_purity_armed_without_manifest_file(tmp_path):
+    # The manifest FILE deleted/renamed entirely: the rule stays armed on
+    # lint.py's built-in fallback list — a callback in topology.py is
+    # still flagged, plus a loud missing-manifest finding (the rule never
+    # silently disarms).
+    bad = _staged_tree(
+        tmp_path,
+        "topology.py",
+        "from jax.experimental import io_callback\n"
+        "def classify(x):\n"
+        "    io_callback(print, None, x)\n",
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "io_callback" in proc.stdout
+    assert "fallback" in proc.stdout

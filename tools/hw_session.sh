@@ -86,6 +86,10 @@ session() {
   fi
 
   # --- production-path measurements (known-good compile shapes) ---------
+  # Staged single-program allreduce vs the torch bridge (ISSUE 8): the
+  # staged child uses real chips when >= 4 answer, else records the @cpu
+  # placeholder trajectory; the bridge child is always CPU-pinned.
+  run 900 "xla_allreduce vs bridge" python bench.py --xla-allreduce --mb 8 --ws 4 || return 1
   run 600 "current"               python tools/qbench.py current || return 1
   run 600 "dequant reference"     python tools/qbench.py dequant || return 1
   run 600 "sra epilogue fused"    python tools/qbench.py sra_epilogue || return 1

@@ -389,7 +389,7 @@ _METRIC_NAMESPACES = ("cgx.", "span.")
 # stay uncheckable and pass.
 _METRIC_CGX_SUBNAMESPACES = frozenset({
     "collective", "faults", "flightrec", "health", "heartbeat", "qerr",
-    "recovery", "ring", "runtime", "shm", "sra", "step", "trace",
+    "recovery", "ring", "runtime", "shm", "sra", "step", "trace", "xla",
 })
 
 
@@ -518,6 +518,123 @@ def check_reducer_reduce_routing(path: Path, tree: ast.Module) -> list[str]:
     return [flagged[k] for k in sorted(flagged)]
 
 
+_STAGED_PURE_MANIFEST = "xla_allreduce.py"
+_CALLBACK_NAMES = {"io_callback", "pure_callback"}
+# Last-resort coverage when the manifest FILE itself is gone (deleted or
+# renamed): the committed staged-pure set, hardcoded so the rule stays
+# armed — a missing manifest must degrade loudly, never silently disarm.
+_STAGED_PURE_FALLBACK = (
+    ("torch_cgx_tpu", "parallel", "xla_allreduce.py"),
+    ("torch_cgx_tpu", "parallel", "topology.py"),
+)
+
+
+def _staged_pure_suffixes(manifest_path: Path) -> list[tuple[str, ...]] | None:
+    """The ``STAGED_PURE`` path list declared in
+    parallel/xla_allreduce.py (parsed, not imported — same discipline as
+    ``_timeline_bridge_ops``). Entries are repo-relative paths, returned
+    as part tuples for suffix matching. None = file missing or no
+    parseable declaration."""
+    try:
+        tree = ast.parse(manifest_path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "STAGED_PURE"
+            for t in node.targets
+        ):
+            continue
+        out: list[tuple[str, ...]] = []
+        for n in ast.walk(node.value):
+            if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                out.append(tuple(n.value.split("/")))
+        return out
+    return None
+
+
+def check_staged_purity(path: Path, tree: ast.Module) -> list[str]:
+    """Staged-purity gate for the in-XLA single-program allreduce: the
+    modules ``parallel/xla_allreduce.py`` lists in its ``STAGED_PURE``
+    manifest (and that file itself) must never import or reference
+    ``io_callback``/``pure_callback`` — one host callback inside the
+    staged program silently reintroduces the host round trip the staged
+    path exists to remove, and nothing at runtime would flag it (the
+    program still computes correct values, just slower). The jaxpr guard
+    in tests/test_xla_allreduce.py catches staged impurity at trace
+    time; this rule catches it at review time, in any code path."""
+    parts = tuple(path.parts)
+    if _LIB_DIR not in parts:
+        return []
+    # Manifest lives at a fixed repo-relative spot (<lib>/parallel/) so
+    # the rule still arms for STAGED_PURE entries anywhere under the lib,
+    # not just siblings of the manifest.
+    lib_root = Path(*parts[: parts.index(_LIB_DIR) + 1])
+    manifest = lib_root / "parallel" / _STAGED_PURE_MANIFEST
+    if path.name == _STAGED_PURE_MANIFEST and path.parent.name == "parallel":
+        suffixes = _staged_pure_suffixes(path)
+        if suffixes is None:
+            return [
+                f"{path}:1: staged-pure manifest missing: "
+                "xla_allreduce.py must declare a STAGED_PURE tuple of the "
+                "modules the purity rule covers"
+            ]
+    else:
+        suffixes = _staged_pure_suffixes(manifest)
+        missing_manifest = not manifest.exists()
+        if missing_manifest:
+            # Deleted/renamed manifest: stay armed on the committed
+            # fallback set, and say so on any file it covers.
+            suffixes = list(_STAGED_PURE_FALLBACK)
+        if not suffixes:
+            return []
+        if not any(
+            len(s) <= len(parts) and parts[len(parts) - len(s):] == s
+            for s in suffixes
+        ):
+            return []
+        if missing_manifest:
+            return [
+                f"{path}:1: staged-pure manifest "
+                f"{manifest} is missing — the purity rule is running on "
+                "lint.py's built-in fallback list; restore the "
+                "STAGED_PURE declaration"
+            ] + _staged_purity_findings(path, tree)
+    return _staged_purity_findings(path, tree)
+
+
+def _staged_purity_findings(path: Path, tree: ast.Module) -> list[str]:
+    findings: list[str] = []
+
+    def flag(lineno: int, what: str) -> None:
+        findings.append(
+            f"{path}:{lineno}: {what} in a staged-pure module — the "
+            "in-XLA single-program allreduce must not contain host "
+            "callbacks (xla_allreduce.STAGED_PURE; docs/PERF_NOTES.md "
+            "Single-program allreduce)"
+        )
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in _CALLBACK_NAMES:
+                    flag(node.lineno, f"import of {a.name!r}")
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                leaf = a.name.rsplit(".", 1)[-1]
+                if leaf in _CALLBACK_NAMES:
+                    flag(node.lineno, f"import of {a.name!r}")
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _CALLBACK_NAMES:
+                flag(node.lineno, f"reference to .{node.attr}")
+        elif isinstance(node, ast.Name):
+            if node.id in _CALLBACK_NAMES and isinstance(node.ctx, ast.Load):
+                flag(node.lineno, f"reference to {node.id!r}")
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -602,6 +719,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_library_hygiene(path, tree))
     out.extend(check_worker_timeline_coverage(path, tree))
     out.extend(check_reducer_reduce_routing(path, tree))
+    out.extend(check_staged_purity(path, tree))
     return out
 
 

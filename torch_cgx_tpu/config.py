@@ -79,6 +79,10 @@ METRICS_DIR = "CGX_METRICS_DIR"  # flight-recorder dumps + metric exports
 METRICS_FLUSH_S = "CGX_METRICS_FLUSH_S"  # periodic exporter interval
 QERR_STATS = "CGX_QERR_STATS"  # per-layer relative-L2 quantization error
 FLIGHTREC_CAP = "CGX_FLIGHTREC_CAP"  # flight-recorder ring capacity
+# In-XLA single-program allreduce + topology router (parallel/topology.py,
+# parallel/xla_allreduce.py — PR 7):
+XLA_ALLREDUCE = "CGX_XLA_ALLREDUCE"  # auto | on | off — staged-program routing
+SRA_EPILOGUE_MIN_ELEMS = "CGX_SRA_EPILOGUE_MIN_ELEMS"  # fused-epilogue floor
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -319,18 +323,64 @@ SRA_EPILOGUE = "CGX_SRA_EPILOGUE"
 
 def sra_epilogue() -> str:
     """SRA epilogue lowering: "auto" (the fused dequant-accumulate-
-    requantize Pallas kernel on TPU, the staged reference path elsewhere),
-    "fused" (force the fused kernel — interpret mode off-TPU; test knob),
-    or "staged" (force the reference path everywhere). Wire bytes are
-    identical between lowerings on the default ``div`` encode
-    (docs/COMPRESSION_GUIDE.md "reduce_rows and the wire-identity
-    contract")."""
+    requantize Pallas kernel on TPU for payloads at or above
+    ``CGX_SRA_EPILOGUE_MIN_ELEMS``, the staged reference path elsewhere
+    and below the crossover), "fused" (force the fused kernel at any
+    size — interpret mode off-TPU; test knob), or "staged" (force the
+    reference path everywhere). Wire bytes are identical between
+    lowerings on the default ``div`` encode (docs/COMPRESSION_GUIDE.md
+    "reduce_rows and the wire-identity contract")."""
     mode = _env.get_str_env_or_default(SRA_EPILOGUE, "auto").lower()
     if mode not in ("auto", "fused", "staged"):
         raise ValueError(
             f"{SRA_EPILOGUE} must be auto|fused|staged, got {mode!r}"
         )
     return mode
+
+
+def xla_allreduce() -> str:
+    """CGX_XLA_ALLREDUCE: routing mode of the in-XLA single-program
+    quantized allreduce (``parallel/xla_allreduce.py``) for intra-slice
+    groups, decided per collective by the topology router
+    (``parallel/topology.py``):
+
+    * "auto" (default) — stage intra-slice traffic only on a real TPU
+      backend; everywhere else the existing paths run unchanged (staged
+      programs, store keys and wire bytes are bit-identical with the knob
+      unset — the grad_sync bit-identity suite pins this).
+    * "on" — stage intra-slice traffic on any backend (CPU multi-device
+      included), and route MIXED groups (a mesh spanning slices with >1
+      device per slice) to the reference's two-level scheme: uncompressed
+      ICI reduce inside the slice, compressed exchange across slices.
+    * "off" — never route; the bridge/per-call paths keep all traffic.
+    """
+    mode = _env.get_str_env_or_default(XLA_ALLREDUCE, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"{XLA_ALLREDUCE} must be auto|on|off, got {mode!r}"
+        )
+    return mode
+
+
+DEFAULT_SRA_EPILOGUE_MIN_ELEMS = 1 << 20
+
+
+def sra_epilogue_min_elems() -> int:
+    """CGX_SRA_EPILOGUE_MIN_ELEMS: payload floor (decoded elements =
+    rows x chunk) below which ``CGX_SRA_EPILOGUE=auto`` keeps the STAGED
+    epilogue lowering even on TPU dispatch. Small fused buckets lose to
+    the staged path — the kernel's per-call fixed cost dominates before
+    its HBM-traffic savings amortize (BENCH_LOG
+    ``sra_epilogue_fused_vs_staged_4bit_1MB_x8``: fused 6.5 ms vs staged
+    1.0 ms at 2^18 elements, fused winning by ~1.9x at 2^27). Default
+    2^20 (a 4 MB fp32 payload) sits safely above the measured losing
+    region; re-measure the crossover per chip with
+    ``tools/qbench.py sra_epilogue`` and tune. ``CGX_SRA_EPILOGUE=fused``
+    still forces the kernel at any size (the test/bench knob)."""
+    v = _env.get_int_env_or_default(
+        SRA_EPILOGUE_MIN_ELEMS, DEFAULT_SRA_EPILOGUE_MIN_ELEMS
+    )
+    return max(v, 0)
 
 
 def bridge_device_codec() -> str:

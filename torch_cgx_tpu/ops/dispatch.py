@@ -115,8 +115,11 @@ def _use_fused_reduce(q: codec.QTensor, *, stochastic: bool = False) -> bool:
     """Fused-kernel eligibility for this QTensor under the current mode.
     "fused" forces the kernel (interpret mode off TPU — the test knob);
     "auto" takes it only on real TPU dispatch with the Pallas codec
-    allowed. Stochastic requantize needs the TPU hardware PRNG, which has
-    no interpret lowering — staged off-TPU regardless of mode."""
+    allowed AND a payload at or above the size crossover
+    (``CGX_SRA_EPILOGUE_MIN_ELEMS`` — small fused buckets measured SLOWER
+    than the staged ops, BENCH_LOG ``sra_epilogue_fused_vs_staged``).
+    Stochastic requantize needs the TPU hardware PRNG, which has no
+    interpret lowering — staged off-TPU regardless of mode."""
     mode = cfg_mod.sra_epilogue()
     if mode == "staged":
         return False
@@ -126,6 +129,8 @@ def _use_fused_reduce(q: codec.QTensor, *, stochastic: bool = False) -> bool:
         return False
     if mode == "fused":
         return True
+    if q.batch_rows * q.numel < cfg_mod.sra_epilogue_min_elems():
+        return False
     return _on_tpu() and cfg_mod.codec_impl() != "xla"
 
 
@@ -139,6 +144,34 @@ def fused_epilogue_would_run(
     runs in the same era — staged three-kernel shape or fused two-kernel
     shape."""
     return _use_fused_reduce(q, stochastic=stochastic)
+
+
+# ---------------------------------------------------------------------------
+# Staged-allreduce capability (CGX_XLA_ALLREDUCE = auto|on|off).
+#
+# The in-XLA single-program quantized allreduce (parallel/xla_allreduce.py)
+# compiles quantize -> collective exchange -> fused epilogue -> all_gather
+# into ONE staged XLA program for intra-slice groups. Whether a group is
+# *eligible* for that routing is a backend/knob question answered here, in
+# the same module that already decides codec and epilogue lowerings; the
+# *topology* question (is the group intra-slice?) belongs to
+# parallel/topology.py, which consults this gate.
+# ---------------------------------------------------------------------------
+
+
+def staged_allreduce_capable() -> bool:
+    """True when the current backend + ``CGX_XLA_ALLREDUCE`` mode allow
+    routing intra-slice traffic to the staged single-program allreduce:
+    "on" stages anywhere (CPU multi-device included — the bench/test
+    configuration), "auto" only on a real TPU backend (so the default is
+    inert on every CI/CPU path — staged programs, store keys and wire
+    bytes unchanged), "off" never."""
+    mode = cfg_mod.xla_allreduce()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return _on_tpu()
 
 
 def ordered_rowsum(vals: jax.Array) -> jax.Array:

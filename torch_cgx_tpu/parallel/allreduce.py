@@ -36,6 +36,7 @@ from ..utils.logging import metrics
 from ..utils.tracing import named_scope
 from ..utils.tree import path_str
 from . import mesh as mesh_mod
+from . import topology as topo_router
 from .reducers import (
     hierarchical_allreduce,
     quantized_allreduce,
@@ -250,18 +251,22 @@ def invalidate_layout_cache(reason: str = "reconfigure") -> None:
     get_logger().info("allreduce layout cache invalidated (%s)", reason)
 
 
-def _layout_key(paths_leaves, treedef, compress_small: bool):
+def _layout_key(paths_leaves, treedef, compress_small: bool, route_key):
     """Everything the layout is a function of: tree structure + leaf
     shapes/dtypes, plus every config input the grouping reads (the pattern
     registry via its version; the env-derived default config and
     thresholds re-read per call — cheap to read, included so an env flip
-    between calls can never hit a stale plan)."""
+    between calls can never hit a stale plan). ``route_key`` is the
+    topology router's (route, class) pair: a ``CGX_XLA_ALLREDUCE`` flip
+    or a mesh whose groups classify differently must derive a fresh plan,
+    never hit one cached for another routing era."""
     return (
         treedef,
         tuple(
             (tuple(l.shape), np.dtype(l.dtype).str) for _, l in paths_leaves
         ),
         bool(compress_small),
+        route_key,
         cfg_mod.registry_version(),
         cfg_mod.default_compression_config(),
         cfg_mod.minimal_size(),
@@ -270,8 +275,10 @@ def _layout_key(paths_leaves, treedef, compress_small: bool):
     )
 
 
-def _tree_layout(paths_leaves, treedef, compress_small: bool) -> _TreeLayout:
-    key = _layout_key(paths_leaves, treedef, compress_small)
+def _tree_layout(
+    paths_leaves, treedef, compress_small: bool, route_key=None
+) -> _TreeLayout:
+    key = _layout_key(paths_leaves, treedef, compress_small, route_key)
     hit = _LAYOUT_CACHE.get(key)
     if hit is not None:
         _LAYOUT_CACHE.move_to_end(key)
@@ -316,6 +323,7 @@ def allreduce_flat(
     key: Optional[jax.Array] = None,
     return_roundtrip: bool = False,
     slices: Optional[Sequence[Tuple[int, int]]] = None,
+    decision: Optional[topo_router.RouteDecision] = None,
 ):
     """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
     shard_map). Slicing by the fusion threshold happens here so oversized
@@ -329,8 +337,32 @@ def allreduce_flat(
     wire sends (``reducers.quantized_allreduce_with_wire`` — quantize-once
     by construction); Ring uses the hop-0 mirror, the hierarchical paths
     the per-level mirror (:func:`_stage1_roundtrip_piece`), and exact
-    wires (PSUM / compression off / fake-ratio tail) round-trip unchanged."""
+    wires (PSUM / compression off / fake-ratio tail) round-trip unchanged.
+
+    Topology routing (``topology.route(mesh, axes)``, computed per call
+    like every CGX_* knob): intra-slice single-axis slices go through the
+    staged-program wrappers (``xla_allreduce`` — same math and wire
+    bytes, plus the ``cgx.xla.*`` trace accounting the bridge spans no
+    longer cover), and a MIXED two-axis group under
+    ``CGX_XLA_ALLREDUCE=on`` gets the reference two-level override
+    (uncompressed ICI intra + compressed cross). With the knob unset on
+    non-TPU backends every decision is UNROUTED and the staged program is
+    bit-identical to the pre-router code. ``decision`` lets allreduce_tree
+    hand in its one-per-call routing decision — it cannot differ between
+    fusion groups of the same (mesh, axes) call, so per-group
+    re-classification would only re-scan the mesh for the same answer."""
+    from . import xla_allreduce as xla_mod
+
+    if decision is None:
+        decision = topo_router.route(mesh, axes)
     topo = topology or cfg_mod.topology_from_env()
+    if decision.route == topo_router.ROUTE_TWO_LEVEL and len(axes) == 2:
+        # Reference two-level scheme for a mixed (cross x intra) group:
+        # the intra stage rides ICI uncompressed (psum_scatter/all_gather
+        # under the leader scheme), only the cross exchange is quantized.
+        topo = topo_router.two_level_config(topo)
+        metrics.add("cgx.xla.routed_two_level")
+    staged = decision.route == topo_router.ROUTE_STAGED and len(axes) == 1
     n = flat.shape[0]
     ratio = cfg_mod.fake_ratio()
     tail = None
@@ -357,16 +389,22 @@ def allreduce_flat(
                 if axes[0] != mesh_mod.CROSS_AXIS
                 else topo.cross_reduction
             )
+            ar = (
+                xla_mod.staged_quantized_allreduce
+                if staged
+                else quantized_allreduce
+            )
+            ar_wire = (
+                xla_mod.staged_quantized_allreduce_with_wire
+                if staged
+                else quantized_allreduce_with_wire
+            )
             if return_roundtrip:
-                red_piece, rt_piece = quantized_allreduce_with_wire(
-                    piece, axes[0], ws, cc, red, k
-                )
+                red_piece, rt_piece = ar_wire(piece, axes[0], ws, cc, red, k)
                 pieces.append(red_piece)
                 rt_pieces.append(rt_piece)
             else:
-                pieces.append(
-                    quantized_allreduce(piece, axes[0], ws, cc, red, k)
-                )
+                pieces.append(ar(piece, axes[0], ws, cc, red, k))
         elif len(axes) == 2:
             cross_axis, intra_axis = axes
             pieces.append(
@@ -552,7 +590,14 @@ def allreduce_tree(
             (l / ws_total if _is_float(l) else l) for l in flat_leaves
         ]
 
-    groups = _tree_layout(paths_leaves, treedef, compress_small).groups
+    # One routing decision per call: it is a function of (mesh, axes) and
+    # the CGX_* knobs only, so every fusion group below shares it (and the
+    # layout key derives from the same scan instead of a second one).
+    decision = topo_router.route(mesh, axes)
+    groups = _tree_layout(
+        paths_leaves, treedef, compress_small,
+        route_key=(decision.route, decision.topo.kind),
+    ).groups
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     rt_out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     for gi, g in enumerate(groups):
@@ -605,11 +650,12 @@ def allreduce_tree(
                     reduced, rt_flat = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, return_roundtrip=True, slices=g.slices,
+                        decision=decision,
                     )
                 else:
                     reduced = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
-                        key=g_key, slices=g.slices,
+                        key=g_key, slices=g.slices, decision=decision,
                     )
             else:
                 metrics.add("cgx.trace.allreduce.raw_elems", float(fused.shape[0]))

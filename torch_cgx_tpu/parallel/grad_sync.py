@@ -34,6 +34,7 @@ from ..utils.compat import shard_map as _compat_shard_map
 from ..utils.logging import metrics
 from . import mesh as mesh_mod
 from . import reducers
+from . import topology as topo_router
 from .allreduce import allreduce_tree
 
 
@@ -649,10 +650,16 @@ def make_train_step(
         # trace time, so a re-registration (adapt_bits, new pattern
         # configs) must produce a fresh trace, not hit the stale one.
         version = cfg_mod.registry_version()
+        # Topology-route component: a CGX_XLA_ALLREDUCE flip (or a mesh
+        # whose groups reclassify) changes what allreduce_tree stages, so
+        # it must produce a fresh trace, never hit one from another
+        # routing era — same contract as the registry version.
+        xla_route = topo_router.cache_key(mesh, sync_axes)
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
             version,
+            xla_route,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.
@@ -693,18 +700,22 @@ def make_train_step(
             from ..observability import flightrec, timeline
 
             metrics.add("cgx.trace.train_step_builds")
+            if xla_route[0] == topo_router.ROUTE_STAGED:
+                metrics.add("cgx.xla.train_steps_staged")
             flightrec.record(
                 "train_step_trace",
                 compressor=compressor,
                 sync_axes=list(sync_axes),
                 guard=guard,
                 registry_version=version,
+                xla_route=list(xla_route),
             )
             timeline.instant(
                 "train_step_trace",
                 compressor=compressor,
                 guard=guard,
                 registry_version=version,
+                xla_route=list(xla_route),
             )
             sharded = _compat_shard_map(
                 body,

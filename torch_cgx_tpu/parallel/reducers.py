@@ -442,6 +442,28 @@ def alltoall_allreduce_with_wire(
     return dispatch.reduce_rows(gathered).astype(x.dtype), rt
 
 
+def sra_wire_frames(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    key: Optional[jax.Array] = None,
+):
+    """SRA allreduce with BOTH wire payloads threaded out (introspection
+    for the staged-program parity suite and bench byte pre-flights):
+    ``(out, q_sent, q_own)`` — the reduced buffer, the stage-1 (ws, chunk)
+    ``QTensor`` this device sent into the all_to_all, and the stage-2
+    requantized own chunk it all_gathers. One wire implementation
+    (:func:`_sra_exchange` / :func:`_sra_epilogue_q`), so the frames can
+    never drift from what :func:`sra_allreduce` actually ships."""
+    n = x.shape[0]
+    q, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
+    q_own = _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, x.dtype)
+    gathered = _gather_rows(q_own, axis_name)
+    out = _dequantize_rows(gathered).reshape(-1)[:n].astype(x.dtype)
+    return out, q, q_own
+
+
 def sra_stage1_wire(
     x: jax.Array,
     axis_name: str,

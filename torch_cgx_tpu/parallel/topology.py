@@ -1,0 +1,296 @@
+"""Two-level ICI/DCN topology router for compressed collectives.
+
+The reference paper's communicator hierarchy (PAPER.md §0) is two-level:
+a fast node-local plane (SHM) and a compressed cross-node plane (MPI).
+Its TPU-native form is *slice* topology: devices inside one TPU slice
+talk over ICI (fast, XLA-schedulable), devices in different slices talk
+over DCN (slow, the role the host bridge's shm/store plane plays for the
+torch path). This module is the router that re-introduces that
+distinction per collective:
+
+* classify each group a collective runs over — the devices varying along
+  the reduction axes of the mesh — as **intra-slice** (all devices share
+  one slice), **cross-slice** (one device per slice) or **mixed**
+  (spanning slices with more than one device in some slice), from the
+  device attributes alone (``slice_index`` on multi-slice TPU;
+  ``process_index`` is the host-granularity fallback that makes a
+  multi-host CPU/GPU mesh classify sensibly);
+* route intra-slice traffic to the in-XLA single-program quantized
+  allreduce (``parallel/xla_allreduce.py`` — no ``io_callback``, no
+  bridge hop), cross-slice traffic to the existing compressed DCN/bridge
+  path, and mixed groups to the reference's two-level scheme:
+  **uncompressed ICI reduce inside the slice, compressed exchange across
+  slices** (:func:`two_level_config` — ``hierarchical_allreduce``'s
+  leader scheme with ``intra_compress`` off lowers the intra stage to a
+  plain ``lax.psum_scatter``/``all_gather`` pair).
+
+Routing is gated by ``CGX_XLA_ALLREDUCE`` (see ``config.xla_allreduce``);
+with the knob unset every decision is :data:`ROUTE_UNROUTED` on non-TPU
+backends, so the default CPU/CI path is bit-identical to the pre-router
+code. This module is **staged-pure** (listed in
+``xla_allreduce.STAGED_PURE``): it must never import host-callback
+machinery — ``tools/lint.py`` enforces that.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import Counter
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config as cfg_mod
+from ..ops import dispatch
+
+# Group topology classes.
+TOPO_SINGLE = "single"  # ws == 1: nothing travels
+TOPO_INTRA = "intra_slice"  # all devices share one slice — ICI only
+TOPO_CROSS = "cross_slice"  # one device per slice — DCN only
+TOPO_MIXED = "mixed"  # spans slices, >1 device in some slice
+
+# Routing decisions.
+ROUTE_STAGED = "staged"  # in-XLA single program (xla_allreduce.py)
+ROUTE_BRIDGE = "bridge"  # cross-slice: the compressed DCN/bridge path
+ROUTE_TWO_LEVEL = "two_level"  # uncompressed ICI + compressed cross
+ROUTE_UNROUTED = "unrouted"  # knob off / ineligible: existing path
+
+
+def device_slice_id(dev) -> int:
+    """The slice a device belongs to: ``slice_index`` where the platform
+    exposes it (multi-slice TPU), else ``process_index`` (host
+    granularity — the bridge's shm/store plane is per-host, so host
+    boundaries are the right fallback notion of "crossing the slow
+    fabric"), else 0 (a single-process CPU/GPU mesh is one slice)."""
+    s = getattr(dev, "slice_index", None)
+    if s is not None:
+        try:
+            return int(s)
+        except (TypeError, ValueError):
+            pass
+    p = getattr(dev, "process_index", None)
+    try:
+        return int(p) if p is not None else 0
+    except (TypeError, ValueError):
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class GroupTopology:
+    """Classification of one collective group (hashable — rides the
+    layout-LRU and trace-cache keys)."""
+
+    kind: str
+    ws: int
+    n_slices: int
+    max_per_slice: int
+
+
+@dataclasses.dataclass(frozen=True)
+class RouteDecision:
+    route: str
+    topo: GroupTopology
+    reason: str
+
+
+def classify_slice_ids(ids: Sequence[int]) -> GroupTopology:
+    """Classify a group from its members' slice ids (the shared kernel of
+    the mesh- and host-based classifiers)."""
+    ids = list(ids)
+    ws = len(ids)
+    counts = Counter(ids)
+    n_slices = len(counts)
+    max_per = max(counts.values()) if counts else 1
+    if ws <= 1:
+        kind = TOPO_SINGLE
+    elif n_slices == 1:
+        kind = TOPO_INTRA
+    elif n_slices == ws:
+        kind = TOPO_CROSS
+    else:
+        kind = TOPO_MIXED
+    return GroupTopology(
+        kind=kind, ws=ws, n_slices=n_slices, max_per_slice=max_per
+    )
+
+
+def classify_hosts(hosts: Sequence) -> GroupTopology:
+    """Bridge-side classification: a torch process group's per-rank host
+    fingerprints (``ProcessGroupCGX._host_by_rank``) map to slice ids by
+    first-seen order. Same taxonomy as the mesh classifier, so the bridge
+    and the JAX router agree on what "mixed" means."""
+    seen: dict = {}
+    ids = []
+    for h in hosts:
+        if h not in seen:
+            seen[h] = len(seen)
+        ids.append(seen[h])
+    return classify_slice_ids(ids)
+
+
+# Classification of a fixed (mesh, axes) pair never changes, but the scan
+# is O(devices) Python work — too hot for per-train-step cache keys on big
+# meshes. Memoized keyed on the mesh object, the axes, AND the live
+# ``device_slice_id`` function (tests monkeypatch it to fake slice ids —
+# a patched function is a different key, so the memo can't serve stale
+# classifications across patches).
+_CLASSIFY_CACHE: dict = {}
+_CLASSIFY_CACHE_MAX = 64
+
+
+def classify_mesh_axes(mesh, axes: Sequence[str]) -> GroupTopology:
+    """Classify the groups a collective over ``axes`` runs on: devices
+    varying along ``axes`` with every other mesh coordinate fixed. All
+    groups of a grid mesh normally classify identically; if slices are
+    not axis-aligned (groups disagree), the conservative answer is MIXED
+    — the two-level scheme degrades gracefully, the staged fast path must
+    not engage on a group that secretly crosses DCN."""
+    try:
+        memo_key = (mesh, tuple(axes), device_slice_id)
+        hit = _CLASSIFY_CACHE.get(memo_key)
+    except TypeError:  # unhashable mesh stand-in
+        memo_key, hit = None, None
+    if hit is not None:
+        return hit
+    out = _classify_mesh_axes_scan(mesh, axes)
+    if memo_key is not None:
+        _CLASSIFY_CACHE[memo_key] = out
+        while len(_CLASSIFY_CACHE) > _CLASSIFY_CACHE_MAX:
+            _CLASSIFY_CACHE.pop(next(iter(_CLASSIFY_CACHE)))
+    return out
+
+
+def _classify_mesh_axes_scan(mesh, axes: Sequence[str]) -> GroupTopology:
+    arr = np.asarray(mesh.devices)
+    names = list(mesh.axis_names)
+    idxs = [names.index(a) for a in axes]
+    moved = np.moveaxis(arr, idxs, range(len(idxs)))
+    group_size = int(np.prod([arr.shape[i] for i in idxs])) if idxs else 1
+    cols = moved.reshape(group_size, -1)
+    topo: Optional[GroupTopology] = None
+    kinds = set()
+    worst: Optional[GroupTopology] = None
+    for c in range(cols.shape[1]):
+        t = classify_slice_ids([device_slice_id(d) for d in cols[:, c]])
+        kinds.add(t.kind)
+        topo = t
+        if worst is None or t.n_slices > worst.n_slices:
+            worst = t
+    assert topo is not None and worst is not None  # meshes are non-empty
+    if len(kinds) > 1:
+        return GroupTopology(
+            kind=TOPO_MIXED,
+            ws=topo.ws,
+            n_slices=worst.n_slices,
+            max_per_slice=worst.max_per_slice,
+        )
+    return topo
+
+
+def route(
+    mesh, axes: Sequence[str], *, allow_remesh: bool = False
+) -> RouteDecision:
+    """The per-collective routing decision:
+
+    * intra-slice single-axis groups -> :data:`ROUTE_STAGED` when the
+      capability gate (``dispatch.staged_allreduce_capable`` — the
+      ``CGX_XLA_ALLREDUCE`` knob + backend) allows;
+    * cross-slice groups -> :data:`ROUTE_BRIDGE` (the existing compressed
+      DCN/bridge path keeps them — its end-state role);
+    * mixed groups -> :data:`ROUTE_TWO_LEVEL` (reference two-level:
+      uncompressed ICI intra, compressed cross) — only under the explicit
+      ``on`` mode, because the override changes wire bytes and ``auto``
+      promises bit-identity with the knob unset. The scheme needs a
+      (cross, intra) grid: a 2-axis call can run it in-program, a 1-axis
+      caller only when it can re-mesh (``allow_remesh=True`` — the eager
+      ``staged_allreduce`` builds the grid from slice ids; shard_map
+      callers cannot, and get UNROUTED so telemetry and cache keys report
+      the path that actually runs);
+    * everything else -> :data:`ROUTE_UNROUTED` (existing paths, byte-
+      identical).
+    """
+    axes = tuple(axes)
+    topo = classify_mesh_axes(mesh, axes)
+    mode = cfg_mod.xla_allreduce()
+    if topo.kind == TOPO_SINGLE:
+        return RouteDecision(ROUTE_UNROUTED, topo, "ws == 1: nothing travels")
+    if not dispatch.staged_allreduce_capable():
+        return RouteDecision(
+            ROUTE_UNROUTED, topo,
+            "knob off" if mode == "off" else "auto: non-TPU backend",
+        )
+    if topo.kind == TOPO_INTRA and len(axes) == 1:
+        return RouteDecision(
+            ROUTE_STAGED, topo, "intra-slice: one staged XLA program"
+        )
+    if topo.kind == TOPO_CROSS:
+        return RouteDecision(
+            ROUTE_BRIDGE, topo, "cross-slice: compressed DCN/bridge path"
+        )
+    if topo.kind == TOPO_MIXED and mode == "on":
+        if len(axes) == 2 or allow_remesh:
+            return RouteDecision(
+                ROUTE_TWO_LEVEL, topo,
+                "mixed: uncompressed ICI intra + compressed cross "
+                "(two-level)",
+            )
+        return RouteDecision(
+            ROUTE_UNROUTED, topo,
+            "mixed 1-axis group: two-level needs a (cross, intra) mesh "
+            "(only the eager staged_allreduce can re-mesh)",
+        )
+    return RouteDecision(
+        ROUTE_UNROUTED, topo,
+        "intra-slice hierarchical mesh" if topo.kind == TOPO_INTRA
+        else "mixed group without CGX_XLA_ALLREDUCE=on",
+    )
+
+
+def cache_key(mesh, axes: Sequence[str]) -> Tuple[str, str]:
+    """The routing component of layout-LRU / trace-cache keys: (route,
+    topology class). Cheap (a device-attribute scan), re-read per call
+    like every CGX_* knob — flipping ``CGX_XLA_ALLREDUCE`` between calls
+    must produce a fresh plan, never hit a stale one."""
+    d = route(mesh, axes)
+    return (d.route, d.topo.kind)
+
+
+def two_level_config(
+    base: Optional[cfg_mod.TopologyConfig] = None,
+) -> cfg_mod.TopologyConfig:
+    """The reference's two-level scheme as a ``TopologyConfig`` override
+    (PAPER.md §0 in TPU-native form): the intra stage rides ICI
+    uncompressed — ``hierarchical_allreduce``'s leader scheme lowers it
+    to a plain ``lax.psum_scatter`` + ``all_gather`` — and only the
+    cross-slice exchange carries the quantized wire."""
+    base = base or cfg_mod.topology_from_env()
+    return dataclasses.replace(
+        base, intra_compress=False, intra_broadcast=True
+    )
+
+
+def two_level_mesh(devices: Optional[Sequence] = None):
+    """A (cross, intra) mesh grouped by slice id, for callers holding a
+    flat device list that classifies MIXED: row ``s`` holds slice ``s``'s
+    devices. Requires a uniform per-slice device count (TPU slices of one
+    topology always are); raises otherwise."""
+    import jax
+    from jax.sharding import Mesh
+
+    from . import mesh as mesh_mod
+
+    devices = list(devices) if devices is not None else jax.devices()
+    by_slice: dict = {}
+    for d in devices:
+        by_slice.setdefault(device_slice_id(d), []).append(d)
+    sizes = {len(v) for v in by_slice.values()}
+    if len(sizes) != 1:
+        raise ValueError(
+            "two_level_mesh: non-uniform devices per slice "
+            f"({ {k: len(v) for k, v in by_slice.items()} })"
+        )
+    rows = [by_slice[k] for k in sorted(by_slice)]
+    return Mesh(
+        np.asarray(rows, dtype=object),
+        (mesh_mod.CROSS_AXIS, mesh_mod.INTRA_AXIS),
+    )

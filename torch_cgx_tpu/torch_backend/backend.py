@@ -329,6 +329,85 @@ def _requantize_frames(
     return out
 
 
+# The topology router's group taxonomy (parallel/topology.py), duplicated
+# here in dependency-light form: the bridge must not import the parallel
+# package (it pulls flax/models) into every rank process. The duplication
+# is pinned by tests/test_xla_allreduce.py, which cross-checks this
+# classifier against topology.classify_hosts on the same host maps.
+TOPO_SINGLE = "single"
+TOPO_INTRA = "intra_slice"
+TOPO_CROSS = "cross_slice"
+TOPO_MIXED = "mixed"
+
+
+def _host_topology(hosts: Sequence) -> str:
+    """Classify a bridge group from its per-rank host fingerprints: one
+    host = intra_slice (the traffic the staged in-XLA program is taking
+    over — the bridge's end-state is to carry only the other classes),
+    all-distinct = cross_slice (the bridge's home turf), otherwise mixed
+    (the two-level leader scheme)."""
+    ws = len(hosts)
+    n_hosts = len(set(hosts))
+    if ws <= 1:
+        return TOPO_SINGLE
+    if n_hosts == 1:
+        return TOPO_INTRA
+    if n_hosts == ws:
+        return TOPO_CROSS
+    return TOPO_MIXED
+
+
+def _sra_fold_chunk(
+    fused: np.ndarray,
+    lo: int,
+    hi: int,
+    segs_me: Sequence[_Segment],
+    frames,
+    me: int,
+    ws: int,
+    dummy: bool,
+    wdt=np.float32,
+) -> None:
+    """Decompress-accumulate the SRA stage-1 frames into the own chunk
+    ``fused[lo:hi]`` with the accumulate association PINNED to the
+    dispatcher's ``ordered_rowsum`` fold: ``v0 + v1 + ...`` ascending by
+    peer rank, the raw own chunk at position ``me``. All three lowerings
+    of the SRA epilogue — the staged XLA ops, the fused Pallas kernel and
+    this host bridge — now share ONE association, which is what makes the
+    staged program's stage-2 wire bytes bit-identical to the bridge's on
+    the same inputs (the staged<->bridge wire contract,
+    docs/COMPRESSION_GUIDE.md). The previous in-place add (own chunk
+    first, then arrivals ascending) differed from this fold by a last ulp
+    whenever ``me >= 2`` — and a last-ulp-different accumulate is a
+    different requantized wire byte.
+
+    ``frames``: peer rank -> wire buffer (uint8 ndarray); own rank absent.
+    """
+    if hi <= lo:
+        return
+    # Chunk-local scratch reused across peers (segments shifted to chunk
+    # offsets) and in-place accumulate: the fold association is unchanged,
+    # but the hot path no longer allocates a full-fused-size buffer per
+    # collective plus a fresh accumulator per peer.
+    segs_local = [dataclasses.replace(s, start=s.start - lo) for s in segs_me]
+    scratch = np.empty(hi - lo, dtype=fused.dtype)
+    acc: Optional[np.ndarray] = None
+    for j in range(ws):
+        if j == me:
+            vals = fused[lo:hi]
+        else:
+            _decompress_frames(
+                frames[j], segs_local, scratch, dummy, add=False,
+                wire_dtype=wdt,
+            )
+            vals = scratch
+        if acc is None:
+            acc = vals.astype(np.float32, copy=True)
+        else:
+            acc += vals
+    fused[lo:hi] = acc
+
+
 def _chunk_split(
     n: int, ws: int, layers=None
 ) -> Tuple[List[int], List[int]]:
@@ -1278,6 +1357,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     "hier" if self._use_hierarchy(topo)
                     else topo.intra_reduction
                 ),
+                # Which fabric this group's traffic crosses — the router
+                # taxonomy (intra-slice bridge traffic is the class the
+                # staged in-XLA program exists to absorb).
+                topo=_host_topology(self._host_by_rank) if (
+                    self._host_by_rank
+                ) else "unknown",
             )
             if self._use_hierarchy(topo):
                 self._qreduce_hier(fused, fl, self._ns(f"cgx{seq}q"), wdt, topo)
@@ -1331,13 +1416,19 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 frame = _compress_frames(fused, segs[j], dummy, rng, wdt)
                 wire_out += len(frame)
                 self._put(f"{pfx}/s{me}>{j}", frame, local=local)
-        # Accumulate peers into our own chunk (TestRecv + decompress-add).
+        # Accumulate peers into our own chunk (TestRecv + decompress) —
+        # the fold association pinned to the dispatcher's ordered_rowsum
+        # (see _sra_fold_chunk: the staged<->bridge wire contract).
+        frames = {}
         for j in range(ws):
             if j != me:
-                buf = self._take(
+                frames[j] = self._take(
                     f"{pfx}/s{j}>{me}", local=local, peer=_group[j]
                 )
-                _decompress_frames(buf, segs[me], fused, dummy, add=True, wire_dtype=wdt)
+        _sra_fold_chunk(
+            fused, offs[me], offs[me] + sizes[me], segs[me], frames, me, ws,
+            dummy, wdt,
+        )
         # Requantize the reduced chunk + self-dequantize in ONE fused pass
         # (error symmetry, scatter_reduce_allgather.cc:157-160 —
         # load-bearing for the bit-exactness oracle).
@@ -1453,8 +1544,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # GROUP-GLOBAL predicate: every rank must take the same branch or
         # the collective deadlocks (a rank alone on its host still joins
         # the hierarchical path — as its own leader with no local peers).
-        n_hosts = len(set(self._host_by_rank))
-        return n_hosts > 1 and n_hosts < self._size
+        # The host map is the bridge's slice map, and "two-level applies"
+        # is exactly the topology router's MIXED class: spanning hosts
+        # with >1 rank on some host (parallel/topology.py taxonomy).
+        return _host_topology(self._host_by_rank) == TOPO_MIXED
 
     def _qreduce_hier(self, fused, layers, pfx, wdt, topo) -> None:
         """Two-level leader reduction (mpi_allreduce_operations.cc:139-185):
