@@ -203,3 +203,80 @@ def test_smoke_skips_placeholder_only_trajectories():
             _chip_row(10.0), _chip_row(10.0)]
     regs, _ = gate.smoke(hist, threshold_pct=30.0)
     assert regs and regs[0]["metric"] == "pallas_codec_roundtrip"
+
+
+# ---------------------------------------------------------------------------
+# Overlap-fraction floor (ISSUE 9 satellite): sched records gate a second
+# trajectory, <metric>:overlap_frac, like throughput — @cpu separation
+# preserved.
+# ---------------------------------------------------------------------------
+
+
+def _sched_rec(overlap, value=0.02, backend="host"):
+    return {
+        "tool": "bench",
+        "metric": "sched_pipelined_vs_monolithic_4bit_32MB_x4",
+        "value": value,
+        "unit": "GB/s",
+        "overlap_frac": overlap,
+        "backend": backend,
+        "chip": backend,
+    }
+
+
+def test_overlap_normalizer_yields_second_trajectory():
+    gate = _load_gate()
+    rec = _sched_rec(0.25)
+    keys = dict(gate.normalize_all(rec))
+    assert keys["sched_pipelined_vs_monolithic_4bit_32MB_x4"] == 0.02
+    assert (
+        keys["sched_pipelined_vs_monolithic_4bit_32MB_x4:overlap_frac"]
+        == 0.25
+    )
+    # 0.0 is a VALID measurement (total collapse must face the floor,
+    # not bypass it); absent/negative overlap contributes nothing
+    assert gate.normalize_overlap(_sched_rec(0.0)) is not None
+    assert gate.normalize_overlap(_sched_rec(-1.0)) is None
+    assert gate.normalize_overlap({"metric": "x", "value": 1}) is None
+
+
+def test_overlap_total_collapse_fails_the_gate():
+    # The worst regression — the pipeline fully re-serialized
+    # (overlap_frac 0.0, e.g. the schedule silently degraded to one
+    # chunk) — must fail, not slip past normalization.
+    gate = _load_gate()
+    history = [_sched_rec(0.25), _sched_rec(0.22), _sched_rec(0.28)]
+    baselines = gate.build_baselines(history)
+    regressions, _ = gate.gate([_sched_rec(0.0)], baselines, 30.0)
+    assert any(
+        r["metric"].endswith(":overlap_frac") and r["value"] == 0.0
+        for r in regressions
+    )
+
+
+def test_overlap_regression_fails_the_gate():
+    gate = _load_gate()
+    history = [_sched_rec(0.25), _sched_rec(0.22), _sched_rec(0.28)]
+    baselines = gate.build_baselines(history)
+    # a run whose pipeline quietly re-serialized: overlap collapses while
+    # throughput barely moves — the overlap floor must catch it
+    regressions, checks = gate.gate(
+        [_sched_rec(0.01, value=0.019)], baselines, 30.0
+    )
+    names = {r["metric"] for r in regressions}
+    assert "sched_pipelined_vs_monolithic_4bit_32MB_x4:overlap_frac" in names
+    assert "sched_pipelined_vs_monolithic_4bit_32MB_x4" not in names
+
+
+def test_overlap_placeholder_rows_key_cpu_trajectory():
+    gate = _load_gate()
+    rec = gate.normalize_overlap(_sched_rec(0.3, backend="cpu"))
+    assert rec is not None
+    assert rec[0].endswith(":overlap_frac@cpu")
+    # and the cpu trajectory never meets the host baseline
+    history = [_sched_rec(0.25)] * 3
+    baselines = gate.build_baselines(history)
+    regressions, checks = gate.gate(
+        [_sched_rec(0.01, backend="cpu")], baselines, 30.0
+    )
+    assert not regressions and not checks

@@ -546,3 +546,60 @@ def test_linter_staged_purity_armed_without_manifest_file(tmp_path):
     assert proc.returncode == 1
     assert "io_callback" in proc.stdout
     assert "fallback" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Schedule-stage blocking gate (ISSUE 9 satellite): no device syncs or
+# unbounded future waits inside schedule-executed pipeline bodies.
+# ---------------------------------------------------------------------------
+
+
+def test_linter_flags_block_until_ready_in_schedule(tmp_path):
+    # A device sync inside a stage body drains every in-flight chunk —
+    # it serializes the very pipeline the schedule compiles.
+    bad = _staged_tree(
+        tmp_path,
+        "schedule.py",
+        _MANIFEST
+        + "def pipelined_body(x):\n"
+        "    x.block_until_ready()\n"
+        "    return x\n",
+        manifest=_MANIFEST,
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "block_until_ready" in proc.stdout
+    assert "schedule-executed" in proc.stdout
+
+
+def test_linter_flags_unbounded_result_in_pipelined_worker(tmp_path):
+    # Worker-loop pipelined sections (functions named *pipelined*/*sched*
+    # in torch_backend/backend.py): an unconditional .result() parks the
+    # pipeline forever behind a dead peer — every wait must be bounded.
+    bdir = tmp_path / "torch_cgx_tpu" / "torch_backend"
+    bdir.mkdir(parents=True)
+    bad = bdir / "backend.py"
+    bad.write_text(
+        "def _qreduce_sra_pipelined(fut):\n"
+        "    return fut.result()\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert ".result()" in proc.stdout and "timeout" in proc.stdout
+
+
+def test_linter_allows_bounded_result_and_unscoped_functions(tmp_path):
+    # .result(timeout=...) is the sanctioned form, and functions OUTSIDE
+    # the pipelined sections (the monolithic paths) stay unconstrained.
+    bdir = tmp_path / "torch_cgx_tpu" / "torch_backend"
+    bdir.mkdir(parents=True)
+    ok = bdir / "backend.py"
+    ok.write_text(
+        "def _qreduce_sra_pipelined(fut, t):\n"
+        "    return fut.result(timeout=t)\n"
+        "def _qreduce_flat(fut, x):\n"
+        "    fut.result()\n"
+        "    return x.block_until_ready()\n"
+    )
+    proc = _run_lint(ok)
+    assert proc.returncode == 0, proc.stdout

@@ -1,0 +1,435 @@
+"""Compiled collective schedules (ISSUE 9 — ``parallel/schedule.py``).
+
+Covers the schedule compiler (chunk tables, the bit-equality contract of
+column-block chunking), the software-pipelined staged executor (bit-equal
+to the monolithic SRA on any payload, wire decode included; jaxpr-guarded
+zero host callbacks and per-chunk kernel counts), the schedule LRU
+(keying, hit/miss accounting, invalidation through BOTH
+``allreduce.invalidate_layout_cache`` and
+``supervisor.invalidate_trace_caches``), inertness with the knob unset,
+the reverse-layer-order group emission, and the bridge's dependency-light
+chunk-table duplicate.
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from torch_cgx_tpu import config as cgx_config
+from torch_cgx_tpu.config import CompressionConfig
+from torch_cgx_tpu.parallel import reducers, schedule
+from torch_cgx_tpu.parallel.allreduce import (
+    allreduce_tree,
+    invalidate_layout_cache,
+)
+from torch_cgx_tpu.utils.compat import shard_map
+
+WS = 4
+BUCKET = 512
+
+
+@pytest.fixture(autouse=True)
+def _fresh_caches():
+    schedule.schedule_cache_clear()
+    yield
+    schedule.schedule_cache_clear()
+
+
+def _mesh(ws=WS):
+    return Mesh(np.asarray(jax.devices()[:ws]), ("dp",))
+
+
+def _run_sharded(fn, per_rank, ws=WS, n_out=1):
+    mesh = _mesh(ws)
+    out_specs = P("dp") if n_out == 1 else (P("dp"),) * n_out
+    body = shard_map(
+        fn, mesh=mesh, in_specs=P("dp"), out_specs=out_specs,
+        check_vma=False,
+    )
+    arr = jax.device_put(
+        jnp.asarray(per_rank), NamedSharding(mesh, P("dp"))
+    )
+    return jax.jit(body)(arr)
+
+
+# ---------------------------------------------------------------------------
+# Chunk tables.
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_table_alignment_and_coverage():
+    align = schedule.chunk_alignment(BUCKET)
+    for width in (align * 8, align * 8 + 32, align * 3, 100_000):
+        table = schedule.chunk_table(width, 4, BUCKET)
+        # covers [0, width) contiguously
+        off = 0
+        for o, w in table:
+            assert o == off
+            off += w
+        assert off == width
+        # every interior boundary bucket-aligned
+        for o, _w in table[1:]:
+            assert o % align == 0
+
+
+def test_chunk_table_degrades_below_depth():
+    align = schedule.chunk_alignment(BUCKET)
+    assert schedule.chunk_table(align - 32, 4, BUCKET) == ((0, align - 32),)
+    assert schedule.chunk_table(align, 4, BUCKET) == ((0, align),)
+    assert len(schedule.chunk_table(align * 2, 4, BUCKET)) == 2
+    assert len(schedule.chunk_table(align * 16, 4, BUCKET)) == 4
+
+
+def test_bridge_chunk_table_matches_compiler():
+    """The bridge keeps a dependency-light duplicate
+    (``backend._sched_chunk_table`` — it must not import the parallel
+    package into every rank process); the two derivations must agree on
+    every (width, depth, bucket)."""
+    from torch_cgx_tpu.torch_backend import backend as be
+
+    for width in (0, 100, 512, 16384, 100_000, 2**21):
+        for chunks in (1, 2, 4, 8):
+            for bucket in (128, 512, 1024):
+                assert tuple(
+                    be._sched_chunk_table(width, chunks, bucket)
+                ) == schedule.chunk_table(width, chunks, bucket), (
+                    width, chunks, bucket,
+                )
+
+
+# ---------------------------------------------------------------------------
+# Staged pipelined executor: bit-equality + jaxpr guards.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("n", [WS * BUCKET * 8, 100_000, 12_345])
+def test_pipelined_bit_equal_to_monolithic(monkeypatch, n):
+    """The column-block pipeline preserves SRA ownership and the bucket
+    grid, so a deterministic pipelined run is bit-equal to the monolithic
+    SRA on ANY payload — reduced output AND wire decode."""
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    monkeypatch.setenv("CGX_SCHED_CHUNKS", "4")
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    sched = schedule.compiled_schedule(n, WS, cc)
+    assert sched is not None and sched.depth >= 2
+    rng = np.random.default_rng(0)
+    per = rng.normal(size=(WS, n)).astype(np.float32)
+
+    def mono(x):
+        o, rt = reducers.sra_allreduce_with_wire(x[0], "dp", WS, cc, None)
+        return o[None], rt[None]
+
+    def pipe(x):
+        o, rt = schedule.pipelined_quantized_allreduce(
+            x[0], "dp", WS, cc, "SRA", None, sched, with_wire=True
+        )
+        return o[None], rt[None]
+
+    om, om_rt = map(np.asarray, _run_sharded(mono, per, n_out=2))
+    op, op_rt = map(np.asarray, _run_sharded(pipe, per, n_out=2))
+    assert np.array_equal(om, op)
+    assert np.array_equal(om_rt, op_rt)
+    # error symmetry: all replicas hold identical bytes
+    assert all(np.array_equal(op[0], op[r]) for r in range(WS))
+
+
+def test_pipelined_jaxpr_per_chunk_kernels_no_callbacks(monkeypatch):
+    """The staged pipeline stays pure — zero host callbacks — and runs
+    exactly one quantize + one epilogue(+decode) composition PER CHUNK:
+    the chunked program's codec invocation count scales with depth, and
+    per-chunk collectives (all_to_all + all_gather each) are all present
+    in one traced program."""
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    monkeypatch.setenv("CGX_SCHED_CHUNKS", "4")
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    n = WS * BUCKET * 16
+    sched = schedule.compiled_schedule(n, WS, cc)
+    assert sched is not None
+    depth = sched.depth
+
+    def pipe(x):
+        return schedule.pipelined_quantized_allreduce(
+            x[0], "dp", WS, cc, "SRA", None, sched
+        )[None]
+
+    mesh = _mesh()
+    body = shard_map(
+        pipe, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False,
+    )
+    jaxpr = jax.make_jaxpr(body)(jnp.zeros((WS, n), jnp.float32))
+    txt = str(jaxpr)
+    assert "io_callback" not in txt and "pure_callback" not in txt
+
+    def count_prims(jx, name):
+        total = 0
+        for eqn in jx.eqns:
+            if eqn.primitive.name == name:
+                total += 1
+            for v in eqn.params.values():
+                for sub in (v if isinstance(v, (list, tuple)) else (v,)):
+                    while hasattr(sub, "jaxpr"):  # ClosedJaxpr -> Jaxpr
+                        sub = sub.jaxpr
+                    if hasattr(sub, "eqns"):
+                        total += count_prims(sub, name)
+        return total
+
+    def mono(x):
+        return reducers.sra_allreduce(x[0], "dp", WS, cc, None)[None]
+
+    mono_jx = jax.make_jaxpr(
+        shard_map(
+            mono, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False,
+        )
+    )(jnp.zeros((WS, n), jnp.float32)).jaxpr
+    inner = jaxpr.jaxpr
+    # One full quantize->exchange->epilogue->allgather composition PER
+    # CHUNK: every collective the monolithic program stages once (one
+    # all_to_all + one all_gather per QTensor leaf) appears depth times.
+    for prim in ("all_to_all", "all_gather"):
+        per_mono = count_prims(mono_jx, prim)
+        assert per_mono > 0
+        assert count_prims(inner, prim) == depth * per_mono, prim
+
+
+def test_pipelined_rejects_non_sra():
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    sched = schedule.CompiledSchedule(
+        table=((0, 512), (512, 512)), n=4096, ws=WS, chunk=1024, cc=cc
+    )
+    with pytest.raises(ValueError, match="SRA"):
+        schedule.pipelined_quantized_allreduce(
+            jnp.zeros(4096), "dp", WS, cc, "RING", None, sched
+        )
+
+
+# ---------------------------------------------------------------------------
+# Engagement gates + the schedule LRU.
+# ---------------------------------------------------------------------------
+
+
+def test_compiled_schedule_gates(monkeypatch):
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    n = WS * BUCKET * 16
+    # unset (auto) on the CPU backend: inert
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    assert schedule.compiled_schedule(n, WS, cc) is None
+    monkeypatch.setenv("CGX_SCHEDULE", "off")
+    assert schedule.compiled_schedule(n, WS, cc) is None
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    assert schedule.compiled_schedule(n, WS, cc) is not None
+    # non-SRA reductions, ws==1, disabled compression: never pipelined
+    assert schedule.compiled_schedule(n, WS, cc, reduction="RING") is None
+    assert schedule.compiled_schedule(n, 1, cc) is None
+    assert schedule.compiled_schedule(
+        n, WS, CompressionConfig(bits=32)
+    ) is None
+    # payload too small for 2 chunks: None — and the negative result is
+    # itself cached (second probe is a HIT, not a re-derive; a realistic
+    # tree's tiny fusion slice probes every collective)
+    schedule.schedule_cache_clear()
+    assert schedule.compiled_schedule(64, WS, cc) is None
+    misses = schedule.schedule_cache_stats()["misses"]
+    assert schedule.compiled_schedule(64, WS, cc) is None
+    stats = schedule.schedule_cache_stats()
+    assert stats["misses"] == misses and stats["hits"] == 1
+
+
+def test_schedule_cache_hits_and_knob_keying(monkeypatch):
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    n = WS * BUCKET * 16
+    schedule.schedule_cache_clear()
+    s1 = schedule.compiled_schedule(n, WS, cc)
+    stats = schedule.schedule_cache_stats()
+    assert (stats["hits"], stats["misses"]) == (0, 1)
+    s2 = schedule.compiled_schedule(n, WS, cc)
+    assert s2 is s1
+    assert schedule.schedule_cache_stats()["hits"] == 1
+    # a CGX_SCHED_CHUNKS flip is a different key — fresh plan, not stale
+    monkeypatch.setenv("CGX_SCHED_CHUNKS", "2")
+    s3 = schedule.compiled_schedule(n, WS, cc)
+    assert s3 is not None and s3.depth == 2
+    assert schedule.schedule_cache_stats()["misses"] == 2
+
+
+def test_invalidation_drops_compiled_schedules(monkeypatch):
+    """Satellite 4: BOTH invalidation entry points —
+    ``allreduce.invalidate_layout_cache`` and
+    ``supervisor.invalidate_trace_caches`` — must drop compiled schedules
+    (a stale chunk plan after a PR 5 reconfigure would wedge the
+    in-flight window against peers on the fresh world's plan)."""
+    from torch_cgx_tpu.robustness import supervisor as sup
+
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    cc = CompressionConfig(bits=4, bucket_size=BUCKET)
+    n = WS * BUCKET * 16
+
+    schedule.compiled_schedule(n, WS, cc)
+    assert schedule.schedule_cache_stats()["misses"] == 1
+    invalidate_layout_cache("test")
+    assert schedule.schedule_cache_stats() == {"hits": 0, "misses": 0}
+    assert not schedule._SCHED_CACHE
+
+    schedule.compiled_schedule(n, WS, cc)
+    assert schedule._SCHED_CACHE
+    sup.invalidate_trace_caches()
+    assert not schedule._SCHED_CACHE
+    # the registry-version bump alone would also re-key, but the cache
+    # must be EMPTY (stale plans must not age out while holding memory)
+    assert schedule.schedule_cache_stats() == {"hits": 0, "misses": 0}
+
+
+def test_cache_key_component_tracks_knobs(monkeypatch):
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    monkeypatch.delenv("CGX_SCHED_CHUNKS", raising=False)
+    base = schedule.cache_key_component()
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    assert schedule.cache_key_component() != base
+    monkeypatch.setenv("CGX_SCHED_CHUNKS", "7")
+    assert schedule.cache_key_component() == ("on", 7)
+
+
+# ---------------------------------------------------------------------------
+# allreduce_tree integration: inertness + reverse-order emission.
+# ---------------------------------------------------------------------------
+
+
+def _tree_sync(tree, ws=WS):
+    mesh = _mesh(ws)
+
+    def body(t):
+        sq = jax.tree.map(lambda l: l[0], t)
+        out = allreduce_tree(sq, mesh=mesh, axes=("dp",))
+        return jax.tree.map(lambda l: l[None], out)
+
+    sm = jax.jit(shard_map(
+        body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False,
+    ))
+    return jax.tree.map(np.asarray, sm(tree))
+
+
+def test_allreduce_tree_values_invariant_under_schedule(monkeypatch):
+    monkeypatch.setenv("CGX_COMPRESSION_QUANTIZATION_BITS", "4")
+    rng = np.random.default_rng(1)
+    tree = {
+        "big": jnp.asarray(
+            rng.normal(size=(WS, 300, 300)).astype(np.float32)
+        ),
+        "mid": jnp.asarray(rng.normal(size=(WS, 64, 64)).astype(np.float32)),
+        "tiny": jnp.asarray(rng.normal(size=(WS, 7)).astype(np.float32)),
+    }
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    base = _tree_sync(tree)
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    on = _tree_sync(tree)
+    for k in tree:
+        assert np.array_equal(base[k], on[k]), k
+    assert schedule.schedule_cache_stats()["misses"] >= 1
+
+
+def test_schedule_unset_stages_identical_program(monkeypatch):
+    """The inertness pin at the program level: with CGX_SCHEDULE unset
+    (auto, CPU backend) the traced program of allreduce_tree is
+    IDENTICAL to the pre-schedule code — same jaxpr text, no pipelined
+    chunks, no reverse-order emission."""
+    monkeypatch.setenv("CGX_COMPRESSION_QUANTIZATION_BITS", "4")
+    rng = np.random.default_rng(2)
+    tree = {
+        "a": jnp.zeros((WS, 200, 200), jnp.float32),
+        "b": jnp.zeros((WS, 33), jnp.float32),
+    }
+    mesh = _mesh()
+
+    def body(t):
+        sq = jax.tree.map(lambda l: l[0], t)
+        out = allreduce_tree(sq, mesh=mesh, axes=("dp",))
+        return jax.tree.map(lambda l: l[None], out)
+
+    sm = shard_map(
+        body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False,
+    )
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    j_unset = str(jax.make_jaxpr(sm)(tree))
+    monkeypatch.setenv("CGX_SCHEDULE", "off")
+    j_off = str(jax.make_jaxpr(sm)(tree))
+    assert j_unset == j_off
+    del rng
+
+
+def test_dispatch_order_reverses_groups():
+    assert schedule.dispatch_order(4) == (3, 2, 1, 0)
+    assert schedule.dispatch_order(1) == (0,)
+    assert schedule.dispatch_order(0) == ()
+
+
+def test_grad_sync_trace_cache_keys_schedule(monkeypatch):
+    """make_train_step's build cache must key on the schedule component:
+    a CGX_SCHEDULE flip between calls retraces instead of serving a
+    trace from another scheduling era (values stay identical — pinned
+    above — but the emission differs)."""
+    import optax
+
+    from torch_cgx_tpu.parallel import make_train_step
+
+    monkeypatch.setenv("CGX_COMPRESSION_QUANTIZATION_BITS", "4")
+    mesh = _mesh(2)
+    params = {"w": jnp.ones((BUCKET * 8,), jnp.float32)}
+
+    def loss_fn(p, batch):
+        return jnp.mean((batch["x"] @ jnp.ones((1,)) - p["w"].sum()) ** 2)
+
+    opt = optax.sgd(1e-2)
+    step = make_train_step(loss_fn, opt, mesh, axes=("dp",), donate=False)
+    batch = {"x": jnp.ones((2, 1), jnp.float32)}
+    opt_state = opt.init(params)
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    step(params, opt_state, batch, 0)
+    builds0 = int(
+        __import__(
+            "torch_cgx_tpu.utils.logging", fromlist=["metrics"]
+        ).metrics.get("cgx.trace.train_step_builds")
+    )
+    step(params, opt_state, batch, 1)  # same era: cached, no rebuild
+    from torch_cgx_tpu.utils.logging import metrics as _m
+
+    assert int(_m.get("cgx.trace.train_step_builds")) == builds0
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    step(params, opt_state, batch, 2)  # new era: fresh build
+    assert int(_m.get("cgx.trace.train_step_builds")) == builds0 + 1
+
+
+# ---------------------------------------------------------------------------
+# Registry/env hygiene for the suite.
+# ---------------------------------------------------------------------------
+
+
+def test_engaged_follows_mode(monkeypatch):
+    monkeypatch.delenv("CGX_SCHEDULE", raising=False)
+    assert schedule.engaged() is (jax.default_backend() == "tpu")
+    monkeypatch.setenv("CGX_SCHEDULE", "on")
+    assert schedule.engaged() is True
+    monkeypatch.setenv("CGX_SCHEDULE", "off")
+    assert schedule.engaged() is False
+    monkeypatch.setenv("CGX_SCHEDULE", "bogus")
+    with pytest.raises(ValueError):
+        cgx_config.schedule_mode()
+
+
+def test_sched_chunks_floor(monkeypatch):
+    monkeypatch.setenv("CGX_SCHED_CHUNKS", "0")
+    assert cgx_config.sched_chunks() == 1
+    monkeypatch.delenv("CGX_SCHED_CHUNKS", raising=False)
+    assert cgx_config.sched_chunks() == cgx_config.DEFAULT_SCHED_CHUNKS
+
+
+if __name__ == "__main__":
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    raise SystemExit(pytest.main([__file__, "-q"]))

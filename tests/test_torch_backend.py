@@ -823,6 +823,62 @@ def test_fsdp_quantized_allgather_ws4():
     _launch(_worker_fsdp_quantized_allgather, ws=4)
 
 
+def _worker_sched_pipelined(rank: int, ws: int) -> None:
+    """CGX_SCHEDULE=on bridge pipeline (ISSUE 9): the double-buffered
+    in-flight window must produce BIT-EQUAL results to the monolithic
+    path on a bucket-aligned payload (the schedule compiler's contract,
+    parallel/schedule.py), bump the ``cgx.sched.*`` bridge counters, and
+    record a live overlap ratio. The knob is re-read per collective, so
+    one group runs both forms back to back."""
+    import torch
+    import torch.distributed as dist
+
+    from torch_cgx_tpu.utils.logging import metrics
+
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+    os.environ["CGX_SCHED_CHUNKS"] = "4"
+    n = ws * 512 * 32  # ceil(n/ws) divides the bucket: aligned payload
+    x = (rank + 1) * (torch.arange(n, dtype=torch.float32) / n - 0.5)
+
+    os.environ.pop("CGX_SCHEDULE", None)
+    mono = x.clone()
+    dist.all_reduce(mono)
+    assert metrics.get("cgx.sched.bridge_collectives") == 0.0
+
+    os.environ["CGX_SCHEDULE"] = "on"
+    pipe = x.clone()
+    dist.all_reduce(pipe)
+    assert torch.equal(mono, pipe), (
+        "pipelined bridge result diverges from monolithic",
+        (mono - pipe).abs().max(),
+    )
+    assert metrics.get("cgx.sched.bridge_collectives") == 1.0
+    assert metrics.get("cgx.sched.wall_s") > 0.0
+    assert metrics.get("cgx.sched.overlap_s") > 0.0
+
+    # Sub-bucket payload: the plan degrades to one chunk -> the
+    # monolithic body runs even with the knob on (no per-chunk keys).
+    tiny = torch.full((256,), float(rank + 1))
+    dist.all_reduce(tiny)
+    assert torch.allclose(
+        tiny, torch.full((256,), _sum_expect(ws))
+    )
+    assert metrics.get("cgx.sched.bridge_collectives") == 1.0
+    os.environ.pop("CGX_SCHEDULE", None)
+    os.environ.pop("CGX_SCHED_CHUNKS", None)
+    os.environ.pop("CGX_COMPRESSION_QUANTIZATION_BITS", None)
+
+
+@pytest.mark.torch_bridge
+def test_sched_pipelined_bridge_ws2():
+    _launch(_worker_sched_pipelined, ws=2)
+
+
+@pytest.mark.torch_bridge
+def test_sched_pipelined_bridge_ws4():
+    _launch(_worker_sched_pipelined, ws=4, timeout=360.0)
+
+
 def _worker_subgroup(rank: int, ws: int) -> None:
     import torch
     import torch.distributed as dist

@@ -29,6 +29,14 @@ gate makes it mechanical:
   (bench.py emits one per run; a fused-path regression fails the gate
   once the trajectory holds a baseline), the qbench variants, and
   shm_bench.
+* **overlap floor** — records carrying a top-level ``overlap_frac``
+  (the ``bench.py --schedule`` pipelined rows: cgx_trace attribution's
+  share of collective wall time hidden under concurrent compute) gate a
+  second trajectory, ``<metric>:overlap_frac``, the same way throughput
+  does: higher is better, placeholder rows key ``@cpu``, published
+  floors from BASELINE.json apply. A schedule change that quietly
+  re-serializes communication fails here even when GB/s barely moves
+  (ROADMAP item 2's explicit ask).
 * **candidate** — a fresh run's JSON records (``--candidate file`` or
   ``-`` for stdin, same schemas the tools print).
 * **verdict** — a candidate value more than ``--threshold`` percent
@@ -123,6 +131,49 @@ def normalize(rec: dict) -> Optional[Tuple[str, float]]:
     return key, v
 
 
+# Overlap-fraction floor (ROADMAP item 2): schedule-pipelined bench
+# records carry a top-level ``overlap_frac`` — the cgx_trace attribution
+# measurement (share of collective wall time hidden under concurrent
+# compute). It is gated EXACTLY like a throughput: higher is better, a
+# candidate more than --threshold percent below its baseline fails, and
+# placeholder rows key into their own ``@cpu`` trajectory. A pipelining
+# regression (a schedule change that quietly re-serializes the wire)
+# shows up here even when raw GB/s barely moves.
+_OVERLAP_SUFFIX = ":overlap_frac"
+
+
+def normalize_overlap(rec: dict) -> Optional[Tuple[str, float]]:
+    """(``<metric>:overlap_frac`` key, fraction) for records carrying the
+    cgx_trace overlap measurement, or None. Unlike throughput, 0.0 is a
+    VALID (and maximally alarming) measurement — a run whose pipeline
+    fully re-serialized must meet the floor head-on, not bypass the gate
+    by being too broken to normalize."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return None
+    metric = rec.get("metric")
+    v = rec.get("overlap_frac")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return None
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v < 0:
+        return None
+    key = f"{metric}{_OVERLAP_SUFFIX}"
+    if is_placeholder(rec):
+        key += _PLACEHOLDER_SUFFIX
+    return key, float(v)
+
+
+def normalize_all(rec: dict) -> List[Tuple[str, float]]:
+    """Every gated (key, higher-is-better value) pair one record yields:
+    its throughput trajectory and, when present, its overlap-fraction
+    trajectory."""
+    out = []
+    for fn in (normalize, normalize_overlap):
+        norm = fn(rec)
+        if norm is not None:
+            out.append(norm)
+    return out
+
+
 def _normalize_bare(rec: dict) -> Optional[Tuple[str, float]]:
     if not isinstance(rec, dict) or rec.get("unresolved"):
         return None
@@ -155,9 +206,8 @@ def build_baselines(
     floors win when higher — a number we have published is a promise)."""
     by_key: Dict[str, List[float]] = defaultdict(list)
     for rec in history:
-        norm = normalize(rec)
-        if norm is not None:
-            by_key[norm[0]].append(norm[1])
+        for key, v in normalize_all(rec):
+            by_key[key].append(v)
     out = {k: median(v) for k, v in by_key.items()}
     for k, v in (published or {}).items():
         if not isinstance(v, (int, float)) or v <= 0:
@@ -178,23 +228,20 @@ def gate(
     checks: List[dict] = []
     regressions: List[dict] = []
     for rec in candidates:
-        norm = normalize(rec)
-        if norm is None:
-            continue
-        key, value = norm
-        base = baselines.get(key)
-        if base is None or base <= 0:
-            continue  # first sighting: nothing to regress against
-        delta_pct = (value - base) / base * 100.0
-        row = {
-            "metric": key,
-            "value": round(value, 4),
-            "baseline": round(base, 4),
-            "delta_pct": round(delta_pct, 1),
-        }
-        checks.append(row)
-        if delta_pct < -threshold_pct:
-            regressions.append(row)
+        for key, value in normalize_all(rec):
+            base = baselines.get(key)
+            if base is None or base <= 0:
+                continue  # first sighting: nothing to regress against
+            delta_pct = (value - base) / base * 100.0
+            row = {
+                "metric": key,
+                "value": round(value, 4),
+                "baseline": round(base, 4),
+                "delta_pct": round(delta_pct, 1),
+            }
+            checks.append(row)
+            if delta_pct < -threshold_pct:
+                regressions.append(row)
     return regressions, checks
 
 
@@ -210,29 +257,36 @@ def smoke(
     machine load, not a code change) must not fail CI. A sustained
     cliff — every recent record slow, which is what a real regression
     looks like — still fails."""
-    by_key: Dict[str, List[Tuple[int, dict]]] = defaultdict(list)
-    for i, rec in enumerate(history):
-        norm = normalize(rec)
-        if norm is not None:
-            by_key[norm[0]].append((i, rec))
+    by_key: Dict[str, List[float]] = defaultdict(list)
+    for rec in history:
+        for key, v in normalize_all(rec):
+            by_key[key].append(v)
     regressions: List[dict] = []
     checks: List[dict] = []
-    for key, rows in by_key.items():
+    for key, vals in by_key.items():
         if key.endswith(_PLACEHOLDER_SUFFIX):
             # Placeholder-only trajectory: a CPU stand-in exists to prove
             # the code path runs, not to defend a perf floor — shared-box
             # noise on it must never fail CI.
             continue
-        if len(rows) < 2:
+        if len(vals) < 2:
             continue
-        w = min(window, len(rows) - 1)
-        earlier = [r for _, r in rows[:-w]]
-        recent = [r for _, r in rows[-w:]]
-        best = max(recent, key=lambda r: normalize(r)[1])
-        base = build_baselines(earlier)
-        r, c = gate([best], base, threshold_pct)
-        regressions.extend(r)
-        checks.extend(c)
+        w = min(window, len(vals) - 1)
+        earlier, recent = vals[:-w], vals[-w:]
+        best = max(recent)
+        base = median(earlier)
+        if base <= 0:
+            continue
+        delta_pct = (best - base) / base * 100.0
+        row = {
+            "metric": key,
+            "value": round(best, 4),
+            "baseline": round(base, 4),
+            "delta_pct": round(delta_pct, 1),
+        }
+        checks.append(row)
+        if delta_pct < -threshold_pct:
+            regressions.append(row)
     return regressions, checks
 
 

@@ -8,13 +8,20 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  overlap  sched$  straggler  gen  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
   step loop) fall back to the allreduce count delta.
 * **wire ratio** — ``bytes_in / wire_bytes_out`` over the SRA/Ring
   counters: the live compression ratio actually achieved on the wire.
+* **overlap** — ``cgx.sched.overlap_s / cgx.sched.wall_s``: the live
+  share of pipelined-collective wall time hidden under concurrent
+  encode compute (the schedule compiler's whole point — ROADMAP item 2;
+  ``-`` when no pipelined collective has run).
+* **sched$** — schedule-cache hit rate ``hits/(hits+misses)`` from the
+  ``cgx.sched.cache_*`` counters (a low rate mid-run means plans are
+  being re-derived — churning configs or an invalidation storm).
 * **straggler** — the health engine's worst per-peer skew score as
   ``score→peer`` (needs CGX_HEALTH on the ranks).
 * **gen** — the recovery generation gauge (``cgx.recovery.generation``).
@@ -182,6 +189,22 @@ def _wire_ratio(m: Dict[str, float]) -> str:
     return f"{bytes_in / out:.1f}x"
 
 
+def _overlap(m: Dict[str, float]) -> str:
+    wall = m.get("cgx.sched.wall_s", 0.0)
+    if not wall:
+        return "-"
+    return f"{min(m.get('cgx.sched.overlap_s', 0.0) / wall, 1.0):.2f}"
+
+
+def _sched_cache(m: Dict[str, float]) -> str:
+    hits = m.get("cgx.sched.cache_hits", 0.0)
+    misses = m.get("cgx.sched.cache_misses", 0.0)
+    total = hits + misses
+    if not total:
+        return "-"
+    return f"{hits / total * 100:.0f}%"
+
+
 def _straggler(status: Optional[dict]) -> str:
     scores = (status or {}).get("straggler_scores") or {}
     if not scores:
@@ -207,7 +230,7 @@ def render(directory: str, state: dict) -> str:
         f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
-               "straggler", "gen", "last_fault")
+               "overlap", "sched$", "straggler", "gen", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -218,6 +241,8 @@ def render(directory: str, state: dict) -> str:
             _fmt_ms(m.get("cgx.collective.allreduce_s.p50")),
             _fmt_ms(m.get("cgx.collective.allreduce_s.p99")),
             _wire_ratio(m),
+            _overlap(m),
+            _sched_cache(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
             _last_fault(d["last_fault"]),

@@ -90,6 +90,10 @@ session() {
   # staged child uses real chips when >= 4 answer, else records the @cpu
   # placeholder trajectory; the bridge child is always CPU-pinned.
   run 900 "xla_allreduce vs bridge" python bench.py --xla-allreduce --mb 8 --ws 4 || return 1
+  # Compiled-schedule pipeline vs monolithic (ISSUE 9): bridge children
+  # are CPU-pinned process groups — never touches the device transport,
+  # and the record carries the cgx_trace overlap_frac the gate floors on.
+  run_cpu 900 "sched pipelined vs monolithic" env JAX_PLATFORMS=cpu python bench.py --schedule --mb 32 --ws 4
   run 600 "current"               python tools/qbench.py current || return 1
   run 600 "dequant reference"     python tools/qbench.py dequant || return 1
   run 600 "sra epilogue fused"    python tools/qbench.py sra_epilogue || return 1

@@ -389,7 +389,8 @@ _METRIC_NAMESPACES = ("cgx.", "span.")
 # stay uncheckable and pass.
 _METRIC_CGX_SUBNAMESPACES = frozenset({
     "collective", "faults", "flightrec", "health", "heartbeat", "qerr",
-    "recovery", "ring", "runtime", "shm", "sra", "step", "trace", "xla",
+    "recovery", "ring", "runtime", "sched", "shm", "sra", "step", "trace",
+    "xla",
 })
 
 
@@ -526,6 +527,7 @@ _CALLBACK_NAMES = {"io_callback", "pure_callback"}
 _STAGED_PURE_FALLBACK = (
     ("torch_cgx_tpu", "parallel", "xla_allreduce.py"),
     ("torch_cgx_tpu", "parallel", "topology.py"),
+    ("torch_cgx_tpu", "parallel", "schedule.py"),
 )
 
 
@@ -635,6 +637,80 @@ def _staged_purity_findings(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+_SCHED_BLOCKING_CALLS = {"block_until_ready"}
+
+
+def _is_sched_stage_scope(path: Path, fn_name: str) -> bool:
+    """Whether a function body is schedule-executed pipeline code: anything
+    in ``parallel/schedule.py``, or a worker-loop pipelined section in
+    ``torch_backend/backend.py`` (functions/methods named ``*pipelined*``
+    or ``*sched*`` — the ``_qreduce_sra_pipelined`` family and its
+    helpers)."""
+    if _LIB_DIR not in path.parts:
+        return False
+    if "parallel" in path.parts and path.name == "schedule.py":
+        return True
+    if "torch_backend" in path.parts and path.name == "backend.py":
+        return "pipelined" in fn_name or "sched" in fn_name
+    return False
+
+
+def check_schedule_stage_blocking(path: Path, tree: ast.Module) -> list[str]:
+    """Pipeline-purity gate for the compiled collective schedules: a stage
+    body executed by the schedule (``parallel/schedule.py``, and the
+    worker-loop pipelined sections of ``torch_backend/backend.py``) must
+    never synchronize the pipeline it exists to overlap —
+
+    * ``x.block_until_ready()`` inside a staged stage body drains every
+      in-flight chunk's collective before the next stage is even issued
+      (and on the staged-pure plane would not even lint as a callback,
+      since it is a host-side sync, not an ``io_callback``);
+    * an UNCONDITIONAL ``.result()`` (no ``timeout=``) on a
+      future/async handle parks the worker thread forever behind a chunk
+      a dead peer will never deliver — every pipelined wait must be
+      bounded, like every other bridge wait (docs/ROBUSTNESS.md).
+
+    ``.result(timeout=...)`` is the sanctioned form. Scoped tightly so
+    the monolithic paths (and tests/benches, which legitimately sync)
+    stay unconstrained."""
+    findings: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _is_sched_stage_scope(path, node.name):
+            continue
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if name in _SCHED_BLOCKING_CALLS:
+                findings.append(
+                    f"{path}:{n.lineno}: blocking '{name}()' inside "
+                    f"schedule-executed stage body {node.name!r} — a "
+                    "device sync serializes the very pipeline the "
+                    "schedule compiles (parallel/schedule.py contract; "
+                    "docs/PERF_NOTES.md Compiled schedules)"
+                )
+            elif name == "result" and isinstance(fn, ast.Attribute):
+                if not any(
+                    kw.arg and "timeout" in kw.arg.lower()
+                    for kw in n.keywords
+                ) and not n.args:
+                    findings.append(
+                        f"{path}:{n.lineno}: unconditional '.result()' "
+                        f"inside schedule-executed stage body "
+                        f"{node.name!r} — bound it with timeout= so a "
+                        "dead peer cannot park the pipeline forever "
+                        "(docs/ROBUSTNESS.md; parallel/schedule.py "
+                        "contract)"
+                    )
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -720,6 +796,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_worker_timeline_coverage(path, tree))
     out.extend(check_reducer_reduce_routing(path, tree))
     out.extend(check_staged_purity(path, tree))
+    out.extend(check_schedule_stage_blocking(path, tree))
     return out
 
 

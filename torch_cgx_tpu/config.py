@@ -83,6 +83,9 @@ FLIGHTREC_CAP = "CGX_FLIGHTREC_CAP"  # flight-recorder ring capacity
 # parallel/xla_allreduce.py — PR 7):
 XLA_ALLREDUCE = "CGX_XLA_ALLREDUCE"  # auto | on | off — staged-program routing
 SRA_EPILOGUE_MIN_ELEMS = "CGX_SRA_EPILOGUE_MIN_ELEMS"  # fused-epilogue floor
+# Compiled collective schedules (parallel/schedule.py — PR 9):
+SCHEDULE = "CGX_SCHEDULE"  # auto | on | off — chunked pipelined collectives
+SCHED_CHUNKS = "CGX_SCHED_CHUNKS"  # pipeline depth (chunks per fusion slice)
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -360,6 +363,48 @@ def xla_allreduce() -> str:
             f"{XLA_ALLREDUCE} must be auto|on|off, got {mode!r}"
         )
     return mode
+
+
+def schedule_mode() -> str:
+    """CGX_SCHEDULE: chunked quantize->wire->epilogue pipelining of the
+    compressed collectives (``parallel/schedule.py``):
+
+    * "auto" (default) — pipeline only where it is bit-inert to enable:
+      the staged in-XLA plane on a real TPU backend (where the latency-
+      hiding scheduler can actually overlap the per-chunk collectives
+      with the codec kernels). Everywhere else — CPU/CI, and the host
+      bridge, whose pipelined schedule changes store keys — the existing
+      monolithic paths run unchanged: staged programs, store keys and
+      wire bytes are bit-identical with the knob unset (the grad_sync
+      bit-identity suite pins this).
+    * "on" — pipeline everywhere the schedule compiler can derive a
+      multi-chunk plan: the staged plane on any backend (CPU multi-device
+      benches/tests) AND the bridge worker loop (double-buffered
+      encode/put/take/epilogue windows; per-chunk store keys).
+    * "off" — never pipeline.
+    """
+    mode = _env.get_str_env_or_default(SCHEDULE, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{SCHEDULE} must be auto|on|off, got {mode!r}")
+    return mode
+
+
+DEFAULT_SCHED_CHUNKS = 4
+
+
+def sched_chunks() -> int:
+    """CGX_SCHED_CHUNKS: target pipeline depth — how many chunks the
+    schedule compiler splits each fusion slice into. The compiler rounds
+    chunk boundaries to the wire-layout alignment (``ws * bucket_size``
+    elements) so a pipelined schedule quantizes every element in the
+    same bucket as the monolithic layout (bit-equal results on aligned
+    payloads — docs/PERF_NOTES.md "Compiled schedules"); payloads too
+    small for the requested depth get fewer chunks, down to 1 (no
+    pipeline). Default 4: enough depth that chunk k+1's quantize, chunk
+    k's wire and chunk k-1's epilogue genuinely co-exist, small enough
+    that per-chunk fixed costs stay amortized."""
+    v = _env.get_int_env_or_default(SCHED_CHUNKS, DEFAULT_SCHED_CHUNKS)
+    return max(v, 1)
 
 
 DEFAULT_SRA_EPILOGUE_MIN_ELEMS = 1 << 20

@@ -22,6 +22,7 @@ the static-shape cache key exactly as planned in SURVEY.md §7.4.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from collections import OrderedDict
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -36,6 +37,7 @@ from ..utils.logging import metrics
 from ..utils.tracing import named_scope
 from ..utils.tree import path_str
 from . import mesh as mesh_mod
+from . import schedule as sched_mod
 from . import topology as topo_router
 from .reducers import (
     hierarchical_allreduce,
@@ -246,6 +248,11 @@ def invalidate_layout_cache(reason: str = "reconfigure") -> None:
     cache was actually cycled."""
     layout_cache_clear()
     metrics.add("cgx.trace.layout_cache_invalidations")
+    # Compiled schedules derive their chunk tables from the same world
+    # the layouts did — a stale chunk plan after a reconfigure would
+    # wedge the bridge's in-flight window against peers on the fresh
+    # plan, so the two caches cycle together.
+    sched_mod.invalidate_schedule_cache(reason)
     from ..utils.logging import get_logger
 
     get_logger().info("allreduce layout cache invalidated (%s)", reason)
@@ -389,16 +396,46 @@ def allreduce_flat(
                 if axes[0] != mesh_mod.CROSS_AXIS
                 else topo.cross_reduction
             )
-            ar = (
-                xla_mod.staged_quantized_allreduce
-                if staged
-                else quantized_allreduce
+            # Schedule compiler (CGX_SCHEDULE, parallel/schedule.py): a
+            # multi-chunk plan pipelines this fusion slice — chunk k+1
+            # quantizes while chunk k is on the wire and chunk k-1 runs
+            # the fused epilogue, all inside the same staged program.
+            # None (the default everywhere off-TPU with the knob unset)
+            # keeps the monolithic path bit-identical.
+            sched = sched_mod.compiled_schedule(
+                ln, ws, cc, reduction=red,
+                dtype=np.dtype(flat.dtype).str, route=decision.route,
+                route_staged=staged,
             )
-            ar_wire = (
-                xla_mod.staged_quantized_allreduce_with_wire
-                if staged
-                else quantized_allreduce_with_wire
-            )
+            if sched is not None:
+                ar = functools.partial(
+                    xla_mod.staged_pipelined_allreduce
+                    if staged
+                    else sched_mod.pipelined_quantized_allreduce,
+                    sched=sched,
+                )
+                ar_wire = (
+                    functools.partial(
+                        xla_mod.staged_pipelined_allreduce_with_wire,
+                        sched=sched,
+                    )
+                    if staged
+                    else functools.partial(
+                        sched_mod.pipelined_quantized_allreduce,
+                        sched=sched, with_wire=True,
+                    )
+                )
+            else:
+                ar = (
+                    xla_mod.staged_quantized_allreduce
+                    if staged
+                    else quantized_allreduce
+                )
+                ar_wire = (
+                    xla_mod.staged_quantized_allreduce_with_wire
+                    if staged
+                    else quantized_allreduce_with_wire
+                )
             if return_roundtrip:
                 red_piece, rt_piece = ar_wire(piece, axes[0], ws, cc, red, k)
                 pieces.append(red_piece)
@@ -600,7 +637,23 @@ def allreduce_tree(
     ).groups
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     rt_out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
-    for gi, g in enumerate(groups):
+    # Emission order of the fused groups: with the schedule compiler
+    # engaged (CGX_SCHEDULE), groups are STAGED in reverse-layer order —
+    # backward produces the tail layers' gradients first, so their
+    # collectives can start while earlier layers' gradients are still
+    # being computed (the reference's DDP-hook bucket ordering as
+    # emission order for the latency-hiding scheduler). Values are
+    # order-invariant: each group keeps its ORIGINAL fold index ``gi``,
+    # so bytes never change — only the schedule does. With the knob
+    # unset off-TPU the order (and the whole staged program) is
+    # unchanged.
+    order = (
+        sched_mod.dispatch_order(len(groups))
+        if sched_mod.engaged()
+        else range(len(groups))
+    )
+    for gi in order:
+        g = groups[gi]
         # distinct stochastic-rounding stream per fused group (groups would
         # otherwise share fold sequences and thus random fields)
         g_key = jax.random.fold_in(key, gi) if key is not None else None

@@ -655,11 +655,19 @@ def make_train_step(
         # it must produce a fresh trace, never hit one from another
         # routing era — same contract as the registry version.
         xla_route = topo_router.cache_key(mesh, sync_axes)
+        # Schedule component: a CGX_SCHEDULE/CGX_SCHED_CHUNKS flip changes
+        # the emission (pipelined chunks, reverse-order group dispatch) of
+        # the staged program — it must retrace, never serve a trace from
+        # another scheduling era.
+        from . import schedule as sched_mod
+
+        sched_key = sched_mod.cache_key_component()
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
             version,
             xla_route,
+            sched_key,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.
@@ -709,6 +717,7 @@ def make_train_step(
                 guard=guard,
                 registry_version=version,
                 xla_route=list(xla_route),
+                schedule=list(sched_key),
             )
             timeline.instant(
                 "train_step_trace",
@@ -716,6 +725,7 @@ def make_train_step(
                 guard=guard,
                 registry_version=version,
                 xla_route=list(xla_route),
+                schedule=list(sched_key),
             )
             sharded = _compat_shard_map(
                 body,

@@ -66,6 +66,7 @@ from . import reducers, topology
 STAGED_PURE = (
     "torch_cgx_tpu/parallel/xla_allreduce.py",
     "torch_cgx_tpu/parallel/topology.py",
+    "torch_cgx_tpu/parallel/schedule.py",
 )
 
 
@@ -130,6 +131,49 @@ def staged_quantized_allreduce_with_wire(
     _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
     return reducers.quantized_allreduce_with_wire(
         x, axis_name, ws, cc, reduction, key
+    )
+
+
+def staged_pipelined_allreduce(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    reduction: str = cfg_mod.REDUCTION_SRA,
+    key: Optional[jax.Array] = None,
+    sched=None,
+):
+    """Schedule-compiled sibling of :func:`staged_quantized_allreduce`:
+    the fusion slice runs as a chunked software pipeline compiled into
+    the same single staged program (``parallel/schedule.py`` — chunk k+1
+    quantizes while chunk k is on the wire and chunk k-1 runs the fused
+    epilogue). Same ``cgx.xla.*`` trace accounting plus the schedule's
+    own ``cgx.sched.*`` counters."""
+    from . import schedule as sched_mod
+
+    _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
+    return sched_mod.pipelined_quantized_allreduce(
+        x, axis_name, ws, cc, reduction, key, sched
+    )
+
+
+def staged_pipelined_allreduce_with_wire(
+    x: jax.Array,
+    axis_name: str,
+    ws: int,
+    cc: CompressionConfig,
+    reduction: str = cfg_mod.REDUCTION_SRA,
+    key: Optional[jax.Array] = None,
+    sched=None,
+):
+    """Error-feedback sibling of :func:`staged_pipelined_allreduce`:
+    ``(reduced, wire_decode)``, the per-chunk wire decodes concatenated
+    (quantize-once — each chunk's decode shares its stage-1 payload)."""
+    from . import schedule as sched_mod
+
+    _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
+    return sched_mod.pipelined_quantized_allreduce(
+        x, axis_name, ws, cc, reduction, key, sched, with_wire=True
     )
 
 

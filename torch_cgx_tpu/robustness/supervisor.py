@@ -112,6 +112,15 @@ def invalidate_trace_caches() -> None:
         ar = sys.modules["torch_cgx_tpu.parallel.allreduce"]
         ar.invalidate_layout_cache("recovery reconfigure")
         ar.reset_qerr_sampling()
+    elif "torch_cgx_tpu.parallel.schedule" in sys.modules:
+        # allreduce.invalidate_layout_cache drops compiled schedules too;
+        # this arm covers a process that loaded the schedule compiler
+        # without the tree-allreduce layer (a stale chunk plan after a
+        # reconfigure would wedge the pipelined in-flight window against
+        # peers running the fresh world's plan).
+        sys.modules["torch_cgx_tpu.parallel.schedule"].invalidate_schedule_cache(
+            "recovery reconfigure"
+        )
     # The health engine's per-peer wait state is a pre-recovery stream
     # too: an evicted peer whose wait EWMA froze at the timeout value
     # would otherwise re-emit a phantom straggler event every cooldown

@@ -329,6 +329,49 @@ def _requantize_frames(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Compiled-schedule chunk plan (parallel/schedule.py), duplicated here in
+# dependency-light form — same reason as the topology taxonomy below: the
+# bridge must not import the parallel package into every rank process.
+# tests/test_schedule.py cross-checks this against schedule.chunk_table.
+# ---------------------------------------------------------------------------
+
+_SCHED_LANE_GROUP = 32  # codec packing group (ops/codec.LANE_GROUP)
+# Double-buffered in-flight window of the pipelined bridge: how many chunks
+# the encoder thread may run ahead of the worker thread's take/epilogue
+# (schedule._BRIDGE_WINDOW's bridge-side value — 2 = classic double
+# buffering; deeper only grows arena residency without adding overlap).
+_SCHED_WINDOW = 2
+
+
+def _sched_chunk_table(
+    width: int, chunks: int, bucket_size: int
+) -> List[Tuple[int, int]]:
+    """(offset, width) sub-chunk plan over one rank's chunk of ``width``
+    elements — boundaries at multiples of ``lcm(bucket, 32)`` so the
+    quantization bucket grid within the chunk is unchanged (the
+    bit-equality contract of parallel/schedule.py). Degrades to a single
+    chunk when the width is too small for the requested depth."""
+    import math as _math
+
+    if width <= 0:
+        return [(0, max(width, 0))] if width else []
+    align = _math.lcm(max(1, bucket_size), _SCHED_LANE_GROUP)
+    chunks = max(1, int(chunks))
+    units = width // align
+    depth = min(chunks, units) if units else 1
+    if depth <= 1:
+        return [(0, width)]
+    per = (units // depth) * align
+    out = []
+    off = 0
+    for _ in range(depth - 1):
+        out.append((off, per))
+        off += per
+    out.append((off, width - off))
+    return out
+
+
 # The topology router's group taxonomy (parallel/topology.py), duplicated
 # here in dependency-light form: the bridge must not import the parallel
 # package (it pulls flax/models) into every rank process. The duplication
@@ -1393,6 +1436,187 @@ class ProcessGroupCGX(dist.ProcessGroup):
             cfg.dummy_compression() or force_raw,
         )
 
+    def _sched_tables(
+        self, sizes: List[int], layers
+    ) -> Optional[List[List[Tuple[int, int]]]]:
+        """Per-rank sub-chunk plans for a pipelined SRA (CGX_SCHEDULE=on),
+        or None when the payload can't sustain a >= 2-deep pipeline.
+        Group-global by construction — every rank derives every rank's
+        table from (sizes, layer configs, env knobs), so writers and
+        readers always agree on the framing of each sub-chunk. Tables
+        are padded to a common depth with empty entries (empty frames
+        travel, like empty monolithic chunks — no rank ever skips a
+        matching put/take)."""
+        import math as _math
+
+        buckets = [c.bucket_size for (_o, _n, c) in layers] or [1]
+        align = 1
+        for b in buckets:
+            align = _math.lcm(align, max(1, b))
+        chunks = cfg.sched_chunks()
+        tables = [
+            _sched_chunk_table(s, chunks, align) for s in sizes
+        ]
+        depth = max((len(t) for t in tables), default=1)
+        if depth < 2:
+            return None
+        for t in tables:
+            while len(t) < depth:
+                end = t[-1][0] + t[-1][1] if t else 0
+                t.append((end, 0))
+        return tables
+
+    def _qreduce_sra_pipelined(
+        self, fused, layers, pfx, wdt, tables, *, ranks=None, local=None,
+        force_raw=False,
+    ) -> None:
+        """Schedule-pipelined SRA (CGX_SCHEDULE=on — parallel/schedule.py's
+        bridge plane): each rank's chunk is split into the sub-chunk plan
+        ``tables[r]``, and the strict phase barriers of the monolithic
+        path are replaced by a double-buffered in-flight window — an
+        encoder thread runs chunk encode+put up to ``_SCHED_WINDOW``
+        chunks ahead while this (worker) thread takes, folds, requantizes
+        and decodes earlier chunks. Per-chunk store keys
+        (``{pfx}/c<k>s…``/``…g…``) namespace the sub-collectives; wire
+        framing per sub-chunk restarts quantization buckets at aligned
+        boundaries, so the single-default-config case stays bit-equal to
+        the monolithic path (bench.py --schedule asserts it).
+
+        Overlap accounting: the encoder's per-chunk work is recorded as
+        ``sched_encode`` CAT_SPAN timeline spans (compute concurrent with
+        the in-flight collective — exactly what ``cgx_trace``'s
+        ``overlap_frac`` measures) and summed into ``cgx.sched.overlap_s``
+        against ``cgx.sched.wall_s`` for the live ratio ``cgx_top``
+        renders."""
+        _group, me, ws, dummy = self._group_ctx(ranks, force_raw)
+        sizes, offs = _chunk_split(fused.shape[0], ws, layers)
+        depth = len(tables[0])
+        seed = cfg.global_seed()
+        stoch = cfg.stochastic_rounding()
+
+        def _rng(c: int, salt: str):
+            # Per-(collective, chunk, stage) deterministic streams: the
+            # monolithic path's sequential per-rank generator would make
+            # draw order depend on pipeline timing. Stochastic bytes
+            # therefore differ from the monolithic path — as they differ
+            # between any two schedules (parallel/schedule.py contract).
+            if not stoch:
+                return None
+            import zlib as _zlib
+
+            mix = _zlib.crc32(f"{pfx}/c{c}/{salt}".encode())
+            return np.random.default_rng(
+                (seed << 16) ^ (self._rank + 1) ^ mix
+            )
+
+        def _segs(r: int, c: int):
+            lo = offs[r] + tables[r][c][0]
+            return _segments_in(layers, lo, lo + tables[r][c][1]), lo
+
+        stop = threading.Event()
+        enc_state = {"err": None, "busy_s": 0.0, "wire_out": 0}
+        window = threading.Semaphore(_SCHED_WINDOW)
+
+        def _encode_loop() -> None:
+            try:
+                for c in range(depth):
+                    # Double-buffered window: run at most _SCHED_WINDOW
+                    # chunks ahead of the worker thread's epilogues (the
+                    # deadline bounds a worker stuck in a failed take —
+                    # the stop event, checked after, breaks us out).
+                    while not window.acquire(timeout=0.2):
+                        if stop.is_set():
+                            return
+                    if stop.is_set():
+                        return
+                    t0 = time.perf_counter()
+                    rng = _rng(c, "enc")
+                    for j in range(ws):
+                        if j == me:
+                            continue
+                        segs_j, _lo = _segs(j, c)
+                        frame = _compress_frames(
+                            fused, segs_j, dummy, rng, wdt
+                        )
+                        enc_state["wire_out"] += len(frame)
+                        self._put(f"{pfx}/c{c}s{me}>{j}", frame, local=local)
+                    dur = time.perf_counter() - t0
+                    enc_state["busy_s"] += dur
+                    # CAT_SPAN: this is compute running CONCURRENTLY with
+                    # the in-flight collective — the interval cgx_trace's
+                    # overlap_frac intersects against the collective span.
+                    timeline.record(
+                        "sched_encode", timeline.CAT_SPAN, t0, dur,
+                        key=f"{pfx}/c{c}",
+                    )
+            except Exception as e:  # surfaced by the worker thread below
+                enc_state["err"] = e
+
+        t0 = time.perf_counter()
+        metrics.add("cgx.sched.bridge_collectives")
+        metrics.add("cgx.sched.chunks_bridge", float(depth))
+        enc = threading.Thread(
+            target=_encode_loop, name="cgx-sched-enc", daemon=True
+        )
+        enc.start()
+        wire_out = 0
+        t1 = t0
+        try:
+            for c in range(depth):
+                if enc_state["err"] is not None:
+                    raise enc_state["err"]
+                tc0 = time.perf_counter()
+                frames = {}
+                for j in range(ws):
+                    if j != me:
+                        frames[j] = self._take(
+                            f"{pfx}/c{c}s{j}>{me}", local=local,
+                            peer=_group[j],
+                        )
+                segs_me, lo = _segs(me, c)
+                hi = lo + tables[me][c][1]
+                _sra_fold_chunk(
+                    fused, lo, hi, segs_me, frames, me, ws, dummy, wdt
+                )
+                wire = _requantize_frames(
+                    fused, segs_me, dummy, _rng(c, "req"), wdt
+                )
+                wire_out += len(wire)
+                t1 = time.perf_counter()
+                self._put(
+                    f"{pfx}/c{c}g{me}", wire, readers=ws - 1, local=local
+                )
+                for j in range(ws):
+                    if j != me:
+                        buf = self._take(
+                            f"{pfx}/c{c}g{j}", readers=ws - 1, local=local,
+                            peer=_group[j],
+                        )
+                        segs_j, _lo_j = _segs(j, c)
+                        _decompress_frames(
+                            buf, segs_j, fused, dummy, add=False,
+                            wire_dtype=wdt,
+                        )
+                window.release()
+                timeline.record(
+                    "sched.chunk", timeline.CAT_PHASE, tc0,
+                    time.perf_counter() - tc0,
+                    key=f"{pfx}/c{c}", ws=ws, chunk=c,
+                )
+        finally:
+            stop.set()
+            enc.join(timeout=self._timeout_s)
+        if enc_state["err"] is not None:
+            raise enc_state["err"]
+        wire_out += enc_state["wire_out"]
+        wall = time.perf_counter() - t0
+        # Live overlap ratio: encoder-thread busy seconds over collective
+        # wall seconds (the encoder runs strictly inside this collective's
+        # window, so its busy time IS communication-hidden compute).
+        metrics.add("cgx.sched.overlap_s", enc_state["busy_s"])
+        metrics.add("cgx.sched.wall_s", wall)
+        _record_qreduce_phases("sra", pfx, ws, fused, wire_out, t0, t1)
+
     def _qreduce_sra(
         self, fused, layers, pfx, wdt=np.float32, *, ranks=None, local=None,
         force_raw=False,
@@ -1401,8 +1625,23 @@ class ProcessGroupCGX(dist.ProcessGroup):
         algorithm (scatter_reduce_allgather.cc:94-202). Empty chunks travel
         as empty payloads, so no rank ever skips a matching put/take.
         ``ranks``/``local`` scope it to a subgroup/channel (the hierarchical
-        leaders' cross stage); keys use subgroup indices."""
+        leaders' cross stage); keys use subgroup indices.
+
+        With ``CGX_SCHEDULE=on`` and a payload that sustains a >= 2-deep
+        chunk plan, the schedule-pipelined variant runs instead
+        (:meth:`_qreduce_sra_pipelined` — double-buffered in-flight
+        windows; per-chunk store keys). The knob unset keeps this
+        monolithic body byte-identical, store keys included."""
         _group, me, ws, dummy = self._group_ctx(ranks, force_raw)
+        if ws > 1 and cfg.schedule_mode() == "on":
+            sizes, _offs = _chunk_split(fused.shape[0], ws, layers)
+            tables = self._sched_tables(sizes, layers)
+            if tables is not None:
+                self._qreduce_sra_pipelined(
+                    fused, layers, pfx, wdt, tables,
+                    ranks=ranks, local=local, force_raw=force_raw,
+                )
+                return
         rng = self._stochastic_rng()
         sizes, offs = _chunk_split(fused.shape[0], ws, layers)
         segs = [

@@ -177,7 +177,8 @@ def _reap_dead_arenas(directory: str) -> None:
 
 
 class _Region:
-    __slots__ = ("gen", "off", "size", "ack_key", "readers", "freed")
+    __slots__ = ("gen", "off", "size", "ack_key", "readers", "freed",
+                 "copying")
 
     def __init__(self, gen: int, off: int, size: int, ack_key: str, readers: int):
         self.gen = gen
@@ -186,6 +187,11 @@ class _Region:
         self.ack_key = ack_key
         self.readers = readers
         self.freed = False
+        # Payload memcpy in flight outside the arena lock (ShmArena.write):
+        # an epoch-bump abandon must not mark this region freed — freed
+        # bytes can be re-allocated, and the new frame would interleave
+        # with our copy.
+        self.copying = False
 
 
 class _GenFile:
@@ -211,6 +217,11 @@ class _GenFile:
         self.head = 0  # next write offset
         self.tail = 0  # oldest live byte
         self.live = 0  # bytes in flight (incl. wrap gaps)
+        # In-flight payload copies running OUTSIDE the arena lock (the
+        # pipelined bridge's encoder thread overlaps its memcpys with the
+        # worker thread's puts — see ShmArena.write). A pinned map must
+        # not be unmapped by reclaim/abandon racing the copy.
+        self.pins = 0
 
     def space_at_head(self) -> Tuple[int, int]:
         """(contiguous bytes at head, gap-to-end if a wrap would be needed)."""
@@ -317,7 +328,7 @@ class ShmArena:
         # `still` (its bytes aren't reusable yet) — keep it for next pass.
         self._pending = [r for r in still]
         for g, gf in list(self._gens.items()):
-            if g != self._gen and gf.live == 0 and not any(
+            if g != self._gen and gf.live == 0 and gf.pins == 0 and not any(
                 r.gen == g for r in self._pending
             ):
                 gf.close()
@@ -385,15 +396,33 @@ class ShmArena:
                 if off >= 0:
                     gen = self._gen
                     gf = self._gens[gen]
+                    # Reserve the region under the lock, COPY OUTSIDE it:
+                    # the pipelined bridge runs an encoder thread whose
+                    # multi-MB frame memcpys must overlap the worker
+                    # thread's own puts, not serialize them behind the
+                    # arena lock. Safe because nothing reads the region
+                    # until the caller publishes its header (after this
+                    # returns), reclaim cannot free it before its acks
+                    # arrive, the ``copying`` flag keeps an epoch-bump
+                    # abandon from freeing (and re-allocating) the bytes
+                    # mid-copy, and the pin keeps the mmap itself alive.
+                    region = _Region(gen, off, size, ack_key, readers)
+                    region.copying = True
+                    self._pending.append(region)
+                    gf.pins += 1
+            if off >= 0:
+                try:
                     t_copy = time.perf_counter()
                     gf.mm[off : off + len(data)] = data
                     metrics.observe(
                         "cgx.shm.put_copy_s", time.perf_counter() - t_copy
                     )
-                    self._pending.append(
-                        _Region(gen, off, size, ack_key, readers)
-                    )
-                    return gen, off, len(data)
+                finally:
+                    with self._lock:
+                        gf.pins -= 1
+                        region.copying = False
+                return gen, off, len(data)
+            with self._lock:
                 stalled = next(
                     (r for r in self._pending if not r.freed and r.ack_key),
                     None,
@@ -436,6 +465,14 @@ class ShmArena:
             n = 0
             drop: List[str] = []
             for r in self._pending:
+                if r.copying:
+                    # A writer thread is mid-memcpy into these bytes
+                    # (ShmArena.write's out-of-lock copy): freeing them
+                    # now would let a post-recovery put re-allocate the
+                    # range and interleave the two copies. Leave the
+                    # region pending — the next reclaim/abandon drains it
+                    # once the copy finishes.
+                    continue
                 if not r.freed:
                     r.freed = True
                     n += 1
@@ -450,9 +487,15 @@ class ShmArena:
 
     def close(self) -> None:
         with self._lock:
-            for gf in self._gens.values():
+            for g, gf in list(self._gens.items()):
+                if gf.pins:
+                    # A copy is in flight on another thread (pipelined
+                    # encoder at shutdown): unmapping under it would
+                    # fault. Leave the map; the dead-arena reaper unlinks
+                    # the file once the owning process exits.
+                    continue
                 gf.close()
-            self._gens.clear()
+                del self._gens[g]
             self._pending.clear()
 
 
