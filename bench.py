@@ -831,6 +831,173 @@ def bench_schedule(mb: int = 32, ws: int = 4, iters: int = 4,
     }
 
 
+# ---------------------------------------------------------------------------
+# Unified wire plane (ISSUE 10): each routed edge's collective raw vs
+# compressed on the same payload — ring-attention/pipeline ppermute hops and
+# the MoE dispatch all_to_all through wire.dispatch, with a bit-equality
+# pre-flight on the unconfigured edge (it must lower to the plain lax
+# collective) and a quantization-envelope allclose on the compressed one.
+# Runs on real chips when >= ws exist, else a forced CPU multi-device
+# platform (records then key into the `@cpu` trajectories).
+# ---------------------------------------------------------------------------
+
+
+def _wire_child(mb: int, ws: int, bits: int, iters: int) -> None:
+    """Child: per-edge raw-vs-compressed timings; one JSON line."""
+    import re as _re
+
+    from torch_cgx_tpu.config import CompressionConfig
+    from torch_cgx_tpu.wire import EdgeConfig
+    from torch_cgx_tpu.wire import dispatch as wire_dispatch
+    from torch_cgx_tpu.wire import edges as wire_edges
+
+    n = mb * 2**20 // 4  # per-device fp32 elements
+    mesh = Mesh(np.asarray(jax.devices()[:ws]), ("d",))
+    perm = [(i, (i + 1) % ws) for i in range(ws)]
+    cc = CompressionConfig(bits=bits, bucket_size=BUCKET)
+    rng = np.random.default_rng(0)
+
+    def timed(fn, x):
+        def sync(o):
+            np.asarray(jax.device_get(jax.tree.leaves(o)[0].ravel()[:1]))
+
+        for _ in range(2):
+            sync(fn(x))
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn(x)
+        sync(out)
+        return (time.perf_counter() - t0) / iters
+
+    out = {
+        "backend": jax.default_backend(),
+        "chip": jax.devices()[0].device_kind,
+        "edges": {},
+    }
+
+    def measure(kind, name, edge_fn, plain_fn, payload, specs):
+        shard = dict(mesh=mesh, in_specs=specs, out_specs=specs,
+                     check_vma=False)
+        f_raw = jax.jit(shard_map(edge_fn, **shard))
+        f_plain = jax.jit(shard_map(plain_fn, **shard))
+        r_raw, r_plain = np.asarray(f_raw(payload)), np.asarray(f_plain(payload))
+        if not (r_raw == r_plain).all():
+            raise AssertionError(
+                f"wire bench pre-flight: unconfigured {kind} edge is not "
+                "bit-equal to the plain collective"
+            )
+        wire_edges.set_edge_config(
+            kind, "^" + _re.escape(name) + "$", EdgeConfig(cc=cc)
+        )
+        f_comp = jax.jit(shard_map(edge_fn, **shard))  # fresh trace
+        r_comp = np.asarray(f_comp(payload))
+        envelope = 2.0 * float(np.abs(payload).max()) / (2**bits - 1)
+        if not np.allclose(r_comp, r_raw, atol=envelope):
+            raise AssertionError(
+                f"wire bench pre-flight: {kind} compressed result outside "
+                f"the {bits}-bit envelope "
+                f"(max diff {np.abs(r_comp - r_raw).max():.3g} > {envelope:.3g})"
+            )
+        out["edges"][kind] = {
+            "t_raw_ms": timed(f_raw, payload) * 1e3,
+            "t_compressed_ms": timed(f_comp, payload) * 1e3,
+            "max_abs_diff": float(np.abs(r_comp - r_raw).max()),
+            "envelope": envelope,
+        }
+
+    per = _xla_payload(n, ws)  # (ws, n), one row per device
+    for kind, name in (("ring_kv", "bench.kv"), ("pp_act", "bench.act")):
+        measure(
+            kind, name,
+            lambda xs, k=kind, nm=name: wire_dispatch.wire_ppermute(
+                xs, "d", perm, kind=k, name=nm
+            ),
+            lambda xs: lax.ppermute(xs, "d", perm),
+            per, P("d"),
+        )
+    # MoE dispatch buffer (E, C, D), E % ws == 0, replicated input: the
+    # all_to_all splits the expert dim locally like the EP helpers do.
+    e_dim, cap = ws * 4, 64
+    d_model = max(32, n // (e_dim * cap))
+    buf = rng.normal(size=(e_dim, cap, d_model)).astype(np.float32)
+    measure(
+        "moe_a2a", "bench.a2a",
+        lambda t: wire_dispatch.wire_all_to_all(
+            t, "d", split_axis=0, concat_axis=1, kind="moe_a2a",
+            name="bench.a2a",
+        ),
+        lambda t: lax.all_to_all(
+            t, "d", split_axis=0, concat_axis=1, tiled=True
+        ),
+        buf, P(),
+    )
+    print(json.dumps(out))
+
+
+def bench_wire(mb: int = 8, ws: int = 4, bits: int = 4,
+               iters: int = 5) -> list:
+    """Per-edge compressed-vs-raw records for the unified wire plane (the
+    ISSUE 10 acceptance bench): one BENCH_LOG row per edge kind, each
+    carrying the pre-flight evidence (unconfigured edge bit-equal to the
+    plain collective; compressed within the quantization envelope)."""
+    env = {
+        **os.environ,
+        "CGX_WIRE": "on",
+        "CGX_COMPRESSION_BUCKET_SIZE": str(BUCKET),
+    }
+    use_real = False
+    try:
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import json, jax; print(json.dumps("
+             "[jax.default_backend(), len(jax.devices())]))"],
+            env=dict(env), capture_output=True, text=True, timeout=180,
+        )
+        backend, n_dev = json.loads(
+            (probe.stdout.strip().splitlines() or ["[]"])[-1]
+        )
+        use_real = backend != "cpu" and n_dev >= ws
+    except Exception:
+        pass
+    if not use_real:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={ws}"
+        )
+    me = str(Path(__file__).resolve())
+    child = _run_json_child(
+        [sys.executable, me, "--wire-child",
+         str(mb), str(ws), str(bits), str(iters)], env,
+    )
+    gbytes = mb * 2**20 / 1e9
+    results = []
+    for kind, d in child["edges"].items():
+        t_r, t_c = d["t_raw_ms"], d["t_compressed_ms"]
+        results.append({
+            "metric": f"wire_{kind}_compressed_vs_raw_{bits}bit_{mb}MB_x{ws}",
+            "value": round(gbytes / (t_c / 1e3), 3),
+            "unit": "GB/s",
+            "vs_baseline": round(t_r / t_c, 3),
+            "chip": child.get("chip", "unknown"),
+            "backend": child.get("backend", "unknown"),
+            "detail": {
+                "t_raw_ms": round(t_r, 3),
+                "t_compressed_ms": round(t_c, 3),
+                "ws": ws,
+                "payload_MB": mb,
+                "bits": bits,
+                "iters": iters,
+                "preflight": (
+                    "raw edge bit-equal to plain collective; compressed "
+                    f"max|diff| {d['max_abs_diff']:.3g} within envelope "
+                    f"{d['envelope']:.3g}"
+                ),
+            },
+        })
+    return results
+
+
 def _device_watchdog(seconds: float = 300.0):
     """Backend init can hang indefinitely when the device transport is
     wedged (observed: a dead client's claim blocking the service). Emit a
@@ -985,6 +1152,31 @@ def main() -> None:
             int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]), argv[5]
         )
         return
+    if argv and argv[0] == "--wire-child":
+        _wire_child(int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]))
+        return
+    if argv and argv[0] == "--wire":
+        # Per-edge wire-plane records (tools/hw_session.sh queues this):
+        # the child is a fresh subprocess (real chips when available, a
+        # forced CPU multi-device platform otherwise).
+        _preflight_lint()
+        kw = {}
+        for flag, name in (("--mb", "mb"), ("--ws", "ws"),
+                           ("--bits", "bits"), ("--iters", "iters")):
+            if flag in argv:
+                idx = argv.index(flag) + 1
+                val = argv[idx] if idx < len(argv) else ""
+                try:
+                    kw[name] = int(val)
+                except ValueError:
+                    sys.exit(
+                        f"bench: {flag} requires an integer value, "
+                        f"got {val!r}"
+                    )
+        results = bench_wire(**kw)
+        rc = _gate_and_log(results)
+        print(json.dumps(results))
+        sys.exit(rc)
     if argv and argv[0] == "--schedule":
         # Pipelined-vs-monolithic schedule record (tools/hw_session.sh
         # queues this): bridge children are fresh CPU-pinned process

@@ -603,3 +603,86 @@ def test_linter_allows_bounded_result_and_unscoped_functions(tmp_path):
     )
     proc = _run_lint(ok)
     assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_flags_direct_collective_in_wire_edge_modules(tmp_path):
+    # The wire-plane routing gate (ISSUE 10 satellite): a bare
+    # lax.ppermute payload send inside parallel/ring_attention.py bypasses
+    # the edge dispatcher — raw bytes no matter what the operator
+    # configured, invisible to cgx.wire.* accounting. Lint failure.
+    pdir = tmp_path / "torch_cgx_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    bad = pdir / "ring_attention.py"
+    bad.write_text(
+        "from jax import lax\n"
+        "def hop(kv, axis_name, perm):\n"
+        "    return lax.ppermute(kv, axis_name, perm)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "bypasses the wire dispatcher" in proc.stdout
+
+
+def test_linter_flags_direct_all_to_all_in_moe(tmp_path):
+    pdir = tmp_path / "torch_cgx_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    bad = pdir / "moe.py"
+    bad.write_text(
+        "from jax import lax\n"
+        "def dispatch(t, axis_name):\n"
+        "    return lax.all_to_all(t, axis_name, 0, 1, tiled=True)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "all_to_all" in proc.stdout and "wire" in proc.stdout
+
+
+def test_linter_wire_routing_allowlist_and_scope(tmp_path):
+    # Control-tensor sends live in allowlisted functions
+    # (_rotate_control), and modules outside the edge set (reducers.py —
+    # the dispatcher's own implementation layer) stay unconstrained.
+    pdir = tmp_path / "torch_cgx_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    ok = pdir / "pipeline.py"
+    ok.write_text(
+        "from jax import lax\n"
+        "from ..wire import dispatch as wire_dispatch\n"
+        "def _rotate_control(t, axis_name, perm):\n"
+        "    return lax.ppermute(t, axis_name, perm)\n"
+        "def _hop(y, axis_name, perm):\n"
+        "    return wire_dispatch.wire_ppermute(\n"
+        "        y, axis_name, perm, kind='pp_act', name='x')\n"
+    )
+    other = pdir / "reducers.py"
+    other.write_text(
+        "from jax import lax\n"
+        "def raw_hop(x, axis_name, perm):\n"
+        "    return lax.ppermute(x, axis_name, perm)\n"
+    )
+    proc = _run_lint(ok, other)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_wire_metric_subnamespace(tmp_path):
+    # cgx.wire.* joined the documented families with the unified wire
+    # plane — the namespace rule must accept it (and still reject typos).
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    ok = ldir / "mod.py"
+    ok.write_text(
+        "from .utils.logging import metrics\n"
+        "def note(kind):\n"
+        "    metrics.add(f'cgx.wire.bytes_raw.{kind}', 4.0)\n"
+        "    metrics.add('cgx.wire.edges_compressed')\n"
+    )
+    bad = ldir / "typo.py"
+    bad.write_text(
+        "from .utils.logging import metrics\n"
+        "def note():\n"
+        "    metrics.add('cgx.wier.edges_compressed')\n"
+    )
+    proc_ok = _run_lint(ok)
+    assert proc_ok.returncode == 0, proc_ok.stdout
+    proc_bad = _run_lint(bad)
+    assert proc_bad.returncode == 1
+    assert "wier" in proc_bad.stdout

@@ -1,0 +1,859 @@
+"""Unified wire plane (ISSUE 10): per-edge registry, dispatcher,
+closed-loop controller, knob-off inertness, and the end-to-end
+MoE + ring-attention + pipelined acceptance runs."""
+
+import dataclasses
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import torch_cgx_tpu as cgx
+from torch_cgx_tpu import CompressionConfig
+from torch_cgx_tpu.parallel.moe import ep_combine, ep_dispatch
+from torch_cgx_tpu.parallel.pipeline import (
+    merge_microbatches,
+    spmd_pipeline,
+    split_microbatches,
+    stack_stage_params,
+)
+from torch_cgx_tpu.parallel.ring_attention import ring_attention
+from torch_cgx_tpu.utils.compat import shard_map
+from torch_cgx_tpu.utils.logging import metrics
+from torch_cgx_tpu.wire import (
+    EdgeConfig,
+    WireController,
+    dispatch as wdisp,
+    edges as wedges,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_wire_state():
+    wedges.clear_edges()
+    wedges.reset_edge_state("test setup")
+    metrics.reset()
+    yield
+    wedges.clear_edges()
+    wedges.reset_edge_state("test teardown")
+
+
+def _mesh(n, name="d"):
+    return Mesh(np.asarray(jax.devices()[:n]), (name,))
+
+
+def _ring_perm(ws):
+    return [(i, (i + 1) % ws) for i in range(ws)]
+
+
+# ---------------------------------------------------------------------------
+# Edge registry.
+# ---------------------------------------------------------------------------
+
+
+def test_registry_later_registration_wins_and_version_bumps():
+    v0 = cgx.config.registry_version()
+    wedges.set_edge_config("ring_kv", ".*", EdgeConfig(cc=CompressionConfig(bits=8)))
+    wedges.set_edge_config(
+        "ring_kv", "^special$", EdgeConfig(cc=CompressionConfig(bits=2))
+    )
+    assert cgx.config.registry_version() > v0
+    assert wedges.resolve_edge("ring_kv", "other").cc.bits == 8
+    assert wedges.resolve_edge("ring_kv", "special").cc.bits == 2
+    # unregistered kind resolves to nothing
+    assert wedges.resolve_edge("pp_act", "special") is None
+
+
+def test_registry_env_default_bits_cover_non_dp_edges(monkeypatch):
+    assert wedges.resolve_edge("moe_a2a", "x") is None
+    monkeypatch.setenv("CGX_WIRE_BITS", "6")
+    ec = wedges.resolve_edge("moe_a2a", "x")
+    assert ec is not None and ec.cc.bits == 6
+    # dp_grad keeps its own env default (CGX_COMPRESSION_QUANTIZATION_BITS)
+    assert wedges.resolve_edge("dp_grad", "layer/kernel") is None
+
+
+def test_registry_backfills_env_defaults(monkeypatch):
+    monkeypatch.setenv("CGX_COMPRESSION_BUCKET_SIZE", "128")
+    wedges.set_edge_config(
+        "pp_act", ".*", EdgeConfig(cc=CompressionConfig(bits=4, bucket_size=0))
+    )
+    assert wedges.resolve_edge("pp_act", "pipeline.act").cc.bucket_size == 128
+
+
+def test_registry_validation():
+    with pytest.raises(ValueError):
+        wedges.set_edge_config("not_a_kind", ".*", EdgeConfig())
+    with pytest.raises(ValueError):
+        EdgeConfig(compressor="zstd")
+    with pytest.raises(ValueError):
+        EdgeConfig(ratio=1.5)
+    with pytest.raises(TypeError):
+        wedges.set_edge_config("pp_act", ".*", CompressionConfig(bits=4))
+
+
+def test_dp_grad_edge_wins_over_pattern_registry(monkeypatch):
+    from torch_cgx_tpu.parallel.allreduce import resolve_leaf_config
+
+    leaf = jnp.zeros((64, 64), jnp.float32)
+    cgx.set_layer_pattern_config(".*kernel.*", CompressionConfig(bits=8))
+    assert resolve_leaf_config("h0/kernel", leaf).bits == 8
+    wedges.set_edge_config(
+        "dp_grad", ".*kernel.*", EdgeConfig(cc=CompressionConfig(bits=3))
+    )
+    # dp_grad edges obey the same CGX_WIRE gate as every other kind:
+    # disengaged (unset on CPU / off), the entry is inert and the legacy
+    # pattern registry still answers — the knob can bisect.
+    assert resolve_leaf_config("h0/kernel", leaf).bits == 8
+    monkeypatch.setenv("CGX_WIRE", "off")
+    assert resolve_leaf_config("h0/kernel", leaf).bits == 8
+    monkeypatch.setenv("CGX_WIRE", "on")
+    assert resolve_leaf_config("h0/kernel", leaf).bits == 3
+    # non-matching leaves fall through to the pattern registry / default
+    assert resolve_leaf_config("h0/bias_matrix", leaf).bits == 32
+
+
+# ---------------------------------------------------------------------------
+# Knob-off inertness: with CGX_WIRE unset (conftest clears env) and the
+# registry empty, every routed call site lowers to the plain collective.
+# ---------------------------------------------------------------------------
+
+
+def test_unset_wire_ppermute_bit_identical():
+    ws = 4
+    mesh = _mesh(ws)
+    perm = _ring_perm(ws)
+    x = np.random.default_rng(0).normal(size=(ws, 256)).astype(np.float32)
+
+    def via_wire(xs):
+        return wdisp.wire_ppermute(xs, "d", perm, kind="ring_kv", name="t")
+
+    def plain(xs):
+        return lax.ppermute(xs, "d", perm)
+
+    sh = dict(mesh=mesh, in_specs=P("d"), out_specs=P("d"), check_vma=False)
+    got = jax.jit(shard_map(via_wire, **sh))(x)
+    want = jax.jit(shard_map(plain, **sh))(x)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def _ring_jaxpr():
+    ws = 2
+    mesh = _mesh(ws)
+    q = jnp.ones((1, 2, 4, 4), jnp.float32)
+
+    def body(qq):
+        return ring_attention(qq, qq, qq, axis_name="d")
+
+    return str(
+        jax.make_jaxpr(
+            shard_map(
+                body, mesh=mesh, in_specs=P(None, None, "d"),
+                out_specs=P(None, None, "d"), check_vma=False,
+            )
+        )(q)
+    )
+
+
+def _pipeline_jaxpr():
+    ws = 4
+    mesh = _mesh(ws, "pp")
+    stages = [
+        {"w": jnp.eye(8, dtype=jnp.float32)} for _ in range(ws)
+    ]
+    stacked = stack_stage_params(stages)
+    x = jnp.ones((8, 8), jnp.float32)
+
+    def run(stacked_local, xfull):
+        micro = split_microbatches(xfull, 4)
+        out = spmd_pipeline(
+            lambda p, t: jnp.tanh(t @ p["w"]), stacked_local, micro,
+            axis_name="pp", n_stages=ws,
+        )
+        return merge_microbatches(out)
+
+    return str(
+        jax.make_jaxpr(
+            shard_map(
+                run, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+                check_vma=False,
+            )
+        )(stacked, x)
+    )
+
+
+def _moe_jaxpr():
+    ws = 2
+    mesh = _mesh(ws)
+    buf = jnp.ones((4, 8, 16), jnp.float32)
+
+    def run(t):
+        return ep_combine(ep_dispatch(t, "d"), "d")
+
+    return str(
+        jax.make_jaxpr(
+            shard_map(run, mesh=mesh, in_specs=P(), out_specs=P(),
+                      check_vma=False)
+        )(buf)
+    )
+
+
+@pytest.mark.parametrize(
+    "jaxpr_fn", [_ring_jaxpr, _pipeline_jaxpr, _moe_jaxpr],
+    ids=["ring", "pipeline", "moe"],
+)
+def test_staged_programs_pinned_with_knob_unset(jaxpr_fn, monkeypatch):
+    """unset == off (the knob is the only gate), and flipping it on with a
+    registered edge genuinely changes the staged program — proof the
+    unset path stages zero wire machinery."""
+    unset = jaxpr_fn()
+    monkeypatch.setenv("CGX_WIRE", "off")
+    assert jaxpr_fn() == unset
+    monkeypatch.setenv("CGX_WIRE", "on")
+    for kind in ("ring_kv", "pp_act", "moe_a2a"):
+        wedges.set_edge_config(kind, ".*", EdgeConfig(cc=CompressionConfig(bits=4)))
+    engaged = jaxpr_fn()
+    assert engaged != unset
+    # zero host callbacks inside the compressed staged program
+    assert "callback" not in engaged
+
+
+# ---------------------------------------------------------------------------
+# Dispatcher mechanics.
+# ---------------------------------------------------------------------------
+
+
+def test_quantized_ppermute_edge_within_envelope(monkeypatch):
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws, n, bits = 4, 1024, 8
+    mesh = _mesh(ws)
+    perm = _ring_perm(ws)
+    wedges.set_edge_config("ring_kv", ".*", EdgeConfig(cc=CompressionConfig(bits=bits)))
+    x = np.random.default_rng(1).normal(size=(ws, n)).astype(np.float32)
+
+    def via_wire(xs):
+        return wdisp.wire_ppermute(xs, "d", perm, kind="ring_kv", name="t")
+
+    sh = dict(mesh=mesh, in_specs=P("d"), out_specs=P("d"), check_vma=False)
+    got = np.asarray(jax.jit(shard_map(via_wire, **sh))(x))
+    want = np.asarray(
+        jax.jit(shard_map(lambda xs: lax.ppermute(xs, "d", perm), **sh))(x)
+    )
+    env = 2.0 * np.abs(x).max() / (2**bits - 1)
+    assert not np.array_equal(got, want)
+    np.testing.assert_allclose(got, want, atol=env)
+    snap = metrics.snapshot("cgx.wire.")
+    assert snap.get("cgx.wire.edges_compressed", 0) >= 1
+    assert snap.get("cgx.wire.bytes_raw.ring_kv", 0) > 0
+    assert 0 < snap["cgx.wire.bytes_wire.ring_kv"] < snap["cgx.wire.bytes_raw.ring_kv"]
+
+
+def test_edge_error_feedback_residual_mechanics(monkeypatch):
+    """EF residual = payload - own wire decode, and carrying it into the
+    next hop corrects the quantization bias (mean of repeated hops
+    approaches the true value)."""
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws, n, bits = 2, 512, 2
+    mesh = _mesh(ws)
+    perm = _ring_perm(ws)
+    wedges.set_edge_config(
+        "pp_act", ".*",
+        EdgeConfig(cc=CompressionConfig(bits=bits), error_feedback=True),
+    )
+    x = np.random.default_rng(2).normal(size=(ws, n)).astype(np.float32)
+
+    def hop_ef(xs, e):
+        return wdisp.wire_ppermute(
+            xs, "d", perm, kind="pp_act", name="t", ef=e
+        )
+
+    sh = dict(
+        mesh=mesh, in_specs=(P("d"), P("d")), out_specs=(P("d"), P("d")),
+        check_vma=False,
+    )
+    f = jax.jit(shard_map(hop_ef, **sh))
+    out, e1 = f(x, np.zeros_like(x))
+    # residual == x - decode(quantize(x)): reconstruct from the hop output
+    # (the ring shifted device r's decode to device r+1).
+    rt = np.roll(np.asarray(out), -1, axis=0)
+    np.testing.assert_allclose(np.asarray(e1), x - rt, atol=1e-6)
+    assert float(np.abs(np.asarray(e1)).max()) > 0  # 2-bit really lossy
+    # EF accumulates: sending the SAME payload repeatedly with the carried
+    # residual makes the time-average of the decodes approach x.
+    e = np.zeros_like(x)
+    acc = np.zeros_like(x)
+    steps = 24
+    for _ in range(steps):
+        out, e = f(x, e)
+        acc += np.roll(np.asarray(out), -1, axis=0)
+    ef_err = np.abs(acc / steps - x).max()
+    one_shot = np.abs(rt - x).max()
+    assert ef_err < one_shot * 0.35, (ef_err, one_shot)
+
+
+def test_raw_edge_passes_ef_through():
+    ws = 2
+    mesh = _mesh(ws)
+    perm = _ring_perm(ws)
+    x = np.random.default_rng(3).normal(size=(ws, 64)).astype(np.float32)
+    e0 = np.random.default_rng(4).normal(size=(ws, 64)).astype(np.float32)
+
+    def hop_ef(xs, e):
+        return wdisp.wire_ppermute(xs, "d", perm, kind="pp_act", name="t", ef=e)
+
+    f = jax.jit(shard_map(
+        hop_ef, mesh=mesh, in_specs=(P("d"), P("d")),
+        out_specs=(P("d"), P("d")), check_vma=False,
+    ))
+    out, e1 = f(x, e0)
+    np.testing.assert_array_equal(np.asarray(e1), e0)
+
+
+def test_powersgd_and_topk_peer_compressors(monkeypatch):
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws = 2
+    mesh = _mesh(ws)
+    perm = _ring_perm(ws)
+    rng = np.random.default_rng(5)
+    # low-rank payload: rank-2 matrix + small noise -> rank-8 factors
+    # reconstruct it nearly exactly on the receiving device.
+    base = rng.normal(size=(ws, 64, 2)) @ rng.normal(size=(ws, 2, 32))
+    x = (base + 0.01 * rng.normal(size=base.shape)).astype(np.float32)
+    wedges.set_edge_config(
+        "pp_act", "^lowrank$", EdgeConfig(compressor="powersgd", rank=8)
+    )
+    wedges.set_edge_config(
+        "pp_act", "^sparse$", EdgeConfig(compressor="topk", ratio=0.25)
+    )
+
+    def hop(xs, name):
+        return wdisp.wire_ppermute(xs, "d", perm, kind="pp_act", name=name)
+
+    sh = dict(mesh=mesh, in_specs=P("d"), out_specs=P("d"), check_vma=False)
+    got = np.asarray(jax.jit(shard_map(lambda t: hop(t, "lowrank"), **sh))(x))
+    want = np.asarray(
+        jax.jit(shard_map(lambda t: lax.ppermute(t, "d", perm), **sh))(x)
+    )
+    rel = np.linalg.norm(got - want) / np.linalg.norm(want)
+    assert rel < 0.05, rel
+
+    got_tk = np.asarray(jax.jit(shard_map(lambda t: hop(t, "sparse"), **sh))(x))
+    nz = np.abs(got_tk.reshape(ws, -1)) > 0
+    assert abs(nz.mean() - 0.25) < 0.02  # exactly the top quarter ships
+    # shipped coordinates carry exact values
+    mask = np.abs(got_tk) > 0
+    np.testing.assert_allclose(got_tk[mask], want[mask], rtol=1e-6)
+    # gradient flows straight-through for both
+    def loss(t):
+        return jnp.sum(hop(t, "lowrank") ** 2)
+
+    g = jax.jit(shard_map(jax.grad(loss), **sh))(x)
+    assert np.isfinite(np.asarray(g)).all()
+
+
+def test_a2a_rejects_p2p_only_compressors(monkeypatch):
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws = 2
+    mesh = _mesh(ws)
+    wedges.set_edge_config(
+        "moe_a2a", ".*", EdgeConfig(compressor="topk", ratio=0.1)
+    )
+    buf = jnp.ones((4, 8, 32), jnp.float32)
+
+    def run(t):
+        return ep_dispatch(t, "d")
+
+    with pytest.raises(ValueError, match="p2p-only"):
+        jax.jit(shard_map(
+            run, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+        ))(buf)
+
+
+def test_a2a_raw_fallbacks_are_unaccounted(monkeypatch):
+    """Every case where the quantized reshard lowers to (or fails like)
+    the plain all_to_all must record NO cgx.wire accounting — counters
+    claiming compression for raw bytes would mislead cgx_top/cgx_report
+    and feed the controller a width that was never used."""
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws = 4
+    mesh = _mesh(ws)
+    wedges.set_edge_config("moe_a2a", ".*", EdgeConfig(cc=CompressionConfig(bits=4)))
+
+    def run(t, split=0, concat=1):
+        return wdisp.wire_all_to_all(
+            t, "d", split_axis=split, concat_axis=concat,
+            kind="moe_a2a", name="m",
+        )
+
+    sh = dict(mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False)
+    # (a) indivisible split axis: the dispatcher classifies the edge RAW
+    # before any accounting, and the failure (lax.all_to_all requires
+    # divisibility) is exactly the pre-wire one.
+    bad = np.random.default_rng(8).normal(size=(6, 8, 32)).astype(np.float32)
+    with pytest.raises(Exception):
+        jax.jit(shard_map(run, **sh))(bad)
+    assert metrics.snapshot("cgx.wire.").get("cgx.wire.bytes_wire.moe_a2a", 0) == 0
+    assert "wire:moe_a2a:m" not in wdisp.edge_info()
+    # (b) payload below the minimal-size floor: raw, bit-equal, unaccounted.
+    monkeypatch.setenv("CGX_COMPRESSION_MINIMAL_SIZE", "100000")
+    ok = np.random.default_rng(9).normal(size=(8, 8, 32)).astype(np.float32)
+    got = np.asarray(jax.jit(shard_map(run, **sh))(ok))
+    want = np.asarray(jax.jit(shard_map(
+        lambda t: lax.all_to_all(t, "d", split_axis=0, concat_axis=1,
+                                 tiled=True), **sh,
+    ))(ok))
+    np.testing.assert_array_equal(got, want)
+    assert metrics.snapshot("cgx.wire.").get("cgx.wire.bytes_wire.moe_a2a", 0) == 0
+    assert "wire:moe_a2a:m" not in wdisp.edge_info()
+
+
+def test_factor_edge_rejects_p2p_only_compressors(monkeypatch):
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws = 2
+    mesh = _mesh(ws)
+    wedges.set_edge_config(
+        "powersgd_factor", ".*", EdgeConfig(compressor="topk", ratio=0.1)
+    )
+    x = jnp.ones((32, 4), jnp.float32)
+
+    def run(t):
+        return wdisp.wire_factor_allreduce(t, ("d",), mesh, name="p")
+
+    with pytest.raises(ValueError, match="p2p-only"):
+        jax.jit(shard_map(
+            run, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+        ))(x)
+
+
+def test_moe_ep_dispatch_combine_roundtrip(monkeypatch):
+    monkeypatch.setenv("CGX_WIRE", "on")
+    ws = 4
+    mesh = _mesh(ws)
+    rng = np.random.default_rng(6)
+    buf = rng.normal(size=(8, 16, 32)).astype(np.float32)
+    wedges.set_edge_config("moe_a2a", ".*", EdgeConfig(cc=CompressionConfig(bits=8)))
+
+    def run(t):
+        return ep_combine(ep_dispatch(t, "d"), "d")
+
+    got = np.asarray(jax.jit(shard_map(
+        run, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+    ))(buf))
+    env = 2 * (2.0 * np.abs(buf).max() / (2**8 - 1))  # two quantized hops
+    np.testing.assert_allclose(got, buf, atol=env)
+    with pytest.raises(ValueError, match="not divisible"):
+        jax.jit(shard_map(
+            lambda t: ep_dispatch(t, "d"), mesh=mesh, in_specs=P(),
+            out_specs=P(), check_vma=False,
+        ))(jnp.ones((6, 4, 32), jnp.float32))
+
+
+def test_powersgd_factor_edge(monkeypatch):
+    """The powersgd_factor edge quantizes the P/Q factor allreduce; the
+    transform's output stays close to the exact-psum run and replicas
+    stay identical (error symmetry of the quantized allreduce)."""
+    from torch_cgx_tpu.parallel.powersgd import (
+        init_powersgd, powersgd_transform,
+    )
+
+    ws = 4
+    mesh = _mesh(ws, "dp")
+    rng = np.random.default_rng(7)
+    grads = {"w": jnp.asarray(rng.normal(size=(32, 48)), jnp.float32)}
+    params = {"w": jnp.zeros((32, 48), jnp.float32)}
+
+    def run_once():
+        tx = powersgd_transform(mesh=mesh, axes=("dp",), rank=4,
+                                placement_warning=False)
+
+        def body(g):
+            st = init_powersgd(params, 4)
+            red, _ = tx.update(g, st)
+            return red["w"]
+
+        return np.asarray(jax.jit(shard_map(
+            body, mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False
+        ))({"w": grads["w"]}))
+
+    exact = run_once()
+    os.environ["CGX_WIRE"] = "on"
+    try:
+        wedges.set_edge_config(
+            "powersgd_factor", ".*", EdgeConfig(cc=CompressionConfig(bits=8))
+        )
+        quant = run_once()
+    finally:
+        os.environ.pop("CGX_WIRE", None)
+    assert not np.array_equal(exact, quant)
+    rel = np.linalg.norm(exact - quant) / np.linalg.norm(exact)
+    assert rel < 0.05, rel
+    snap = metrics.snapshot("cgx.wire.")
+    assert snap.get("cgx.wire.bytes_wire.powersgd_factor", 0) > 0
+
+
+# ---------------------------------------------------------------------------
+# Closed-loop controller.
+# ---------------------------------------------------------------------------
+
+
+def _seed_qerr(label, rel, n=8):
+    for _ in range(n):
+        metrics.observe(f"cgx.qerr.{label}", rel)
+
+
+def test_controller_reallocates_from_live_qerr(monkeypatch):
+    # Two edges at 4 bits, one 10x noisier: under an avg-bits budget the
+    # noisy one must end up wider than the quiet one.
+    monkeypatch.setenv("CGX_WIRE", "on")
+    wedges.set_edge_config(
+        "ring_kv", "^noisy$", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    wedges.set_edge_config(
+        "ring_kv", "^quiet$", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    wdisp._EDGE_INFO["wire:ring_kv:noisy"] = {"numel": 4096, "bits": 4}
+    wdisp._EDGE_INFO["wire:ring_kv:quiet"] = {"numel": 4096, "bits": 4}
+    _seed_qerr("wire:ring_kv:noisy", 0.2)
+    _seed_qerr("wire:ring_kv:quiet", 0.02)
+    v0 = cgx.config.registry_version()
+    ctl = WireController(avg_bits=4, every=0)
+    alloc = ctl.update()
+    assert set(alloc) == {"wire:ring_kv:noisy", "wire:ring_kv:quiet"}
+    assert alloc["wire:ring_kv:noisy"] > alloc["wire:ring_kv:quiet"]
+    # written back into the edge registry + version bumped (retrace)
+    assert (
+        wedges.resolve_edge("ring_kv", "noisy").cc.bits
+        == alloc["wire:ring_kv:noisy"]
+    )
+    assert cgx.config.registry_version() > v0
+    assert metrics.get("cgx.wire.controller_updates") == 1
+    assert metrics.get("cgx.wire.bits.wire:ring_kv:noisy") == float(
+        alloc["wire:ring_kv:noisy"]
+    )
+
+
+def test_controller_covers_dp_grad_layers():
+    from torch_cgx_tpu.parallel import allreduce
+
+    allreduce._QERR_INFO["h0/kernel"] = {"numel": 1 << 16, "bits": 4}
+    allreduce._QERR_INFO["h1/kernel"] = {"numel": 1 << 16, "bits": 4}
+    _seed_qerr("h0/kernel", 0.3)
+    _seed_qerr("h1/kernel", 0.03)
+    ctl = WireController(avg_bits=4, every=0)
+    alloc = ctl.update()
+    assert alloc["h0/kernel"] > alloc["h1/kernel"]
+    # dp layers land in the pattern registry (exact-path pattern)
+    assert cgx.config.resolve_pattern_config("h0/kernel").bits == alloc[
+        "h0/kernel"
+    ]
+
+
+def test_controller_cadence_and_idempotence():
+    wdisp._EDGE_INFO["wire:pp_act:t"] = {"numel": 1024, "bits": 4}
+    _seed_qerr("wire:pp_act:t", 0.1)
+    ctl = WireController(avg_bits=4, every=3)
+    assert ctl.step() is None
+    assert ctl.step() is None
+    alloc = ctl.step()
+    assert alloc  # fired on the 3rd call
+    v = cgx.config.registry_version()
+    assert ctl.step() is None
+    assert ctl.step() is None
+    ctl.step()
+    # identical telemetry -> identical allocation -> NO second registry
+    # bump (no retrace storm)
+    assert cgx.config.registry_version() == v
+    assert ctl.updates == 2
+
+
+def test_controller_ignores_unknown_and_sparse_labels():
+    _seed_qerr("wire:pp_act:unknown", 0.5)  # no side-table entry
+    wdisp._EDGE_INFO["wire:pp_act:thin"] = {"numel": 256, "bits": 4}
+    _seed_qerr("wire:pp_act:thin", 0.5, n=1)
+    ctl = WireController(avg_bits=4, every=0, min_observations=4)
+    assert ctl.update() == {}
+
+
+# ---------------------------------------------------------------------------
+# Reset / recovery wiring (satellite: stale post-recovery edge state).
+# ---------------------------------------------------------------------------
+
+
+def test_invalidate_trace_caches_resets_edge_state_not_configs():
+    from torch_cgx_tpu.robustness.supervisor import invalidate_trace_caches
+
+    wedges.set_edge_config("pp_act", ".*", EdgeConfig(cc=CompressionConfig(bits=4)))
+    wdisp._EDGE_INFO["wire:pp_act:t"] = {"numel": 1024, "bits": 4}
+    ctl = WireController(avg_bits=4, every=5)
+    ctl._count = 4
+    ctl.last_alloc = {"wire:pp_act:t": 4}
+    invalidate_trace_caches()
+    # derived state cleared...
+    assert wdisp.edge_info() == {}
+    assert ctl._count == 0 and ctl.last_alloc == {}
+    assert metrics.get("cgx.wire.state_resets") >= 1
+    # ...but the registered config survives (it is configuration)
+    assert wedges.resolve_edge("pp_act", "x").cc.bits == 4
+
+
+def test_reset_registries_clears_edges_too():
+    wedges.set_edge_config("pp_act", ".*", EdgeConfig(cc=CompressionConfig(bits=4)))
+    cgx.set_layer_pattern_config(".*", CompressionConfig(bits=4))
+    wdisp._EDGE_INFO["wire:pp_act:t"] = {"numel": 1024, "bits": 4}
+    cgx.reset_registries()
+    assert wedges.resolve_edge("pp_act", "x") is None
+    assert cgx.config.resolve_pattern_config("anything") is None
+    assert wdisp.edge_info() == {}
+
+
+# ---------------------------------------------------------------------------
+# End-to-end acceptance: MoE + ring-attention train step and a pipelined
+# train step on a CPU-forced multi-device mesh, CGX_WIRE=on, loss allclose
+# to the raw run at >= 4 bits, counters + controller observed, jaxpr
+# guards proving in-program compression with zero host callbacks.
+# ---------------------------------------------------------------------------
+
+B, S, D, H, E = 2, 8, 16, 2, 4  # batch, seq, model, heads, experts
+
+
+def _e2e_init(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "wq": jnp.asarray(rng.normal(size=(D, D)) / np.sqrt(D), jnp.float32),
+        "wkv": jnp.asarray(rng.normal(size=(D, 2 * D)) / np.sqrt(D), jnp.float32),
+        "experts": jnp.asarray(
+            rng.normal(size=(E, D, D)) / np.sqrt(D), jnp.float32
+        ),
+        "wo": jnp.asarray(rng.normal(size=(D, D)) / np.sqrt(D), jnp.float32),
+    }
+
+
+def _e2e_forward(p, x, experts_local, axis_name):
+    """Ring attention over the sequence axis + a fixed-dispatch expert
+    block whose all_to_alls ride the moe_a2a edge. x: (B, S_local, D)."""
+    b, s_local, d = x.shape
+    qkv_q = (x @ p["wq"]).reshape(b, s_local, H, d // H)
+    kv = (x @ p["wkv"]).reshape(b, s_local, 2, H, d // H)
+    q = jnp.moveaxis(qkv_q, 2, 1)  # (B, H, S_local, Dh)
+    k = jnp.moveaxis(kv[:, :, 0], 2, 1)
+    v = jnp.moveaxis(kv[:, :, 1], 2, 1)
+    attn = ring_attention(q, k, v, axis_name=axis_name, causal=True)
+    y = jnp.moveaxis(attn, 1, 2).reshape(b, s_local, d)
+    # MoE block: contiguous token groups -> experts (fixed routing keeps
+    # the test deterministic; the wire is what's under test).
+    t = b * s_local
+    exp_in = y.reshape(E, t // E, d)  # (E, C, D)
+    slots = ep_dispatch(exp_in, axis_name)  # (E/ws, ws*C, D)
+    h = jnp.tanh(jnp.einsum("ecd,edf->ecf", slots, experts_local))
+    exp_out = ep_combine(h, axis_name)  # (E, C, D)
+    out = exp_out.reshape(b, s_local, d) @ p["wo"]
+    return out
+
+
+def _e2e_train(n_steps=8, lr=0.05, seed=0):
+    ws = 2
+    mesh = _mesh(ws)
+    rng = np.random.default_rng(100 + seed)
+    x = rng.normal(size=(B, S, D)).astype(np.float32)
+    tgt = rng.normal(size=(B, S, D)).astype(np.float32) * 0.1
+    params = _e2e_init(seed)
+
+    def loss_fn(p, xb, tb):
+        out = _e2e_forward(
+            {k: v for k, v in p.items() if k != "experts"},
+            xb, p["experts"], "d",
+        )
+        return jnp.mean((out - tb) ** 2)
+
+    def step(p, xb, tb):
+        loss, g = jax.value_and_grad(loss_fn)(p, xb, tb)
+        g = jax.tree.map(lambda a: lax.pmean(a, "d"), g)
+        return lax.pmean(loss, "d"), g
+
+    sharded = jax.jit(shard_map(
+        step, mesh=mesh,
+        in_specs=(
+            {"wq": P(), "wkv": P(), "wo": P(), "experts": P("d")},
+            P(None, "d"), P(None, "d"),
+        ),
+        out_specs=(P(), {"wq": P(), "wkv": P(), "wo": P(), "experts": P("d")}),
+        check_vma=False,
+    ))
+    losses = []
+    for _ in range(n_steps):
+        loss, g = sharded(params, x, tgt)
+        losses.append(float(loss))
+        params = jax.tree.map(lambda a, b: a - lr * b, params, g)
+    jaxpr = str(jax.make_jaxpr(
+        shard_map(
+            step, mesh=mesh,
+            in_specs=(
+                {"wq": P(), "wkv": P(), "wo": P(), "experts": P("d")},
+                P(None, "d"), P(None, "d"),
+            ),
+            out_specs=(
+                P(), {"wq": P(), "wkv": P(), "wo": P(), "experts": P("d")}
+            ),
+            check_vma=False,
+        )
+    )(params, x, tgt))
+    return losses, jaxpr
+
+
+def test_e2e_moe_ring_wire_converges(monkeypatch):
+    raw_losses, raw_jaxpr = _e2e_train()
+    monkeypatch.setenv("CGX_WIRE", "on")
+    wedges.set_edge_config(
+        "ring_kv", ".*", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    wedges.set_edge_config(
+        "moe_a2a", ".*", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    wire_losses, wire_jaxpr = _e2e_train()
+    # converges, tracks the raw run at 4 bits
+    assert wire_losses[-1] < wire_losses[0] * 0.9
+    np.testing.assert_allclose(
+        wire_losses, raw_losses, rtol=0.1, atol=5e-4
+    )
+    # compression runs INSIDE the staged program, with zero host callbacks
+    assert wire_jaxpr != raw_jaxpr
+    assert "callback" not in wire_jaxpr
+    assert "callback" not in raw_jaxpr
+    # per-edge counters observed for both edge kinds
+    snap = metrics.snapshot("cgx.wire.")
+    for kind in ("ring_kv", "moe_a2a"):
+        assert snap.get(f"cgx.wire.bytes_wire.{kind}", 0) > 0, snap
+    info = wdisp.edge_info()
+    assert "wire:moe_a2a:moe.dispatch" in info
+    assert info["wire:ring_kv:ring_attention.k"]["bits"] == 4
+
+
+def test_e2e_qerr_stream_drives_controller(monkeypatch):
+    """CGX_QERR_STATS=1 + a wire-on step: the edges stream live relative-L2
+    into cgx.qerr.wire:*, and the controller's re-solve from THAT stream
+    re-allocates the registered edge widths (observability -> control)."""
+    monkeypatch.setenv("CGX_WIRE", "on")
+    monkeypatch.setenv("CGX_QERR_STATS", "1")
+    wedges.set_edge_config(
+        "ring_kv", ".*", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    wedges.set_edge_config(
+        "moe_a2a", ".*", EdgeConfig(cc=CompressionConfig(bits=4))
+    )
+    _e2e_train(n_steps=2)
+    qerr = {
+        k: v for k, v in metrics.snapshot("cgx.qerr.wire:").items()
+        if k.endswith(".count")
+    }
+    assert qerr, "wire edges did not stream qerr"
+    ctl = WireController(avg_bits=5, every=0)
+    alloc = ctl.update()
+    assert alloc, "controller found no edges in the live stream"
+    assert all(label.startswith("wire:") for label in alloc)
+    # the write-back landed in the registry at the solved widths
+    for label, bits in alloc.items():
+        _, kind, name = label.split(":", 2)
+        assert wedges.resolve_edge(kind, name).cc.bits == bits
+    assert metrics.get("cgx.wire.controller_updates") == 1
+
+
+def test_e2e_pipelined_step_wire(monkeypatch):
+    """Pipelined train step (GPipe-through-AD) with the pp_act edge at
+    8 bits: loss gradient allclose to the raw pipeline."""
+    ws, n_micro = 4, 4
+    mesh = _mesh(ws, "pp")
+    rng = np.random.default_rng(9)
+    d = 16
+    stages = [
+        {
+            "w": jnp.asarray(rng.normal(size=(d, d)) / np.sqrt(d), jnp.float32),
+            "b": jnp.zeros((d,), jnp.float32),
+        }
+        for _ in range(ws)
+    ]
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(rng.normal(size=(16, d)), jnp.float32)
+
+    def stage_fn(p, t):
+        return jnp.tanh(t @ p["w"] + p["b"])
+
+    def pipe_loss(stacked_p):
+        def run(stacked_local, xfull):
+            micro = split_microbatches(xfull, n_micro)
+            out = spmd_pipeline(
+                stage_fn, stacked_local, micro, axis_name="pp",
+                n_stages=ws,
+            )
+            return jnp.mean(merge_microbatches(out) ** 2)
+
+        return shard_map(
+            run, mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            check_vma=False,
+        )(stacked_p, x)
+
+    raw_loss, raw_g = jax.jit(jax.value_and_grad(pipe_loss))(stacked)
+    monkeypatch.setenv("CGX_WIRE", "on")
+    wedges.set_edge_config(
+        "pp_act", ".*", EdgeConfig(cc=CompressionConfig(bits=8))
+    )
+    wire_loss, wire_g = jax.jit(jax.value_and_grad(pipe_loss))(stacked)
+    np.testing.assert_allclose(
+        float(wire_loss), float(raw_loss), rtol=0.05
+    )
+    for a, b in zip(jax.tree.leaves(wire_g), jax.tree.leaves(raw_g)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=0.05
+        )
+    assert metrics.snapshot("cgx.wire.").get(
+        "cgx.wire.bytes_wire.pp_act", 0
+    ) > 0
+
+
+# ---------------------------------------------------------------------------
+# Tooling: cgx_report's == wire == section and cgx_top's edges column.
+# ---------------------------------------------------------------------------
+
+
+def _tool(name):
+    import importlib.util
+    import pathlib
+
+    p = pathlib.Path(__file__).resolve().parent.parent / "tools" / f"{name}.py"
+    spec = importlib.util.spec_from_file_location(name, p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_report_and_top_render_wire(tmp_path):
+    import json
+
+    counters = {
+        "cgx.wire.bytes_raw.moe_a2a": 8e6,
+        "cgx.wire.bytes_wire.moe_a2a": 1e6,
+        "cgx.wire.edges_compressed": 4,
+        "cgx.wire.controller_updates": 2,
+    }
+    gauges = {"cgx.wire.bits.wire:moe_a2a:moe.dispatch": 6.0}
+    (tmp_path / "metrics-rank0.jsonl").write_text(
+        json.dumps({"ts": 1.0, "counters": counters, "gauges": gauges,
+                    "histograms": {}}) + "\n"
+    )
+    (tmp_path / "flightrec-rank0.jsonl").write_text(
+        json.dumps({"kind": "dump", "metrics": {**counters, **gauges}}) + "\n"
+    )
+    report = _tool("cgx_report")
+    summary = report.summarize(report.load_dir(str(tmp_path)))
+    assert summary["wire"]["edges"]["moe_a2a"]["ratio"] == 8.0
+    assert summary["wire"]["controller_bits"][
+        "wire:moe_a2a:moe.dispatch"
+    ] == 6.0
+    text = report.render(summary)
+    assert "== wire" in text and "8.0x" in text and "controller bits" in text
+    top = _tool("cgx_top")
+    frame = top.render(str(tmp_path), {})
+    assert "edges" in frame and "moe:8.0x" in frame

@@ -67,7 +67,8 @@ def load_dir(directory: str) -> dict:
 def summarize(data: dict) -> dict:
     summary: dict = {"ranks": sorted(data["flight"]), "failures": [],
                      "faults": {}, "collectives": {}, "compression": {},
-                     "suspected_dead": [], "counters": {}, "recovery": {}}
+                     "suspected_dead": [], "counters": {}, "recovery": {},
+                     "wire": {}}
     recovery_events: List[dict] = []
     coll_time: Dict[str, float] = defaultdict(float)
     coll_n: Dict[str, int] = defaultdict(int)
@@ -211,6 +212,36 @@ def summarize(data: dict) -> dict:
             "evicted": sorted(evicted),
             "counters": rec_counters,
         }
+    # Unified wire plane: per-edge byte tallies (counters, summed across
+    # ranks) + the closed-loop controller's current bit gauges (taken as
+    # max-within-rank then max across ranks — a width is a level, not a
+    # tally, so summing would be nonsense).
+    edge_bytes: Dict[str, Dict[str, float]] = defaultdict(dict)
+    for k, v in totals.items():
+        if k.startswith("cgx.wire.bytes_raw."):
+            edge_bytes[k[len("cgx.wire.bytes_raw."):]]["raw_bytes"] = v
+        elif k.startswith("cgx.wire.bytes_wire."):
+            edge_bytes[k[len("cgx.wire.bytes_wire."):]]["wire_bytes"] = v
+    for kind, d in edge_bytes.items():
+        w = d.get("wire_bytes", 0.0)
+        d["ratio"] = round(d.get("raw_bytes", 0.0) / w, 3) if w else 0.0
+    ctl_bits: Dict[str, float] = {}
+    for per_rank in rank_counters.values():
+        for k, v in per_rank.items():
+            if k.startswith("cgx.wire.bits."):
+                label = k[len("cgx.wire.bits."):]
+                ctl_bits[label] = max(ctl_bits.get(label, 0.0), v)
+    wire_counters = {
+        k: v for k, v in totals.items()
+        if k.startswith("cgx.wire.")
+        and not k.startswith(("cgx.wire.bytes_", "cgx.wire.bits."))
+    }
+    if edge_bytes or ctl_bits or wire_counters:
+        summary["wire"] = {
+            "edges": dict(edge_bytes),
+            "controller_bits": ctl_bits,
+            "counters": wire_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -299,6 +330,28 @@ def render(summary: dict) -> str:
             parts.append(
                 _fmt_table(rows, ("rank", "phase", "gen", "detail", "step"))
             )
+    if summary.get("wire"):
+        w = summary["wire"]
+        parts.append("\n== wire (per-edge bytes, unified wire plane) ==")
+        rows = [
+            (
+                kind,
+                f"{d.get('raw_bytes', 0.0) / 1e6:.2f}",
+                f"{d.get('wire_bytes', 0.0) / 1e6:.2f}",
+                f"{d.get('ratio', 0.0):.1f}x",
+            )
+            for kind, d in sorted(w.get("edges", {}).items())
+        ]
+        if rows:
+            parts.append(
+                _fmt_table(rows, ("edge", "raw_MB", "wire_MB", "ratio"))
+            )
+        if w.get("controller_bits"):
+            parts.append("  controller bits:")
+            for label, b in sorted(w["controller_bits"].items()):
+                parts.append(f"    {label}: {int(b)}")
+        for k, v in sorted(w.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
     # cgx.recovery.* counters are NOT repeated here — the recovery
     # section above is their home.
     interesting = {

@@ -8,13 +8,16 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  overlap  sched$  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  straggler  gen  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
   step loop) fall back to the allreduce count delta.
 * **wire ratio** — ``bytes_in / wire_bytes_out`` over the SRA/Ring
   counters: the live compression ratio actually achieved on the wire.
+* **edges** — per-edge ratios of the unified wire plane
+  (``cgx.wire.bytes_{raw,wire}.<kind>``), e.g. ``moe:7.9x kv:7.9x`` —
+  which non-allreduce traffic classes are compressing and by how much.
 * **overlap** — ``cgx.sched.overlap_s / cgx.sched.wall_s``: the live
   share of pipelined-collective wall time hidden under concurrent
   encode compute (the schedule compiler's whole point — ROADMAP item 2;
@@ -189,6 +192,25 @@ def _wire_ratio(m: Dict[str, float]) -> str:
     return f"{bytes_in / out:.1f}x"
 
 
+_EDGE_ABBREV = {
+    "moe_a2a": "moe", "ring_kv": "kv", "pp_act": "pp",
+    "powersgd_factor": "psgd", "dp_grad": "dp",
+}
+
+
+def _edge_wire(m: Dict[str, float]) -> str:
+    """Per-edge wire ratios from the ``cgx.wire.bytes_{raw,wire}.<kind>``
+    counters (the unified wire plane's accounting) — e.g.
+    ``moe:7.9x kv:7.9x``; ``-`` when no edge has compressed."""
+    parts = []
+    for kind, short in _EDGE_ABBREV.items():
+        raw = m.get(f"cgx.wire.bytes_raw.{kind}", 0.0)
+        wire = m.get(f"cgx.wire.bytes_wire.{kind}", 0.0)
+        if wire:
+            parts.append(f"{short}:{raw / wire:.1f}x")
+    return " ".join(parts) or "-"
+
+
 def _overlap(m: Dict[str, float]) -> str:
     wall = m.get("cgx.sched.wall_s", 0.0)
     if not wall:
@@ -230,7 +252,8 @@ def render(directory: str, state: dict) -> str:
         f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
-               "overlap", "sched$", "straggler", "gen", "last_fault")
+               "edges", "overlap", "sched$", "straggler", "gen",
+               "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -241,6 +264,7 @@ def render(directory: str, state: dict) -> str:
             _fmt_ms(m.get("cgx.collective.allreduce_s.p50")),
             _fmt_ms(m.get("cgx.collective.allreduce_s.p99")),
             _wire_ratio(m),
+            _edge_wire(m),
             _overlap(m),
             _sched_cache(m),
             _straggler(d["status"]),

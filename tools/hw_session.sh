@@ -94,6 +94,10 @@ session() {
   # are CPU-pinned process groups — never touches the device transport,
   # and the record carries the cgx_trace overlap_frac the gate floors on.
   run_cpu 900 "sched pipelined vs monolithic" env JAX_PLATFORMS=cpu python bench.py --schedule --mb 32 --ws 4
+  # Unified wire plane (ISSUE 10): per-edge compressed-vs-raw records.
+  # The child probes for real chips itself and falls back to a forced CPU
+  # multi-device platform, so this step never wedges the device transport.
+  run 900 "wire edges compressed vs raw" python bench.py --wire --mb 8 --ws 4
   run 600 "current"               python tools/qbench.py current || return 1
   run 600 "dequant reference"     python tools/qbench.py dequant || return 1
   run 600 "sra epilogue fused"    python tools/qbench.py sra_epilogue || return 1

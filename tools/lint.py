@@ -390,7 +390,7 @@ _METRIC_NAMESPACES = ("cgx.", "span.")
 _METRIC_CGX_SUBNAMESPACES = frozenset({
     "collective", "faults", "flightrec", "health", "heartbeat", "qerr",
     "recovery", "ring", "runtime", "sched", "shm", "sra", "step", "trace",
-    "xla",
+    "wire", "xla",
 })
 
 
@@ -711,6 +711,64 @@ def check_schedule_stage_blocking(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+# Wire-plane routing gate: the modules whose collectives are EDGES of the
+# unified wire plane must send payloads through wire.dispatch (so the edge
+# registry, the per-edge counters and the closed-loop controller see
+# them), never via a bare lax collective the dispatcher cannot intercept.
+# Control/index tensors (bool masks riding beside a K/V block) are the
+# documented exemption — they live in functions named in the allowlist.
+_WIRE_EDGE_FILES = ("moe.py", "ring_attention.py", "pipeline.py")
+_WIRE_PAYLOAD_COLLECTIVES = {"ppermute", "all_to_all"}
+_WIRE_RAW_ALLOWLIST = frozenset({"_rotate_control"})
+
+
+def check_wire_edge_routing(path: Path, tree: ast.Module) -> list[str]:
+    """Every ``ppermute``/``all_to_all`` call in
+    ``parallel/{moe,ring_attention,pipeline}.py`` must go through
+    ``wire.dispatch`` (``wire_ppermute``/``wire_all_to_all``) — a direct
+    ``lax`` payload send bypasses the edge registry, ships raw bytes no
+    matter what the operator configured, and is invisible to the
+    ``cgx.wire.*`` accounting. Functions in ``_WIRE_RAW_ALLOWLIST``
+    (control/index tensors that must never quantize) are exempt."""
+    if (
+        _LIB_DIR not in path.parts
+        or "parallel" not in path.parts
+        or path.name not in _WIRE_EDGE_FILES
+    ):
+        return []
+    findings: list[str] = []
+
+    def walk(node: ast.AST, fn_name: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                walk(child, child.name)
+                continue
+            if isinstance(child, ast.Call):
+                fn = child.func
+                name = (
+                    fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else ""
+                )
+                if (
+                    name in _WIRE_PAYLOAD_COLLECTIVES
+                    and fn_name not in _WIRE_RAW_ALLOWLIST
+                ):
+                    findings.append(
+                        f"{path}:{child.lineno}: direct '{name}' payload "
+                        f"send in {fn_name or '<module>'!r} bypasses the "
+                        "wire dispatcher — route it through "
+                        "wire.dispatch.wire_ppermute/wire_all_to_all, or "
+                        "move control-tensor sends into an allowlisted "
+                        "function (tools/lint.py _WIRE_RAW_ALLOWLIST; "
+                        "docs/COMPRESSION_GUIDE.md 'Every wire, one "
+                        "dispatcher')"
+                    )
+            walk(child, fn_name)
+
+    walk(tree, "")
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -797,6 +855,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_reducer_reduce_routing(path, tree))
     out.extend(check_staged_purity(path, tree))
     out.extend(check_schedule_stage_blocking(path, tree))
+    out.extend(check_wire_edge_routing(path, tree))
     return out
 
 

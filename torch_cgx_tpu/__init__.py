@@ -10,12 +10,13 @@ data-parallel front end, and a pure-Python torch.distributed backend.
 
 __version__ = "0.1.0"
 
-from . import checkpoint, config, data, observability, robustness
+from . import checkpoint, config, data, observability, robustness, wire
 from .config import (
     CompressionConfig,
     TopologyConfig,
     clear_registry,
     register_layer,
+    reset_registries,
     set_layer_pattern_config,
     set_quantization_bits,
     set_quantization_bucket_size,
@@ -27,9 +28,11 @@ __all__ = [
     "config",
     "observability",
     "robustness",
+    "wire",
     "CompressionConfig",
     "TopologyConfig",
     "clear_registry",
+    "reset_registries",
     "register_layer",
     "set_layer_pattern_config",
     "set_quantization_bits",

@@ -86,6 +86,11 @@ SRA_EPILOGUE_MIN_ELEMS = "CGX_SRA_EPILOGUE_MIN_ELEMS"  # fused-epilogue floor
 # Compiled collective schedules (parallel/schedule.py — PR 9):
 SCHEDULE = "CGX_SCHEDULE"  # auto | on | off — chunked pipelined collectives
 SCHED_CHUNKS = "CGX_SCHED_CHUNKS"  # pipeline depth (chunks per fusion slice)
+# Unified wire plane (wire/edges.py + wire/dispatch.py — per-edge
+# compression for MoE all-to-all, ring-attention K/V hops, pipeline
+# activations and PowerSGD factors):
+WIRE = "CGX_WIRE"  # auto | on | off — edge-dispatcher engagement
+WIRE_BITS = "CGX_WIRE_BITS"  # env-default bits for unregistered edges
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -514,6 +519,45 @@ def flightrec_cap() -> int:
     return v if v > 0 else 512
 
 
+def wire_mode() -> str:
+    """CGX_WIRE: engagement of the unified wire plane (``wire/``) — the
+    per-edge compression dispatcher every non-allreduce collective routes
+    through (MoE all-to-all, ring-attention K/V hops, pipeline activation
+    hops, PowerSGD factor reductions):
+
+    * "auto" (default) — the dispatcher compresses only on a real TPU
+      backend, and only edges with a resolvable config. On every CPU/CI
+      path the staged programs stay bit-identical to the pre-wire code
+      (the knob-off inertness suite pins this).
+    * "on" — compress resolvable edges on any backend (the CPU
+      multi-device test/bench configuration).
+    * "off" — never compress; every edge sends raw collectives.
+
+    Unset with an empty edge registry and ``CGX_WIRE_BITS`` unset, every
+    edge resolves to no config, so no program, store key or wire byte
+    changes regardless of mode.
+    """
+    mode = _env.get_str_env_or_default(WIRE, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{WIRE} must be auto|on|off, got {mode!r}")
+    return mode
+
+
+def wire_default_bits() -> int:
+    """CGX_WIRE_BITS: env-default quantization width for wire edges with
+    no registered config — the one-knob way to compress EVERY routed edge
+    (MoE/ring/pipeline/PowerSGD-factor) at once. 0 (default) = off:
+    unregistered edges stay raw. 1..8 enable a max-min wire at that width
+    using the default bucket size. dp_grad edges are NOT covered (their
+    default is the existing ``CGX_COMPRESSION_QUANTIZATION_BITS``)."""
+    v = _env.get_int_env_or_default(WIRE_BITS, 0)
+    if v and not 1 <= v <= MAX_BITS:
+        raise ValueError(
+            f"{WIRE_BITS} must be 0 (off) or 1..{MAX_BITS}, got {v}"
+        )
+    return v
+
+
 def health_enabled() -> bool:
     """CGX_HEALTH: run the per-rank streaming health engine
     (``observability/health.py``) — online EWMA/P² estimators over the
@@ -778,3 +822,22 @@ def clear_registry() -> None:
     _layer_sizes.clear()
     _pattern_configs.clear()
     _bump_registry_version()
+
+
+def reset_registries() -> None:
+    """Full config-plane reset: the per-layer registries
+    (:func:`clear_registry`) PLUS the wire plane's per-edge registry and
+    its derived state (resolution caches, per-edge EF zeroing hooks, the
+    closed-loop controller's cadence/allocation) when the ``wire``
+    subsystem is loaded. The recovery supervisor's
+    ``invalidate_trace_caches`` resets only the derived state (configs
+    survive a reconfigure); this entry point is the stronger
+    test-harness/new-job reset. Lazy via ``sys.modules`` — importing the
+    wire plane from here would cycle (wire imports config)."""
+    import sys as _sys
+
+    clear_registry()
+    edges_mod = _sys.modules.get("torch_cgx_tpu.wire.edges")
+    if edges_mod is not None:
+        edges_mod.clear_edges()
+        edges_mod.reset_edge_state("reset_registries")

@@ -75,8 +75,20 @@ def resolve_leaf_config(
     hook's ``should_compress_`` (dim<=1 or tiny tensors -> uncompressed,
     allreduce_hooks.py:42-45) and the compressor's ``isEnabled``
     (numel > minimal and bits <= 8, compressor.cc:421-425).
+
+    Resolution order: a registered ``dp_grad`` EDGE config matching the
+    leaf path (``wire.edges`` — the generalized per-edge registry, which
+    the closed-loop controller writes into), then the legacy name-pattern
+    registry, then the env default. With no edge registered the edge
+    lookup is a no-op (bit-identical resolution).
     """
-    cc = cfg_mod.resolve_pattern_config(path) or cfg_mod.default_compression_config()
+    from ..wire import edges as wire_edges
+
+    cc = (
+        wire_edges.resolve_dp_grad(path)
+        or cfg_mod.resolve_pattern_config(path)
+        or cfg_mod.default_compression_config()
+    )
     if not is_compressible(leaf, compress_small=compress_small):
         return dataclasses.replace(cc, bits=32)
     return cc
@@ -132,16 +144,31 @@ def _report_qerr(path: str, leaf, rt) -> None:
 
 _QERR_SEEN: Dict[str, int] = {}
 
+# Trace-time (numel, bits) per qerr-reporting layer: the closed-loop
+# controller (wire/controller.py) rebuilds the bit-allocation solver's
+# LayerStats from the live cgx.qerr.* histograms, which carry only the
+# relative error — the payload size and the width it was measured at are
+# static facts recorded here when the program stages the measurement.
+# Plain host-side Python at trace time: nothing staged changes.
+_QERR_INFO: Dict[str, Dict[str, int]] = {}
+
+
+def qerr_layer_info() -> Dict[str, Dict[str, int]]:
+    """Copy of the per-layer {numel, bits} side table (controller)."""
+    return {k: dict(v) for k, v in _QERR_INFO.items()}
+
 
 def reset_qerr_sampling() -> None:
     """Restart the flight-recorder qerr subsample cadence (the per-layer
-    every-32nd counters above). Called alongside the registry-version
-    bump (``supervisor.invalidate_trace_caches``): after a recovery
+    every-32nd counters above) and the controller's (numel, bits) side
+    table. Called alongside the registry-version bump
+    (``supervisor.invalidate_trace_caches``): after a recovery
     reconfiguration the retraced programs are a new qerr stream, and
     keeping the dead generation's counters would subsample it on a stale
     phase — the first post-recovery observation per layer must land in
     the flight recorder, not be silently skipped."""
     _QERR_SEEN.clear()
+    _QERR_INFO.clear()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -267,6 +294,8 @@ def _layout_key(paths_leaves, treedef, compress_small: bool, route_key):
     topology router's (route, class) pair: a ``CGX_XLA_ALLREDUCE`` flip
     or a mesh whose groups classify differently must derive a fresh plan,
     never hit one cached for another routing era."""
+    from ..wire import edges as wire_edges
+
     return (
         treedef,
         tuple(
@@ -279,6 +308,10 @@ def _layout_key(paths_leaves, treedef, compress_small: bool, route_key):
         cfg_mod.minimal_size(),
         cfg_mod.standalone_layer_elems(),
         cfg_mod.fusion_threshold_elems(1),
+        # dp_grad edge entries resolve under the CGX_WIRE engagement gate,
+        # so a mode/bits flip must derive a fresh plan, never hit one
+        # cached for another wire era.
+        wire_edges.cache_key_component(),
     )
 
 
@@ -729,6 +762,10 @@ def allreduce_tree(
                 if return_roundtrip:
                     rt_out[i] = rt_leaf
                 if qerr and g.cc.enabled:
+                    _QERR_INFO[paths_leaves[i][0]] = {
+                        "numel": int(leaf.size),
+                        "bits": int(g.cc.bits),
+                    }
                     _report_qerr(paths_leaves[i][0], leaf, rt_leaf)
     result = jax.tree_util.tree_unflatten(treedef, out)
     if return_roundtrip:

@@ -662,12 +662,21 @@ def make_train_step(
         from . import schedule as sched_mod
 
         sched_key = sched_mod.cache_key_component()
+        # Wire-plane component: a CGX_WIRE/CGX_WIRE_BITS flip changes what
+        # any routed edge inside loss_fn (ring-attention hops, MoE
+        # dispatch) stages — it must retrace, never serve a trace from
+        # another wire era. Registered-edge changes ride the registry
+        # version above.
+        from ..wire import edges as wire_edges
+
+        wire_key = wire_edges.cache_key_component()
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
             version,
             xla_route,
             sched_key,
+            wire_key,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.

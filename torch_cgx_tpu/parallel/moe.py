@@ -33,8 +33,47 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from ..utils import compat
+from ..wire import dispatch as wire_dispatch
+from ..wire.edges import EDGE_MOE_A2A
+
 
 _warned_constraint = False
+
+
+def ep_dispatch(exp_in: jax.Array, axis_name: str, *, name: str = "moe.dispatch"):
+    """Explicit expert-parallel dispatch ``all_to_all`` through the wire
+    dispatcher (the ``moe_a2a`` edge) — for MoE layers running INSIDE
+    ``shard_map`` over ``axis_name`` (the GSPMD path in :class:`MoEMlp`
+    instead lets the compiler insert the collective, which the edge
+    registry cannot see).
+
+    ``exp_in`` is this device's dense dispatch buffer ``(E, C, D)``
+    (every expert's slots, local tokens). Returns ``(E/ws, ws*C, D)``:
+    this device's experts' slots, gathered from every rank. Raw unless a
+    ``moe_a2a`` edge config resolves; with one, the payload rides the
+    quantized wire (packed bit-planes + per-slice meta, STE backward).
+    Requires ``E % ws == 0``."""
+    ws = compat.axis_size(axis_name)
+    if exp_in.shape[0] % ws:
+        raise ValueError(
+            f"ep_dispatch: expert dim {exp_in.shape[0]} not divisible by "
+            f"axis size {ws}"
+        )
+    return wire_dispatch.wire_all_to_all(
+        exp_in, axis_name, split_axis=0, concat_axis=1,
+        kind=EDGE_MOE_A2A, name=name,
+    )
+
+
+def ep_combine(exp_out: jax.Array, axis_name: str, *, name: str = "moe.combine"):
+    """Inverse of :func:`ep_dispatch`: ``(E/ws, ws*C, D)`` expert outputs
+    back to the token-owning ranks as ``(E, C, D)`` — the combine
+    ``all_to_all``, same ``moe_a2a`` edge surface."""
+    return wire_dispatch.wire_all_to_all(
+        exp_out, axis_name, split_axis=1, concat_axis=0,
+        kind=EDGE_MOE_A2A, name=name,
+    )
 
 
 def _capacity(tokens: int, n_experts: int, top_k: int, factor: float) -> int:

@@ -26,15 +26,19 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..config import CompressionConfig
-from .reducers import quantized_ppermute
+from ..wire import dispatch as wire_dispatch
+from ..wire.edges import EDGE_PP_ACT
 
 
-def _hop(y, axis_name, perm, hop_cc):
-    """One inter-stage transfer: plain ppermute, or the quantized wire
-    (packed bit-planes + meta, STE backward) when ``hop_cc`` is given."""
-    if hop_cc is None:
-        return lax.ppermute(y, axis_name, perm)
-    return quantized_ppermute(y, axis_name, perm, hop_cc)
+def _hop(y, axis_name, perm, hop_cc, name: str = "pipeline.act"):
+    """One inter-stage transfer through the edge dispatcher (`pp_act`):
+    an explicit ``hop_cc`` keeps the legacy quantized wire (packed
+    bit-planes + meta, STE backward — byte-identical to calling
+    ``reducers.quantized_ppermute`` directly); otherwise the hop resolves
+    the edge registry and sends raw unless a config is registered."""
+    return wire_dispatch.wire_ppermute(
+        y, axis_name, perm, kind=EDGE_PP_ACT, name=name, cc=hop_cc
+    )
 
 
 def _squeeze_stage_axis(local_params):
@@ -362,7 +366,7 @@ def pipeline_1f1b(
         )
 
         recv_x = _hop(y, axis_name, right, hop_cc)
-        recv_cot = _hop(cot_x, axis_name, left, hop_cc)
+        recv_cot = _hop(cot_x, axis_name, left, hop_cc, name="pipeline.cot")
         return (recv_x, recv_cot, stash, gacc, lacc), None
 
     stash0 = jnp.zeros((k_slots,) + zero.shape, zero.dtype)

@@ -141,6 +141,16 @@ def powersgd_transform(
                 x = lax.psum(x, a)
         return x
 
+    def _factor_psum(x, name):
+        # Factor traffic is a wire edge (`powersgd_factor`): plain exact
+        # psum unless an edge config resolves, in which case the factors
+        # ride the quantized allreduce — error-symmetric, so every device
+        # still decodes identical factors (the orthonormalization input
+        # stays replicated).
+        from ..wire import dispatch as wire_dispatch
+
+        return wire_dispatch.wire_factor_allreduce(x, axes, mesh, name=name)
+
     def init_fn(params):
         return init_powersgd(params, rank)
 
@@ -175,10 +185,10 @@ def powersgd_transform(
                 continue
             n, m = _matrix_shape(leaf.shape)
             mat = leaf.astype(jnp.float32).reshape(n, m) + e
-            p = _psum(mat @ q)  # scale irrelevant: orthonormalized next
-            p = _orthonormalize(p)
+            p = _factor_psum(mat @ q, "powersgd.p")  # scale irrelevant:
+            p = _orthonormalize(p)                   # orthonormalized next
             # MEAN projection — see the module docstring on why /ws here.
-            q_new = _psum(mat.T @ p) / np.float32(ws)
+            q_new = _factor_psum(mat.T @ p, "powersgd.q") / np.float32(ws)
             m_hat = p @ q_new.T
             metrics.add(
                 "cgx.trace.powersgd.wire_elems", float((n + m) * q.shape[1])

@@ -34,8 +34,18 @@ import numpy as np
 from jax import lax
 
 from ..utils import compat
+from ..wire import dispatch as wire_dispatch
+from ..wire.edges import EDGE_RING_KV
 
 NEG_INF = np.float32(-1e30)
+
+
+def _rotate_control(t, axis_name, perm):
+    """Raw ``ppermute`` for control tensors (the bool padding mask riding
+    beside its K/V block): index/mask payloads must never quantize, so
+    this is the documented wire-dispatcher exemption (tools/lint.py
+    allowlists exactly this function)."""
+    return lax.ppermute(t, axis_name, perm)
 
 
 def _block_scores(q, k, scale):
@@ -124,8 +134,26 @@ def ring_attention(
         )
         m = m_new
         if step != ws - 1:
-            kv = jax.tree.map(
-                lambda a: lax.ppermute(a, axis_name, shift_left), kv
+            # K/V hops ride the edge dispatcher (`ring_kv`): raw unless a
+            # config resolves — per-hop quantization compounds over the
+            # ring, so compression here is strictly opt-in via the edge
+            # registry. The mask is a control tensor and always raw.
+            k_next = wire_dispatch.wire_ppermute(
+                kv[0], axis_name, shift_left,
+                kind=EDGE_RING_KV, name="ring_attention.k",
+            )
+            v_next = wire_dispatch.wire_ppermute(
+                kv[1], axis_name, shift_left,
+                kind=EDGE_RING_KV, name="ring_attention.v",
+            )
+            kv = (
+                (k_next, v_next)
+                if mask is None
+                else (
+                    k_next,
+                    v_next,
+                    _rotate_control(kv[2], axis_name, shift_left),
+                )
             )
 
     out = acc / jnp.maximum(l, np.float32(1e-30))[..., None]
@@ -173,14 +201,12 @@ def ulysses_attention(
         mask = lax.all_gather(mask, axis_name, axis=1, tiled=True)  # (B, S)
 
     def _a2a(t, s_ax, c_ax):
-        if hop_cc is not None:
-            from .reducers import quantized_all_to_all
-
-            return quantized_all_to_all(
-                t, axis_name, split_axis=s_ax, concat_axis=c_ax, cc=hop_cc
-            )
-        return lax.all_to_all(
-            t, axis_name, split_axis=s_ax, concat_axis=c_ax, tiled=True
+        # One surface for both modes: an explicit hop_cc bypasses the
+        # registry (legacy behavior, byte-identical); otherwise the
+        # reshard resolves the `ring_kv` edge — raw unless configured.
+        return wire_dispatch.wire_all_to_all(
+            t, axis_name, split_axis=s_ax, concat_axis=c_ax,
+            kind=EDGE_RING_KV, name="ulysses", cc=hop_cc,
         )
 
     def to_heads(t):  # split heads over axis, gather sequence

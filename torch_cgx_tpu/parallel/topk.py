@@ -67,6 +67,23 @@ def _k_for(n: int, ratio: float) -> int:
     return max(1, int(np.ceil(ratio * n)))
 
 
+def sparsify(flat: jax.Array, k: int):
+    """(indices, values) of the ``k`` largest-magnitude coordinates of a
+    flat buffer — the top-k wire payload. Shared with the edge
+    dispatcher (``wire/dispatch.py``), which ships top-k as a peer
+    compressor for point-to-point edges."""
+    _, idx = lax.top_k(jnp.abs(flat), k)
+    return idx.astype(jnp.int32), jnp.take(flat, idx)
+
+
+def densify(n: int, idx: jax.Array, val: jax.Array) -> jax.Array:
+    """Scatter one device's (idx, val) pairs into a dense zero buffer —
+    the receive-side reconstruction of :func:`sparsify` (indices from a
+    single sender are unique, so ``set`` suffices; the transform's
+    multi-sender fold uses ``add``)."""
+    return jnp.zeros((n,), val.dtype).at[idx].set(val)
+
+
 def eligible(leaf, ratio: float, ws: int = 1) -> bool:
     """Sparsification pays off: float, above the minimal size, and the
     (index, value) pairs are smaller IN BYTES than the dense leaf — a
@@ -182,8 +199,7 @@ def topk_transform(
             n = leaf.size
             k = _k_for(n, ratio)
             m = leaf.astype(jnp.float32).reshape(-1) + e
-            _, idx = lax.top_k(jnp.abs(m), k)
-            val = jnp.take(m, idx)
+            idx, val = sparsify(m, k)
             # (ws*k,) after tiled gathers; identical on every device.
             all_idx = _gather(idx)
             all_val = _gather(val)

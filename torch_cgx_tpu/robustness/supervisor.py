@@ -126,6 +126,15 @@ def invalidate_trace_caches() -> None:
     # would otherwise re-emit a phantom straggler event every cooldown
     # window for the rest of the run.
     health_mod.forget_peers()
+    # Wire plane: derived per-edge state (resolution memo, the
+    # dispatcher's numel/bits side table, EF zeroers and the closed-loop
+    # controller's cadence) is a pre-recovery stream too — a stale edge
+    # cadence after a reconfigure mirrors the qerr-cadence bug above.
+    # Registered edge CONFIGS survive (they are configuration, not
+    # state); config.reset_registries is the stronger reset.
+    wire_edges = sys.modules.get("torch_cgx_tpu.wire.edges")
+    if wire_edges is not None:
+        wire_edges.reset_edge_state("recovery reconfigure")
     metrics.add("cgx.recovery.trace_cache_invalidations")
 
 

@@ -1,0 +1,62 @@
+"""Unified wire plane: one compression dispatcher for every edge.
+
+The reference compresses exactly one traffic class — the DDP gradient
+allreduce — behind its per-layer config registry (ProcessGroupCGX.cc:
+837-857). This subsystem generalizes that registry to per-*edge* configs
+(:mod:`.edges`), routes every other collective the framework emits — MoE
+all-to-all dispatch, ring-attention K/V hops, pipeline activation hops,
+PowerSGD factor reductions — through the same ``ops.dispatch`` codec path
+(:mod:`.dispatch`: quantize → collective → dequantize inside the staged
+program, zero host callbacks), and closes the observability→control loop
+(:mod:`.controller`: the live ``cgx.qerr.*`` relative-L2 stream drives
+``adaptive.solve_bit_allocation`` every K steps and writes the result
+back into the registries).
+
+Everything is gated by ``CGX_WIRE`` (auto|on|off): with the knob unset
+and the edge registry empty, every routed call site lowers to exactly
+the plain ``lax`` collective it replaced — staged programs, store keys
+and wire bytes bit-identical (docs/COMPRESSION_GUIDE.md "Every wire,
+one dispatcher").
+"""
+
+from . import controller, dispatch, edges
+from .controller import WireController
+from .dispatch import (
+    init_edge_ef,
+    wire_all_to_all,
+    wire_factor_allreduce,
+    wire_ppermute,
+)
+from .edges import (
+    EDGE_DP_GRAD,
+    EDGE_KINDS,
+    EDGE_MOE_A2A,
+    EDGE_POWERSGD_FACTOR,
+    EDGE_PP_ACT,
+    EDGE_RING_KV,
+    EdgeConfig,
+    clear_edges,
+    resolve_edge,
+    set_edge_config,
+)
+
+__all__ = [
+    "controller",
+    "dispatch",
+    "edges",
+    "WireController",
+    "init_edge_ef",
+    "wire_all_to_all",
+    "wire_factor_allreduce",
+    "wire_ppermute",
+    "EDGE_DP_GRAD",
+    "EDGE_KINDS",
+    "EDGE_MOE_A2A",
+    "EDGE_POWERSGD_FACTOR",
+    "EDGE_PP_ACT",
+    "EDGE_RING_KV",
+    "EdgeConfig",
+    "clear_edges",
+    "resolve_edge",
+    "set_edge_config",
+]

@@ -1,0 +1,184 @@
+"""Closed-loop adaptive bit-width control from live qerr telemetry.
+
+``parallel/adaptive.py`` solves the per-layer bit-allocation problem
+from an OFFLINE host pass over a gradient tree (``measure_layer_stats``
+-> ``solve_bit_allocation``). This controller closes the
+observability→control loop instead: the ``cgx.qerr.*`` relative-L2
+histograms the instrumented collectives already stream (dp_grad layers
+via ``allreduce._report_qerr``, wire edges via
+``dispatch._stage_qerr``; both need ``CGX_QERR_STATS=1``) are converted
+back into the solver's error-model statistics and re-solved every K
+steps, with the result written into the live registries — dp_grad
+layers into the name-pattern registry, wire edges into the edge
+registry. The registry-version bump both writes perform forces the next
+step to retrace at the new widths, exactly like ``adapt_bits``.
+
+Error-model conversion: the solver minimizes
+``E_l(b) = numel_l * msr_l / (12 (2^b - 1)^2)``. A layer observed at
+relative L2 ``rel`` while quantized at ``b_cur`` bits satisfies
+``rel^2 ~ msr_unit / (12 (2^b_cur - 1)^2)`` per unit norm, so feeding
+``msr_l = rel^2 * 12 * (2^b_cur - 1)^2`` makes
+``E_l(b) = numel_l * rel^2 * ((2^b_cur - 1)/(2^b - 1))^2`` — the
+predicted relative error at candidate width ``b``, weighted by payload
+size. No gradient-norm side channel is needed: relative error is
+scale-invariant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+import weakref
+from typing import Dict, Optional, Tuple
+
+from .. import config as cfg_mod
+from ..utils.logging import metrics
+from . import dispatch, edges
+
+_QERR_PREFIX = "cgx.qerr."
+
+# Controllers auto-reset with the rest of the per-edge derived state
+# (supervisor.invalidate_trace_caches / config.reset_registries): a
+# cadence counter surviving a recovery reconfiguration would fire the
+# next re-solve on the dead generation's phase — the PR 6 qerr-cadence
+# bug, closed-loop edition.
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def _reset_all() -> None:
+    for c in list(_LIVE):
+        c.reset()
+
+
+edges.register_reset_hook(_reset_all)
+
+
+class WireController:
+    """Drive ``solve_bit_allocation`` from the live qerr stream.
+
+    Host-side, called from the training loop::
+
+        ctl = WireController(avg_bits=4, every=500)
+        for step in range(n_steps):
+            params, opt_state, loss = train_step(...)
+            ctl.step()   # re-solves (and retraces) every 500 steps
+
+    ``avg_bits`` — the payload-weighted average-width budget.
+    ``every`` — re-solve cadence in :meth:`step` calls (0 = manual only).
+    ``min_observations`` — a layer/edge needs at least this many qerr
+    samples before it joins the solve (a single warm-up sample is a
+    noisy basis for a retrace).
+    """
+
+    def __init__(
+        self,
+        avg_bits: float,
+        *,
+        every: int = 500,
+        bits_range: Tuple[int, int] = (2, 8),
+        min_observations: int = 1,
+    ):
+        if every < 0:
+            raise ValueError(f"every must be >= 0, got {every}")
+        self.avg_bits = avg_bits
+        self.every = every
+        self.bits_range = bits_range
+        self.min_observations = max(1, min_observations)
+        self.updates = 0
+        self.last_alloc: Dict[str, int] = {}
+        self._count = 0
+        _LIVE.add(self)
+
+    def reset(self) -> None:
+        """Drop cadence + last allocation (post-recovery / new job)."""
+        self._count = 0
+        self.last_alloc = {}
+
+    def step(self) -> Optional[Dict[str, int]]:
+        """Note one training step; every ``every``-th call re-solves.
+        Returns the new allocation when one was applied, else None."""
+        self._count += 1
+        if self.every and self._count % self.every == 0:
+            return self.update()
+        return None
+
+    def _gather_stats(self):
+        """LayerStats from the live qerr histograms + the trace-time
+        (numel, bits) side tables. Only labels with a known payload and
+        a quantized current width can join the error model."""
+        from ..parallel import allreduce
+        from ..parallel.adaptive import LayerStat
+
+        info: Dict[str, Dict[str, int]] = {}
+        info.update(allreduce.qerr_layer_info())
+        info.update(dispatch.edge_info())
+        hists = metrics.snapshot_typed()["histograms"]
+        stats: Dict[str, LayerStat] = {}
+        for hname, h in hists.items():
+            if not hname.startswith(_QERR_PREFIX):
+                continue
+            label = hname[len(_QERR_PREFIX):]
+            meta = info.get(label)
+            if meta is None or not meta.get("bits"):
+                continue  # raw or non-quantize edge: nothing to re-bit
+            if h.get("count", 0) < self.min_observations:
+                continue
+            rel = h.get("p90", h.get("mean", 0.0)) or h.get("mean", 0.0)
+            b_cur = int(meta["bits"])
+            msr = float(rel) ** 2 * 12.0 * (2**b_cur - 1) ** 2
+            stats[label] = LayerStat(numel=int(meta["numel"]), mean_sq_range=msr)
+        return stats
+
+    def _apply(self, alloc: Dict[str, int]) -> None:
+        for label, b in alloc.items():
+            if label.startswith("wire:"):
+                _, kind, name = label.split(":", 2)
+                cur = edges.resolve_edge(kind, name) or edges.EdgeConfig()
+                edges.set_edge_config(
+                    kind,
+                    "^" + re.escape(name) + "$",
+                    dataclasses.replace(
+                        cur, cc=dataclasses.replace(cur.cc, bits=int(b))
+                    ),
+                )
+            else:
+                base = (
+                    cfg_mod.resolve_pattern_config(label)
+                    or cfg_mod.default_compression_config()
+                )
+                cfg_mod.set_layer_pattern_config(
+                    "^" + re.escape(label) + "$",
+                    dataclasses.replace(base, bits=int(b)),
+                )
+            metrics.set(f"cgx.wire.bits.{label}", float(b))
+
+    def update(self) -> Dict[str, int]:
+        """Gather -> solve -> write-back now. Returns the allocation
+        ({} when no label has enough telemetry yet). Idempotent when the
+        telemetry hasn't moved: the same stats solve to the same bits,
+        and re-registering an identical config only costs a registry
+        bump (one retrace) the first time."""
+        from ..parallel.adaptive import solve_bit_allocation
+
+        stats = self._gather_stats()
+        if not stats:
+            return {}
+        alloc = solve_bit_allocation(
+            stats, self.avg_bits, bits_range=self.bits_range
+        )
+        changed = alloc != self.last_alloc
+        if changed:
+            self._apply(alloc)
+        self.last_alloc = dict(alloc)
+        self.updates += 1
+        metrics.add("cgx.wire.controller_updates")
+        from ..observability import flightrec
+
+        flightrec.record(
+            "wire_controller",
+            avg_bits=self.avg_bits,
+            layers=len(alloc),
+            changed=changed,
+            alloc={k: int(v) for k, v in sorted(alloc.items())[:32]},
+        )
+        return alloc

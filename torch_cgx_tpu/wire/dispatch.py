@@ -1,0 +1,489 @@
+"""Edge dispatcher: every collective payload through one codec surface.
+
+Call sites in ``parallel/{moe,ring_attention,pipeline,powersgd}.py`` send
+their wire payloads through :func:`wire_ppermute` /
+:func:`wire_all_to_all` / :func:`wire_factor_allreduce` instead of bare
+``lax`` collectives (``tools/lint.py`` enforces this). Each call resolves
+its ``(edge_kind, name)`` against the edge registry (:mod:`.edges`) and
+either
+
+* lowers to the PLAIN collective (no config resolves, the payload is
+  below ``CGX_COMPRESSION_MINIMAL_SIZE``, or ``CGX_WIRE`` disengages) —
+  byte-identical to the pre-wire code, or
+* compresses inside the staged program: quantize → collective →
+  dequantize through the same ``ops.dispatch`` codec the SRA/Ring
+  reducers use (Pallas on TPU, XLA elsewhere; zero host callbacks — the
+  jaxpr guard in tests/test_wire.py pins this), with PowerSGD low-rank
+  and top-k sparsification available as peer compressors behind the same
+  surface, and optional per-edge error feedback for aggressive
+  bit-widths (state threaded explicitly by the caller).
+
+Backward passes are straight-through: the cotangent rides the same
+compressed transport over the inverse permutation/reshard (the
+``reducers.quantized_ppermute`` convention).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+from ..ops import dispatch as ops_dispatch
+from ..utils.logging import metrics
+from . import edges
+
+
+def engaged() -> bool:
+    """Whether the dispatcher may compress: ``CGX_WIRE=on`` anywhere,
+    ``auto`` (the default) only on a real TPU backend — so every CPU/CI
+    path with the knob unset lowers each edge to its plain collective
+    (programs bit-identical; the inertness suite pins this), ``off``
+    never."""
+    mode = cfg_mod.wire_mode()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return ops_dispatch._on_tpu()
+
+
+def _active_cc(ec: Optional[edges.EdgeConfig], x) -> Optional[edges.EdgeConfig]:
+    """The edge config that will actually compress this payload, or None
+    (raw): engagement, bits-enabled, the dummy-codec debug knob and the
+    minimal-size floor all mirror the reducers' own gates so a fallback
+    here is byte-identical to the plain collective."""
+    if ec is None:
+        return None
+    if cfg_mod.dummy_compression() or x.size < cfg_mod.minimal_size():
+        return None
+    if ec.compressor == edges.COMPRESSOR_QUANTIZE and not ec.cc.enabled:
+        return None
+    return ec
+
+
+# Trace-time side table for the closed-loop controller: every compressed
+# edge records its element count and current width under the same
+# "wire:<kind>:<name>" label its qerr stream reports under, so
+# ``controller.WireController`` can rebuild LayerStats from live
+# telemetry without a host pass over the tensors.
+_EDGE_INFO: Dict[str, Dict[str, int]] = {}
+
+
+def edge_info() -> Dict[str, Dict[str, int]]:
+    """Copy of the per-edge (numel, bits) side table (controller/tests)."""
+    return {k: dict(v) for k, v in _EDGE_INFO.items()}
+
+
+def reset_edge_tables() -> None:
+    """Post-recovery reset (``edges.reset_edge_state``): retraced programs
+    are a new edge stream; the dead generation's table must not feed the
+    controller."""
+    _EDGE_INFO.clear()
+
+
+def edge_label(kind: str, name: str) -> str:
+    return f"wire:{kind}:{name}"
+
+
+def _note_edge(
+    kind: str,
+    name: str,
+    ec: edges.EdgeConfig,
+    numel: int,
+    wire_bytes: Optional[float] = None,
+) -> None:
+    """Trace-time accounting (once per compiled program, the
+    ``cgx.trace.*`` convention): per-kind raw/wire byte counters feeding
+    the report/cgx_top wire ratios, the flight-recorder/timeline
+    structure event, and the controller's side table. ``wire_bytes``
+    overrides the estimate for compressors whose payload the generic
+    model cannot see (powersgd factors)."""
+    cc = ec.cc
+    raw_b = numel * 4
+    bits = 0
+    if wire_bytes is not None:
+        wire_b = wire_bytes
+    elif ec.compressor == edges.COMPRESSOR_QUANTIZE:
+        nb = -(-numel // cc.bucket_size)
+        wire_b = numel * cc.bits / 8 + nb * 8
+        bits = cc.bits
+    else:  # topk: int32 index + f32 value per shipped coordinate
+        k = max(1, int(np.ceil(ec.ratio * numel)))
+        wire_b = 8 * k
+    metrics.add("cgx.wire.edges_compressed")
+    metrics.add(f"cgx.wire.bytes_raw.{kind}", float(raw_b))
+    metrics.add(f"cgx.wire.bytes_wire.{kind}", float(wire_b))
+    _EDGE_INFO[edge_label(kind, name)] = {"numel": numel, "bits": bits}
+    from ..observability import flightrec, timeline
+
+    rec = dict(
+        edge=kind,
+        edge_name=name,
+        compressor=ec.compressor,
+        elems=numel,
+        bits=bits,
+        wire_ratio=round(raw_b / wire_b, 3) if wire_b else 0.0,
+    )
+    flightrec.record("wire_edge", **rec)
+    timeline.instant("wire_edge", **rec)
+
+
+def _stage_qerr(label: str, x, rt) -> Optional[jax.Array]:
+    """CGX_QERR_STATS: stage this edge's relative-L2 round-trip error into
+    the live ``cgx.qerr.<label>`` histogram — the same stream the
+    closed-loop controller consumes for dp_grad layers, so wire edges
+    join the bit-allocation problem. Two hazards the allreduce qerr hook
+    never faces, because wire edges sit inside *differentiated* forward
+    passes: (1) ``io_callback`` has no JVP rule, so its input is
+    ``stop_gradient``-ed off the tangent path; (2) scan partial eval
+    (grad through the pipeline hops) DCEs effectful equations with
+    unused outputs, so the callback RETURNS the error and the caller
+    must anchor that returned value into its live dataflow via
+    :func:`_attach_qerr` (measured: without the anchor, grad-of-scan
+    silently delivers nothing). Returns None when the knob is off
+    (nothing staged — the clean program is unchanged)."""
+    if not cfg_mod.qerr_stats():
+        return None
+    from jax.experimental import io_callback
+
+    from ..ops.codec import relative_l2_error
+
+    err = lax.stop_gradient(relative_l2_error(x, rt).astype(jnp.float32))
+
+    def _sink(v, label=label):
+        metrics.observe(f"cgx.qerr.{label}", float(v))
+        return v
+
+    return io_callback(
+        _sink, jax.ShapeDtypeStruct((), jnp.float32), err, ordered=False
+    )
+
+
+def _attach_qerr(out: jax.Array, err: Optional[jax.Array]) -> jax.Array:
+    """Value-exact anchor for the staged qerr report: ``select(p, out,
+    out)`` keeps the report's output live in the jaxpr (so no transform
+    DCEs the effect) without changing a single output bit — both select
+    branches are ``out``, and XLA never removes the side-effecting
+    callback custom-call itself."""
+    if err is None:
+        return out
+    return jnp.where(jnp.isfinite(err), out, out)
+
+
+def init_edge_ef(x) -> jax.Array:
+    """Zero per-edge error-feedback residual for ``wire_ppermute(...,
+    ef=...)`` — f32, payload-shaped, PER-DEVICE (under shard_map it must
+    ride a sharded carry/state slot, never a replicated one — the
+    ErrorFeedbackState placement hazard applies verbatim)."""
+    return jnp.zeros(jnp.shape(x), jnp.float32)
+
+
+def _quantize_roundtrip(x, cc: CompressionConfig, key) -> jax.Array:
+    """What this device's payload decodes to on the wire — the same
+    rows=1 layout and key ``reducers.quantized_ppermute`` quantizes with,
+    so the EF residual/qerr measure the exact draw the wire used."""
+    q = ops_dispatch.quantize_batch(
+        x.reshape(1, -1), cc, key=key if cc.stochastic else None
+    )
+    rt = ops_dispatch.dequantize_batch(q, out_dtype=jnp.float32)
+    return lax.stop_gradient(rt.reshape(x.shape))
+
+
+def _matrix_view(v) -> Tuple[int, int]:
+    """(rows, cols) low-rank view of a payload: flattened leading dims x
+    last dim (activations' feature dim carries the structure)."""
+    return int(np.prod(v.shape[:-1])), int(v.shape[-1])
+
+
+def _powersgd_eligible(v, rank: int) -> bool:
+    if v.ndim < 2:
+        return False
+    n, m = _matrix_view(v)
+    r = min(rank, n, m)
+    return (n + m) * r < n * m
+
+
+def _powersgd_factors(v, rank: int, key):
+    """One-shot rank-r factorization of this device's payload (no
+    allreduce here — the edge is point-to-point, so sender factorizes,
+    receiver reconstructs): gaussian sketch -> orthonormalize -> project.
+    Deterministic for key=None (fixed seed) so replays are bit-stable."""
+    from ..parallel.powersgd import _orthonormalize
+
+    n, m = _matrix_view(v)
+    r = min(rank, n, m)
+    mat = v.reshape(n, m).astype(jnp.float32)
+    k = key if key is not None else jax.random.PRNGKey(0)
+    sketch = jax.random.normal(k, (m, r), jnp.float32) / np.float32(np.sqrt(m))
+    p = _orthonormalize(mat @ sketch)
+    q = mat.T @ p
+    return p, q
+
+
+def _reconstruct(p, q, shape, dtype):
+    return (p @ q.T).reshape(shape).astype(dtype)
+
+
+def _ste_hop(hop_fwd, hop_bwd):
+    """Straight-through wrapper: forward ships through ``hop_fwd``, the
+    cotangent through ``hop_bwd`` (the same compressed transport over the
+    inverse route — the quantized_ppermute convention)."""
+
+    @jax.custom_vjp
+    def f(v):
+        return hop_fwd(v)
+
+    f.defvjp(lambda v: (hop_fwd(v), None), lambda _, ct: (hop_bwd(ct),))
+    return f
+
+
+def wire_ppermute(
+    x: jax.Array,
+    axis_name: str,
+    perm,
+    *,
+    kind: str,
+    name: str = "",
+    cc: Optional[CompressionConfig] = None,
+    key: Optional[jax.Array] = None,
+    ef: Optional[jax.Array] = None,
+):
+    """``lax.ppermute`` through the edge dispatcher.
+
+    ``cc`` (explicit) bypasses the registry — the legacy ``hop_cc``
+    surface of the pipeline/ulysses helpers, byte-identical to calling
+    ``reducers.quantized_ppermute`` directly. Otherwise the payload
+    resolves ``(kind, name)`` against the edge registry; no config (or
+    ``CGX_WIRE`` disengaged) lowers to the plain ``ppermute``.
+
+    ``ef``: per-edge error-feedback residual (f32, payload-shaped,
+    per-device). When given, the call returns ``(out, ef_new)``: the
+    residual is added to the payload before quantization and re-measured
+    against this device's own wire decode — the aggressive-bit-width
+    corrector. On a raw edge the residual passes through unchanged
+    (exact wire, nothing to correct).
+    """
+    perm = tuple(perm)
+    if cc is not None:
+        if ef is not None:
+            raise ValueError(
+                "wire_ppermute: ef requires a registry-resolved edge — an "
+                "explicit cc bypasses the per-edge EF surface (register an "
+                "EdgeConfig instead)"
+            )
+        from ..parallel.reducers import quantized_ppermute
+
+        return quantized_ppermute(x, axis_name, perm, cc, key=key)
+    ec = _active_cc(edges.resolve_edge(kind, name) if engaged() else None, x)
+    if ec is None:
+        out = lax.ppermute(x, axis_name, perm)
+        return (out, ef) if ef is not None else out
+    inv_perm = tuple((d, s) for (s, d) in perm)
+    label = edge_label(kind, name)
+
+    if ec.compressor == edges.COMPRESSOR_QUANTIZE:
+        from ..parallel.reducers import quantized_ppermute
+
+        _note_edge(kind, name, ec, int(x.size))
+        use_ef = ef is not None
+        x_eff = (
+            (x.astype(jnp.float32) + lax.stop_gradient(ef)).astype(x.dtype)
+            if use_ef
+            else x
+        )
+        out = quantized_ppermute(x_eff, axis_name, perm, ec.cc, key=key)
+        if use_ef or cfg_mod.qerr_stats():
+            rt = _quantize_roundtrip(x_eff, ec.cc, key)
+            out = _attach_qerr(
+                out, _stage_qerr(label, x_eff, rt.astype(x_eff.dtype))
+            )
+            if use_ef:
+                ef_new = lax.stop_gradient(
+                    x_eff.astype(jnp.float32) - rt
+                )
+                return out, ef_new
+        return out
+
+    if ec.compressor == edges.COMPRESSOR_POWERSGD:
+        if not _powersgd_eligible(x, ec.rank):
+            out = lax.ppermute(x, axis_name, perm)
+            return (out, ef) if ef is not None else out
+        n, m = _matrix_view(x)
+        r = min(ec.rank, n, m)
+        _note_edge(kind, name, ec, int(x.size), wire_bytes=(n + m) * r * 4.0)
+        use_ef = ef is not None
+        x_eff = (
+            (x.astype(jnp.float32) + lax.stop_gradient(ef)).astype(x.dtype)
+            if use_ef
+            else x
+        )
+
+        def fwd(v, p_route=perm):
+            p_f, q_f = _powersgd_factors(v, ec.rank, key)
+            p_r = lax.ppermute(p_f, axis_name, p_route)
+            q_r = lax.ppermute(q_f, axis_name, p_route)
+            return _reconstruct(p_r, q_r, v.shape, v.dtype)
+
+        out = _ste_hop(fwd, lambda ct: fwd(ct, inv_perm))(x_eff)
+        if use_ef:
+            p_f, q_f = _powersgd_factors(x_eff, ec.rank, key)
+            rt = lax.stop_gradient(
+                _reconstruct(p_f, q_f, x_eff.shape, jnp.float32)
+            )
+            out = _attach_qerr(
+                out, _stage_qerr(label, x_eff, rt.astype(x_eff.dtype))
+            )
+            return out, lax.stop_gradient(x_eff.astype(jnp.float32) - rt)
+        return out
+
+    # top-k sparsification: ship the k largest-magnitude coordinates as
+    # (int32 index, f32 value) pairs; receiver scatters into zeros.
+    from ..parallel.topk import densify, sparsify
+
+    _note_edge(kind, name, ec, int(x.size))
+    k = max(1, int(np.ceil(ec.ratio * x.size)))
+    use_ef = ef is not None
+    x_eff = (
+        (x.astype(jnp.float32) + lax.stop_gradient(ef)).astype(x.dtype)
+        if use_ef
+        else x
+    )
+
+    def fwd_tk(v, p_route=perm):
+        idx, val = sparsify(v.reshape(-1).astype(jnp.float32), k)
+        idx_r = lax.ppermute(idx, axis_name, p_route)
+        val_r = lax.ppermute(val, axis_name, p_route)
+        return densify(v.size, idx_r, val_r).reshape(v.shape).astype(v.dtype)
+
+    out = _ste_hop(fwd_tk, lambda ct: fwd_tk(ct, inv_perm))(x_eff)
+    if use_ef:
+        idx, val = sparsify(x_eff.reshape(-1).astype(jnp.float32), k)
+        rt = lax.stop_gradient(densify(x_eff.size, idx, val)).reshape(
+            x_eff.shape
+        )
+        out = _attach_qerr(
+            out, _stage_qerr(label, x_eff, rt.astype(x_eff.dtype))
+        )
+        return out, lax.stop_gradient(x_eff.astype(jnp.float32) - rt)
+    return out
+
+
+def wire_all_to_all(
+    x: jax.Array,
+    axis_name: str,
+    *,
+    split_axis: int,
+    concat_axis: int,
+    kind: str,
+    name: str = "",
+    cc: Optional[CompressionConfig] = None,
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """``lax.all_to_all`` (tiled) through the edge dispatcher — the MoE
+    dispatch/combine and Ulysses reshard surface. Quantize-only: a
+    reshard's payload is consumed immediately on arrival, so low-rank/
+    sparse peer compressors (whose value is cross-step structure) are
+    rejected rather than silently degraded. ``cc`` explicit bypasses the
+    registry (the Ulysses ``hop_cc`` surface)."""
+    if cc is not None:
+        from ..parallel.reducers import quantized_all_to_all
+
+        return quantized_all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            cc=cc, key=key,
+        )
+    ec = _active_cc(edges.resolve_edge(kind, name) if engaged() else None, x)
+    if ec is not None:
+        from ..utils import compat
+
+        # quantized_all_to_all falls back to the plain reshard when the
+        # split axis doesn't divide by the axis size — classify that case
+        # as a RAW edge *here* so the accounting below never claims
+        # compression for bytes that went uncompressed.
+        if x.shape[split_axis] % compat.axis_size(axis_name):
+            ec = None
+    if ec is None:
+        return lax.all_to_all(
+            x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+            tiled=True,
+        )
+    if ec.compressor != edges.COMPRESSOR_QUANTIZE:
+        raise ValueError(
+            f"edge ({kind!r}, {name!r}): compressor {ec.compressor!r} is "
+            "p2p-only; all_to_all edges support 'quantize'"
+        )
+    from ..parallel.reducers import quantized_all_to_all
+    from ..utils import compat
+
+    _note_edge(kind, name, ec, int(x.size))
+    err = None
+    if cfg_mod.qerr_stats():
+        # Round-trip the payload in the same (ws, -1) row layout the
+        # quantized reshard quantizes; relative L2 is permutation-
+        # invariant, so measuring on the rows equals measuring on x.
+        ws = compat.axis_size(axis_name)
+        rows = jnp.moveaxis(x, split_axis, 0).reshape(ws, -1)
+        q = ops_dispatch.quantize_batch(
+            rows, ec.cc, key=key if ec.cc.stochastic else None
+        )
+        rt = lax.stop_gradient(
+            ops_dispatch.dequantize_batch(q, out_dtype=rows.dtype)
+        )
+        err = _stage_qerr(edge_label(kind, name), rows, rt)
+    out = quantized_all_to_all(
+        x, axis_name, split_axis=split_axis, concat_axis=concat_axis,
+        cc=ec.cc, key=key,
+    )
+    return _attach_qerr(out, err)
+
+
+def wire_factor_allreduce(
+    x: jax.Array,
+    axes: Sequence[str],
+    mesh,
+    *,
+    name: str = "",
+    key: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Exact-or-quantized allreduce of a PowerSGD factor (the
+    ``powersgd_factor`` edge): no config resolves -> the plain ``psum``
+    the transform always used (bit-identical); a quantize config routes
+    the flattened factor through ``reducers.quantized_allreduce`` per
+    axis — error-symmetric, so every device still decodes identical
+    factors and the orthonormalization stays replicated."""
+    ec = _active_cc(
+        edges.resolve_edge(edges.EDGE_POWERSGD_FACTOR, name)
+        if engaged()
+        else None,
+        x,
+    )
+    if ec is not None and ec.compressor != edges.COMPRESSOR_QUANTIZE:
+        # Same loud rejection as wire_all_to_all: silently degrading a
+        # misconfigured compressor to the exact psum would leave the user
+        # with no signal their config was a no-op.
+        raise ValueError(
+            f"edge ('powersgd_factor', {name!r}): compressor "
+            f"{ec.compressor!r} is p2p-only; factor allreduce edges "
+            "support 'quantize'"
+        )
+    live_axes = [a for a in axes if mesh is None or mesh.shape[a] > 1]
+    if ec is None or not live_axes:
+        for a in live_axes:
+            x = lax.psum(x, a)
+        return x
+    from ..parallel.reducers import quantized_allreduce
+
+    _note_edge(edges.EDGE_POWERSGD_FACTOR, name, ec, int(x.size))
+    flat = x.reshape(-1)
+    for i, a in enumerate(live_axes):
+        k = jax.random.fold_in(key, i) if key is not None else None
+        flat = quantized_allreduce(
+            flat, a, mesh.shape[a], ec.cc, key=k
+        )
+    return flat.reshape(x.shape).astype(x.dtype)

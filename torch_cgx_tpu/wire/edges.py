@@ -1,0 +1,265 @@
+"""Per-edge compression registry.
+
+Generalization of the reference's per-layer config registry
+(``register_layer`` / the name-pattern registry in ``config.py``,
+ProcessGroupCGX.cc:837-857) from gradient layers to *wire edges*: every
+distinct traffic class the framework puts on the fabric is an edge kind,
+and a config is keyed by ``(edge_kind, name-pattern)`` — the same
+later-registration-wins regex semantics as the layer registry, and the
+same registry-version bumping, so every trace/layout/schedule cache that
+already keys on :func:`~torch_cgx_tpu.config.registry_version` re-derives
+when an edge config changes.
+
+Edge taxonomy (docs/COMPRESSION_GUIDE.md "Every wire, one dispatcher"):
+
+===================  ====================================================
+kind                 traffic
+===================  ====================================================
+``dp_grad``          data-parallel gradient allreduce (the reference's
+                     only wire; resolution feeds
+                     ``allreduce.resolve_leaf_config``)
+``moe_a2a``          MoE expert dispatch/combine ``all_to_all``
+``ring_kv``          sequence-parallel K/V traffic: ring-attention
+                     ``ppermute`` hops, Ulysses reshard ``all_to_all``
+``pp_act``           pipeline activation/cotangent ``ppermute`` hops
+``powersgd_factor``  PowerSGD P/Q factor reductions
+===================  ====================================================
+
+Resolution order for a non-``dp_grad`` edge ``(kind, name)``:
+
+1. the last registered ``(kind, pattern)`` whose pattern matches
+   ``name`` (zeros back-filled from the env default, like the layer
+   registry);
+2. the ``CGX_WIRE_BITS`` env default (every routed edge at that width);
+3. nothing — the edge sends raw (the dispatcher lowers to the plain
+   ``lax`` collective).
+
+``dp_grad`` entries skip step 2 (their env default remains
+``CGX_COMPRESSION_QUANTIZATION_BITS``) and are consulted by
+``allreduce.resolve_leaf_config`` ahead of the name-pattern registry.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Callable, Dict, List, Optional, Tuple
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+
+EDGE_DP_GRAD = "dp_grad"
+EDGE_MOE_A2A = "moe_a2a"
+EDGE_RING_KV = "ring_kv"
+EDGE_PP_ACT = "pp_act"
+EDGE_POWERSGD_FACTOR = "powersgd_factor"
+
+EDGE_KINDS = (
+    EDGE_DP_GRAD,
+    EDGE_MOE_A2A,
+    EDGE_RING_KV,
+    EDGE_PP_ACT,
+    EDGE_POWERSGD_FACTOR,
+)
+
+# Peer compressors the dispatcher can put behind an edge (max-min
+# quantization is the default; PowerSGD low-rank and top-k sparsification
+# ride the same surface — docs/COMPRESSION_GUIDE.md).
+COMPRESSOR_QUANTIZE = "quantize"
+COMPRESSOR_POWERSGD = "powersgd"
+COMPRESSOR_TOPK = "topk"
+COMPRESSORS = (COMPRESSOR_QUANTIZE, COMPRESSOR_POWERSGD, COMPRESSOR_TOPK)
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeConfig:
+    """One edge's wire treatment.
+
+    ``cc`` — the max-min quantization config (``bits``/``bucket_size``/
+    stochastic, zeros back-filled from the env default at resolution).
+    ``compressor`` — which scheme ships the payload: "quantize" (the
+    codec), "powersgd" (rank-``rank`` low-rank factors, p2p edges only),
+    or "topk" (the ``ratio`` largest-magnitude coordinates as
+    index/value pairs, p2p edges only).
+    ``error_feedback`` — carry a per-edge residual for aggressive
+    bit-widths; callers thread the state explicitly
+    (``wire_ppermute(..., ef=...)`` — docs/COMPRESSION_GUIDE.md "EF on
+    wire edges").
+    """
+
+    cc: CompressionConfig = dataclasses.field(
+        default_factory=lambda: CompressionConfig(bits=0, bucket_size=0)
+    )
+    compressor: str = COMPRESSOR_QUANTIZE
+    error_feedback: bool = False
+    rank: int = 4  # powersgd
+    ratio: float = 0.01  # topk
+
+    def __post_init__(self):
+        if self.compressor not in COMPRESSORS:
+            raise ValueError(
+                f"unknown edge compressor {self.compressor!r}; expected one "
+                f"of {COMPRESSORS}"
+            )
+        if self.rank < 1:
+            raise ValueError(f"powersgd rank must be >= 1, got {self.rank}")
+        if not 0.0 < self.ratio < 1.0:
+            raise ValueError(
+                f"topk ratio must be in (0, 1), got {self.ratio!r}"
+            )
+
+    def resolved(self) -> "EdgeConfig":
+        """Zeros back-filled from the env default (the layer registry's
+        ``merged_with_default`` semantics applied to the edge's cc)."""
+        return dataclasses.replace(
+            self, cc=self.cc.merged_with_default(
+                cfg_mod.default_compression_config()
+            )
+        )
+
+
+# (kind, pattern) -> EdgeConfig, insertion-ordered: later registrations win,
+# like the name-pattern layer registry.
+_edge_configs: Dict[Tuple[str, str], EdgeConfig] = {}
+
+# Resolution memo: edge resolution runs at trace time on hot paths (every
+# ring hop site, every pipeline build); (kind, name, registry version,
+# env default, wire bits) -> Optional[EdgeConfig]. Bounded implicitly —
+# the key space is the set of distinct edges, a handful per model.
+_resolve_cache: Dict[Tuple, Optional[EdgeConfig]] = {}
+
+# Reset hooks: owners of derived per-edge state (the controller's cadence,
+# user-registered EF zeroers) register a callable; reset_edge_state() runs
+# them all — the post-recovery analogue of allreduce.reset_qerr_sampling
+# (a stale edge cadence after a reconfigure mirrors the PR 6 qerr bug).
+_reset_hooks: List[Callable[[], None]] = []
+
+
+def _check_kind(kind: str) -> None:
+    if kind not in EDGE_KINDS:
+        raise ValueError(
+            f"unknown edge kind {kind!r}; expected one of {EDGE_KINDS}"
+        )
+
+
+def set_edge_config(kind: str, pattern: str, config: EdgeConfig) -> None:
+    """Register an edge config for every edge of ``kind`` whose name
+    matches ``pattern`` (regex via ``re.search``; later registrations
+    win). Bumps the config registry version — every cached trace/layout
+    keyed on it re-derives, so the new bits take effect on the next
+    step."""
+    _check_kind(kind)
+    re.compile(pattern)  # validate eagerly
+    if not isinstance(config, EdgeConfig):
+        raise TypeError(
+            f"set_edge_config expects an EdgeConfig, got {type(config)!r}"
+        )
+    key = (kind, pattern)
+    # re-registration moves to the end (later wins), like dict re-insert
+    _edge_configs.pop(key, None)
+    _edge_configs[key] = config
+    _resolve_cache.clear()
+    cfg_mod._bump_registry_version()
+
+
+def resolve_edge(kind: str, name: str) -> Optional[EdgeConfig]:
+    """The config this edge sends under, or None (raw wire).
+
+    Registered ``(kind, pattern)`` entries win (last match), then the
+    ``CGX_WIRE_BITS`` env default for non-``dp_grad`` kinds. The result
+    is env-back-filled (:meth:`EdgeConfig.resolved`)."""
+    _check_kind(kind)
+    key = (
+        kind,
+        name,
+        cfg_mod.registry_version(),
+        cfg_mod.default_compression_config(),
+        cfg_mod.wire_default_bits(),
+    )
+    if key in _resolve_cache:
+        return _resolve_cache[key]
+    match: Optional[EdgeConfig] = None
+    for (k, pattern), ec in _edge_configs.items():
+        if k == kind and re.search(pattern, name):
+            match = ec
+    if match is None and kind != EDGE_DP_GRAD:
+        bits = cfg_mod.wire_default_bits()
+        if bits:
+            match = EdgeConfig(cc=CompressionConfig(bits=bits, bucket_size=0))
+    out = match.resolved() if match is not None else None
+    _resolve_cache[key] = out
+    return out
+
+
+def resolve_dp_grad(path: str) -> Optional[CompressionConfig]:
+    """dp_grad resolution hook for ``allreduce.resolve_leaf_config``: a
+    registered dp_grad edge matching this leaf path wins over the legacy
+    name-pattern registry; None falls through to it. Only the quantize
+    compressor applies on the allreduce plane (PowerSGD/top-k gradients
+    go through their own transforms).
+
+    Gated on the same ``CGX_WIRE`` engagement as every other edge kind —
+    "off: every edge sends raw" must mean dp_grad edge entries too, or
+    the knob cannot bisect a convergence problem (the legacy
+    name-pattern registry remains the ungated per-layer surface)."""
+    from . import dispatch as _dispatch
+
+    if not _dispatch.engaged():
+        return None
+    ec = resolve_edge(EDGE_DP_GRAD, path)
+    if ec is None or ec.compressor != COMPRESSOR_QUANTIZE:
+        return None
+    return ec.cc
+
+
+def registered_edges() -> List[Tuple[str, str, EdgeConfig]]:
+    """(kind, pattern, config) rows in registration order (tooling)."""
+    return [(k, p, ec) for (k, p), ec in _edge_configs.items()]
+
+
+def clear_edges() -> None:
+    """Drop every registered edge config (version bumped so cached
+    traces from the configured era can never be hit)."""
+    if _edge_configs:
+        _edge_configs.clear()
+        cfg_mod._bump_registry_version()
+    _resolve_cache.clear()
+
+
+def register_reset_hook(fn: Callable[[], None]) -> None:
+    """Register a zeroer for derived per-edge state (controller cadence,
+    EF stores); run by :func:`reset_edge_state`. Idempotent on identity."""
+    if fn not in _reset_hooks:
+        _reset_hooks.append(fn)
+
+
+def reset_edge_state(reason: str = "reset") -> None:
+    """Clear DERIVED per-edge state — the resolution memo, the
+    dispatcher's numel/bits side table, and every registered reset hook
+    (controller cadence, EF zeroers) — WITHOUT touching the registered
+    configs. Called by ``supervisor.invalidate_trace_caches`` after a
+    recovery reconfiguration (a stale edge cadence would mirror the PR 6
+    qerr-cadence bug) and by ``config.reset_registries``."""
+    import sys as _sys
+
+    _resolve_cache.clear()
+    disp = _sys.modules.get("torch_cgx_tpu.wire.dispatch")
+    if disp is not None:
+        disp.reset_edge_tables()
+    for fn in list(_reset_hooks):
+        fn()
+    from ..utils.logging import metrics
+
+    metrics.add("cgx.wire.state_resets")
+    from ..utils.logging import get_logger
+
+    get_logger().info("wire edge state reset (%s)", reason)
+
+
+def cache_key_component() -> Tuple:
+    """The wire plane's contribution to trace/layout cache keys: the
+    engagement mode and env-default bits (registered-config changes are
+    covered by the registry version those keys already carry). A
+    ``CGX_WIRE``/``CGX_WIRE_BITS`` flip must retrace, never serve a
+    staged program from another wire era."""
+    return (cfg_mod.wire_mode(), cfg_mod.wire_default_bits())
