@@ -1,0 +1,204 @@
+"""Codec autotune cache: hit/miss accounting, on-disk persistence,
+invalidation (incl. supervisor.invalidate_trace_caches), mode gating,
+and that tuned entries actually steer the kernels without changing
+bytes."""
+
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torch_cgx_tpu.ops import autotune, codec_pallas
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memo():
+    autotune.invalidate("test setup")
+    yield
+    autotune.invalidate("test teardown")
+
+
+def _tuned_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("CGX_AUTOTUNE_DIR", str(tmp_path))
+    return tmp_path
+
+
+def test_lookup_miss_counts_and_returns_none(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    ) is None
+    s = autotune.stats()
+    assert s["misses"] == 1 and s["hits"] == 0
+
+
+def test_record_then_hit(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=4, pack="butterfly"),
+        n_chunks=64, bucket_size=512, bits=4,
+    )
+    hit = autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    )
+    assert hit is not None and hit.tc == 4 and hit.pack == "butterfly"
+    assert autotune.stats()["hits"] == 1
+    # A different shape is a different key.
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=128, bucket_size=512, bits=4
+    ) is None
+
+
+def test_persistence_across_invalidation(tmp_path, monkeypatch):
+    """record() persists to disk; invalidate() drops the memo; the next
+    lookup reloads the persisted entry (a fresh process would too)."""
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.record(
+        autotune.KIND_EPILOGUE, autotune.TunedConfig(tc=2, db=True),
+        n_chunks=8, bucket_size=512, bits=4, ws=4,
+    )
+    path = autotune.cache_path()
+    assert path.exists()
+    autotune.invalidate("simulated restart")
+    assert autotune.stats()["hits"] == 0
+    hit = autotune.lookup(
+        autotune.KIND_EPILOGUE, n_chunks=8, bucket_size=512, bits=4, ws=4
+    )
+    assert hit is not None and hit.tc == 2 and hit.db is True
+    assert autotune.stats()["loads"] == 1
+
+
+def test_supervisor_invalidate_trace_caches_drops_memo(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=8),
+        n_chunks=32, bucket_size=512, bits=4, persist=False,
+    )
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=32, bucket_size=512, bits=4
+    ) is not None
+    from torch_cgx_tpu.robustness import supervisor
+
+    supervisor.invalidate_trace_caches()
+    # persist=False: the entry lived only in the memo — gone now.
+    assert autotune.stats()["hits"] == 0
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=32, bucket_size=512, bits=4
+    ) is None
+
+
+def test_mode_off_never_consults(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=4),
+        n_chunks=64, bucket_size=512, bits=4,
+    )
+    monkeypatch.setenv("CGX_AUTOTUNE", "off")
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    ) is None
+
+
+def test_corrupt_cache_file_tolerated(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.cache_path().parent.mkdir(parents=True, exist_ok=True)
+    autotune.cache_path().write_text("{not json")
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    ) is None  # no raise
+    # and a half-valid document keeps its parseable entries
+    autotune.invalidate("reset")
+    doc = {"entries": {
+        "flat/c64/b512/q4/w0/ediv": {"tc": 4},
+        "garbage": {"tc": "x"},
+    }}
+    autotune.cache_path().write_text(json.dumps(doc))
+    hit = autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    )
+    assert hit is not None and hit.tc == 4
+
+
+def test_tune_skips_failing_candidates(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+
+    def measure(cand):
+        if cand.tc == 8:
+            raise RuntimeError("mosaic wedge")  # the tc=32 lesson
+        return 0.5 if cand.tc == 4 else 1.0
+
+    win = autotune.tune(
+        autotune.KIND_CHUNKS,
+        [autotune.TunedConfig(tc=t) for t in (2, 4, 8)],
+        measure,
+        n_chunks=64, bucket_size=512, bits=4, input_bytes=10**9,
+    )
+    assert win is not None and win.tc == 4 and win.gbps == pytest.approx(2.0)
+    assert autotune.lookup(
+        autotune.KIND_CHUNKS, n_chunks=64, bucket_size=512, bits=4
+    ).tc == 4
+
+
+def test_env_fingerprint_separates_encode_eras(tmp_path, monkeypatch):
+    _tuned_dir(tmp_path, monkeypatch)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=4),
+        n_chunks=64, bucket_size=512, bits=4,
+    )
+    monkeypatch.setenv("CGX_CODEC_ENCODE", "mul")
+    assert autotune.lookup(
+        autotune.KIND_FLAT, n_chunks=64, bucket_size=512, bits=4
+    ) is None
+
+
+def test_snap_to_divisor():
+    assert autotune.snap_to_divisor(16, 48, 64) == 16
+    assert autotune.snap_to_divisor(10, 48, 64) == 8
+    assert autotune.snap_to_divisor(100, 48, 7) == 6
+    assert autotune.snap_to_divisor(0, 48, 64) == 1
+
+
+def test_tuned_tc_steers_kernel_without_changing_bytes(tmp_path, monkeypatch):
+    """A tuned flat-kernel tile changes the grid, never the wire: the
+    deterministic payload is tc-invariant (packing is per-chunk)."""
+    _tuned_dir(tmp_path, monkeypatch)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2, 4 * 32 * 512)), jnp.float32)
+    q_default = codec_pallas.quantize_batch(x, 4, 512, interpret=True)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=2),
+        n_chunks=8, bucket_size=512, bits=4,
+    )
+    assert codec_pallas._pipe_tc(
+        8, 512,
+        autotune.lookup(
+            autotune.KIND_FLAT, n_chunks=8, bucket_size=512, bits=4
+        ),
+    ) == 2
+    q_tuned = codec_pallas.quantize_batch(x, 4, 512, interpret=True)
+    assert bool(jnp.array_equal(q_default.packed, q_tuned.packed))
+    assert bool(jnp.array_equal(q_default.meta, q_tuned.meta))
+
+
+def test_tuned_db_engages_double_buffer(tmp_path, monkeypatch):
+    """CGX_PALLAS_DB=auto engages the DB lowering iff a tuned entry for
+    the shape says it measured faster — bytes identical either way."""
+    _tuned_dir(tmp_path, monkeypatch)
+    assert not codec_pallas._use_db(None)
+    assert codec_pallas._use_db(autotune.TunedConfig(tc=4, db=True))
+    monkeypatch.setenv("CGX_PALLAS_DB", "off")
+    assert not codec_pallas._use_db(autotune.TunedConfig(tc=4, db=True))
+    monkeypatch.setenv("CGX_PALLAS_DB", "on")
+    assert codec_pallas._use_db(None)
+    monkeypatch.delenv("CGX_PALLAS_DB")
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((1, 4 * 32 * 512)), jnp.float32)
+    q_grid = codec_pallas.quantize_batch(x, 4, 512, interpret=True)
+    autotune.record(
+        autotune.KIND_FLAT, autotune.TunedConfig(tc=2, db=True),
+        n_chunks=4, bucket_size=512, bits=4,
+    )
+    q_db = codec_pallas.quantize_batch(x, 4, 512, interpret=True)
+    assert bool(jnp.array_equal(q_grid.packed, q_db.packed))
+    assert bool(jnp.array_equal(q_grid.meta, q_db.meta))

@@ -724,3 +724,121 @@ def test_fused_epilogue_tpu():
     np.testing.assert_array_equal(
         np.asarray(q_ref.meta, np.float32), np.asarray(q_f.meta, np.float32)
     )
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered manual-DMA lowerings (CGX_PALLAS_DB) + int8 epilogue
+# accumulation (CGX_SRA_ACCUM) — codec roofline round 2.
+# ---------------------------------------------------------------------------
+
+
+def _db_case(rng, rows=2, chunks=4, bucket=512):
+    return jnp.asarray(
+        rng.standard_normal((rows, chunks * 32 * bucket)), jnp.float32
+    )
+
+
+@pytest.mark.parametrize("bits", [1, 4, 8])
+def test_db_quantize_bytes_match_grid(bits, monkeypatch):
+    """CGX_PALLAS_DB=on: the manual-DMA quantize emits byte-identical
+    words/meta to the grid kernel (per-block math is shared)."""
+    xs = _db_case(np.random.default_rng(21))
+    q_grid = codec_pallas.quantize_batch(xs, bits, 512, interpret=True)
+    monkeypatch.setenv("CGX_PALLAS_DB", "on")
+    q_db = codec_pallas.quantize_batch(xs, bits, 512, interpret=True)
+    np.testing.assert_array_equal(
+        np.asarray(q_grid.packed), np.asarray(q_db.packed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(q_grid.meta), np.asarray(q_db.meta)
+    )
+
+
+def test_db_dequantize_and_fused_add_match_grid(monkeypatch):
+    rng = np.random.default_rng(22)
+    xs = _db_case(rng)
+    q = codec_pallas.quantize_batch(xs, 4, 512, interpret=True)
+    acc = jnp.asarray(rng.standard_normal(xs.shape), jnp.float32)
+    d_grid = codec_pallas.dequantize_batch(q, interpret=True)
+    a_grid = codec_pallas.dequantize_batch(q, add_to=acc, interpret=True)
+    monkeypatch.setenv("CGX_PALLAS_DB", "on")
+    d_db = codec_pallas.dequantize_batch(q, interpret=True)
+    a_db = codec_pallas.dequantize_batch(q, add_to=acc, interpret=True)
+    np.testing.assert_array_equal(np.asarray(d_grid), np.asarray(d_db))
+    np.testing.assert_array_equal(np.asarray(a_grid), np.asarray(a_db))
+
+
+def test_db_epilogue_bytes_match_grid(monkeypatch):
+    ws, bits, bucket = 4, 4, 512
+    rng = np.random.default_rng(23)
+    xs = jnp.asarray(
+        rng.standard_normal((ws, 2 * 32 * bucket)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    own = jnp.int32(1)
+    e_grid = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[1], own_idx=own, interpret=True
+    )
+    monkeypatch.setenv("CGX_PALLAS_DB", "on")
+    e_db = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[1], own_idx=own, interpret=True
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e_grid.packed), np.asarray(e_db.packed)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(e_grid.meta, np.float32),
+        np.asarray(e_db.meta, np.float32),
+    )
+
+
+def test_db_auto_is_inert_without_tuned_entry():
+    """auto (default) never engages the DB lowering unless a persisted
+    autotune entry measured it faster on this chip."""
+    assert not codec_pallas._use_db(None)
+
+
+def test_int8_accum_envelope(monkeypatch):
+    """CGX_SRA_ACCUM=int8: the fixed-point peer-row fold stays within the
+    documented envelope of the exact f32 fold — per-row unit snap error
+    <= U/2^13 * maxlvl, summed over ws rows."""
+    ws, bits, bucket = 4, 4, 512
+    rng = np.random.default_rng(24)
+    xs = jnp.asarray(
+        rng.standard_normal((ws, 2 * 32 * bucket)), jnp.float32
+    )
+    q = codec_pallas.quantize_batch(xs, bits, bucket, interpret=True)
+    own = jnp.int32(2)
+    exact = codec_pallas.reduce_rows_batch(
+        q, raw_row=xs[2], own_idx=own, interpret=True
+    )
+    monkeypatch.setenv("CGX_SRA_ACCUM", "int8")
+    fixed = codec_pallas.reduce_rows_batch(
+        q, raw_row=xs[2], own_idx=own, interpret=True
+    )
+    units = np.asarray(q.meta, np.float32)[..., 0]
+    bound = ws * units.max() * ((1 << bits) - 1) / (1 << 13) + 1e-6
+    err = np.max(np.abs(np.asarray(exact) - np.asarray(fixed)))
+    assert err <= bound, (err, bound)
+    # and the requantizing epilogue still produces a decodable payload
+    q2 = codec_pallas.sra_epilogue_batch(
+        q, raw_row=xs[2], own_idx=own, interpret=True
+    )
+    dec = codec_pallas.dequantize_batch(q2, interpret=True)
+    unit2 = np.abs(np.asarray(exact)).max() / ((1 << bits) - 1)
+    assert np.max(
+        np.abs(np.asarray(dec)[0] - np.asarray(exact))
+    ) <= 2 * unit2 + bound
+
+
+def test_int8_accum_constant_buckets_exact(monkeypatch):
+    """Constant buckets (unit 0) decode exactly under the int8 fold too —
+    the zero-unit guard must not poison the fixed-point scales."""
+    ws, bucket = 4, 512
+    xs = jnp.tile(
+        jnp.asarray([[1.5]], jnp.float32), (ws, 32 * bucket)
+    )
+    q = codec_pallas.quantize_batch(xs, 4, bucket, interpret=True)
+    monkeypatch.setenv("CGX_SRA_ACCUM", "int8")
+    red = codec_pallas.reduce_rows_batch(q, interpret=True)
+    np.testing.assert_allclose(np.asarray(red), ws * 1.5, rtol=1e-6)

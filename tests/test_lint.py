@@ -686,3 +686,64 @@ def test_linter_accepts_wire_metric_subnamespace(tmp_path):
     proc_bad = _run_lint(bad)
     assert proc_bad.returncode == 1
     assert "wier" in proc_bad.stdout
+
+
+def test_linter_flags_f32_intermediate_in_epilogue_kernel(tmp_path):
+    # Roofline round 2 (ISSUE 11 satellite): a fused-epilogue kernel body
+    # that inlines `.astype(jnp.float32)` on decoded peer rows
+    # re-materializes the full-width f32 intermediate the kernel exists to
+    # eliminate — the audited fold lives in _decode_accumulate only.
+    odir = tmp_path / "torch_cgx_tpu" / "ops"
+    odir.mkdir(parents=True)
+    bad = odir / "bad_kernel.py"
+    bad.write_text(
+        "import jax.numpy as jnp\n"
+        "def _sra_epilogue_v2_kernel(w_ref, out_ref):\n"
+        "    lvl = w_ref[:]\n"
+        "    out_ref[:] = lvl.astype(jnp.float32) * 2.0\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "fused-epilogue kernel body" in proc.stdout
+
+
+def test_linter_allows_staged_epilogue_oracle_and_helpers(tmp_path):
+    odir = tmp_path / "torch_cgx_tpu" / "ops"
+    odir.mkdir(parents=True)
+    good = odir / "good_kernel.py"
+    good.write_text(
+        "import jax.numpy as jnp\n"
+        # _staged-suffixed oracle: the documented escape hatch.
+        "def _sra_epilogue_staged_kernel(w_ref, out_ref):\n"
+        "    out_ref[:] = w_ref[:].astype(jnp.float32)\n"
+        # helpers outside kernel bodies are the audited conversion sites
+        "def _decode_accumulate(words):\n"
+        "    return words.astype(jnp.float32)\n"
+        # int-domain kernel body: no f32 materialization — clean
+        "def _reduce_rows_v2_kernel(w_ref, out_ref):\n"
+        "    out_ref[:] = _decode_accumulate(w_ref[:])\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_codec_metric_namespace(tmp_path):
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.codec.autotune_hits')\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.codecs.autotune_hits')\n"  # typo'd family
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "undocumented cgx sub-namespace" in proc.stdout

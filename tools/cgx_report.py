@@ -242,6 +242,29 @@ def summarize(data: dict) -> dict:
             "controller_bits": ctl_bits,
             "counters": wire_counters,
         }
+    # Codec plane: autotune cache efficiency + producer-fuse consumption
+    # (counters summed across ranks) and the measured roofline fraction
+    # (a gauge — max across ranks, like the controller bit levels; a
+    # hardware session watches this converge toward 1.0).
+    codec_counters = {
+        k: v for k, v in totals.items()
+        if k.startswith("cgx.codec.") and k != "cgx.codec.roofline_frac"
+    }
+    roofline = 0.0
+    for per_rank in rank_counters.values():
+        roofline = max(
+            roofline, per_rank.get("cgx.codec.roofline_frac", 0.0)
+        )
+    if codec_counters or roofline:
+        hits = codec_counters.get("cgx.codec.autotune_hits", 0.0)
+        misses = codec_counters.get("cgx.codec.autotune_misses", 0.0)
+        summary["codec"] = {
+            "autotune_hit_rate": (
+                round(hits / (hits + misses), 3) if hits + misses else None
+            ),
+            "roofline_frac": round(roofline, 4) if roofline else None,
+            "counters": codec_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -351,6 +374,20 @@ def render(summary: dict) -> str:
             for label, b in sorted(w["controller_bits"].items()):
                 parts.append(f"    {label}: {int(b)}")
         for k, v in sorted(w.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("codec"):
+        c = summary["codec"]
+        parts.append("\n== codec (kernel autotune + producer fuse) ==")
+        if c.get("autotune_hit_rate") is not None:
+            parts.append(
+                f"  autotune cache hit rate: {c['autotune_hit_rate']:.1%}"
+            )
+        if c.get("roofline_frac"):
+            parts.append(
+                "  measured quantize roofline fraction: "
+                f"{c['roofline_frac']:.1%}"
+            )
+        for k, v in sorted(c.get("counters", {}).items()):
             parts.append(f"  {k}: {v:g}")
     # cgx.recovery.* counters are NOT repeated here — the recovery
     # section above is their home.

@@ -8,7 +8,7 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  atune$  roofl  straggler  gen  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
@@ -25,6 +25,13 @@ dumps — and renders one row per rank:
 * **sched$** — schedule-cache hit rate ``hits/(hits+misses)`` from the
   ``cgx.sched.cache_*`` counters (a low rate mid-run means plans are
   being re-derived — churning configs or an invalidation storm).
+* **atune$** — codec-autotune cache hit rate from the
+  ``cgx.codec.autotune_*`` counters (``-`` until the tuner is
+  consulted; climbs as the persisted per-chip cache warms).
+* **roofl** — measured quantize roofline fraction (the
+  ``cgx.codec.roofline_frac`` gauge ``bench.py --codec-roofline``
+  publishes): how close the codec kernels sit to the chip's HBM
+  roofline, live, so a hardware session can watch tuning converge.
 * **straggler** — the health engine's worst per-peer skew score as
   ``score→peer`` (needs CGX_HEALTH on the ranks).
 * **gen** — the recovery generation gauge (``cgx.recovery.generation``).
@@ -227,6 +234,26 @@ def _sched_cache(m: Dict[str, float]) -> str:
     return f"{hits / total * 100:.0f}%"
 
 
+def _autotune_cache(m: Dict[str, float]) -> str:
+    """Codec autotune cache hit rate (``cgx.codec.autotune_*``) — a
+    hardware session watches this climb as the persisted per-chip cache
+    warms; ``-`` while the tuner is off / unconsulted."""
+    hits = m.get("cgx.codec.autotune_hits", 0.0)
+    misses = m.get("cgx.codec.autotune_misses", 0.0)
+    total = hits + misses
+    if not total:
+        return "-"
+    return f"{hits / total * 100:.0f}%"
+
+
+def _roofline(m: Dict[str, float]) -> str:
+    """Measured quantize roofline fraction (the ``cgx.codec.
+    roofline_frac`` gauge ``bench.py --codec-roofline`` publishes) —
+    the convergence number of the kernel-tuning story."""
+    v = m.get("cgx.codec.roofline_frac", 0.0)
+    return f"{v:.2f}" if v else "-"
+
+
 def _straggler(status: Optional[dict]) -> str:
     scores = (status or {}).get("straggler_scores") or {}
     if not scores:
@@ -252,8 +279,8 @@ def render(directory: str, state: dict) -> str:
         f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
-               "edges", "overlap", "sched$", "straggler", "gen",
-               "last_fault")
+               "edges", "overlap", "sched$", "atune$", "roofl",
+               "straggler", "gen", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -267,6 +294,8 @@ def render(directory: str, state: dict) -> str:
             _edge_wire(m),
             _overlap(m),
             _sched_cache(m),
+            _autotune_cache(m),
+            _roofline(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
             _last_fault(d["last_fault"]),

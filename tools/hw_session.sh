@@ -6,6 +6,17 @@
 #
 #   bash tools/hw_session.sh            # full program (~15-25 min)
 #   bash tools/hw_session.sh quick      # sweep only, no tests/bench
+#   bash tools/hw_session.sh full fresh # ignore completion markers
+#
+# RESUMABLE (round-11 lesson — the BENCH_r05 incident class): every step
+# that completes drops a marker under .hw_session_state/, and each
+# variant's results land in BENCH_LOG.jsonl the moment its step exits —
+# so when flaky device transport kills a session mid-sweep, re-running
+# the same command SKIPS the finished variants and continues from the
+# first incomplete one instead of re-burning (and possibly re-wedging)
+# the transport on measurements we already hold. Markers are cleared
+# automatically after a fully-clean session; pass `fresh` as the second
+# argument to discard them and measure everything again.
 #
 # Round-5 lesson (2026-07-31 session): a step killed MID-DEVICE-OP (the
 # tc=32 Mosaic compile hung past its timeout) wedged the remote transport
@@ -22,7 +33,11 @@
 set -u
 cd "$(dirname "$0")/.."
 mode="${1:-full}"
+STATE_DIR=".hw_session_state"
+if [ "${2:-}" = fresh ]; then rm -rf "$STATE_DIR"; fi
+mkdir -p "$STATE_DIR"
 log() { printf '\n=== %s (%s) ===\n' "$1" "$(date +%T)"; }
+slug() { printf '%s' "$1" | tr -c 'A-Za-z0-9._-' '_'; }
 
 probe() {  # cheap transport health check (fresh process, tiny compile)
   # stderr goes to a file, shown only on failure: a quiet success, but a
@@ -42,16 +57,28 @@ print('probe: transport ok')" 2>/tmp/cgx_probe_err.$$
 
 FAILED=0
 run_cpu() {  # run_cpu <timeout-s> <desc> <cmd...> — CPU-pinned steps: never
-  log "$2"   # probe the (possibly wedged) device transport on failure
-  timeout --kill-after=30 "$1" "${@:3}"
-  rc=$?
-  if [ $rc -ne 0 ]; then echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1)); fi
-  return 0
-}
-run() {  # run <timeout-s> <desc> <cmd...> — device steps
+  local mark="$STATE_DIR/$(slug "$2").done"  # probe the device on failure
+  if [ -f "$mark" ]; then
+    log "$2 — completed in a previous pass, skipping (rm $mark to redo)"
+    return 0
+  fi
   log "$2"
   timeout --kill-after=30 "$1" "${@:3}"
   rc=$?
+  if [ $rc -ne 0 ]; then echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1));
+  else touch "$mark"; fi
+  return 0
+}
+run() {  # run <timeout-s> <desc> <cmd...> — device steps
+  local mark="$STATE_DIR/$(slug "$2").done"
+  if [ -f "$mark" ]; then
+    log "$2 — completed in a previous pass, skipping (rm $mark to redo)"
+    return 0
+  fi
+  log "$2"
+  timeout --kill-after=30 "$1" "${@:3}"
+  rc=$?
+  if [ $rc -eq 0 ]; then touch "$mark"; fi
   if [ $rc -ne 0 ]; then
     echo "STEP FAILED rc=$rc: $2"; FAILED=$((FAILED+1))
     # 124 = timeout TERM, 137 = timeout KILL: the step died mid-device-op.
@@ -98,6 +125,10 @@ session() {
   # The child probes for real chips itself and falls back to a forced CPU
   # multi-device platform, so this step never wedges the device transport.
   run 900 "wire edges compressed vs raw" python bench.py --wire --mb 8 --ws 4
+  # Codec roofline round 2 (ISSUE 11): quantize roofline fraction +
+  # producer-fused vs staged, with the autotune sweep persisting per-chip
+  # tile winners for every later run (ops/autotune.py cache).
+  run 900 "codec roofline + autotune" env CGX_AUTOTUNE=on python bench.py --codec-roofline --mb 64 --ws 4
   run 600 "current"               python tools/qbench.py current || return 1
   run 600 "dequant reference"     python tools/qbench.py dequant || return 1
   run 600 "sra epilogue fused"    python tools/qbench.py sra_epilogue || return 1
@@ -143,8 +174,14 @@ fi
 echo
 if [ $ABORTED -ne 0 ]; then
   echo "=== session ABORTED on wedged transport ($FAILED step(s) failed) ==="
+  echo "(completed variants are marked under $STATE_DIR — re-run the same"
+  echo " command to continue from the first incomplete step)"
+elif [ $FAILED -ne 0 ]; then
+  echo "=== session complete ($FAILED step(s) failed — markers kept; re-run"
+  echo "    to retry only the failed steps) ==="
 else
-  echo "=== session complete ($FAILED step(s) failed) ==="
+  echo "=== session complete (all steps passed) ==="
+  rm -rf "$STATE_DIR"
 fi
 echo "=== tail of BENCH_LOG.jsonl ==="
 tail -n 20 BENCH_LOG.jsonl 2>/dev/null

@@ -388,9 +388,12 @@ _METRIC_NAMESPACES = ("cgx.", "span.")
 # (`cgx.arena_pressure_waits`) and dynamic prefixes that stop at `cgx.`
 # stay uncheckable and pass.
 _METRIC_CGX_SUBNAMESPACES = frozenset({
-    "collective", "faults", "flightrec", "health", "heartbeat", "qerr",
-    "recovery", "ring", "runtime", "sched", "shm", "sra", "step", "trace",
-    "wire", "xla",
+    # "codec" joined with the roofline round-2 work (PR 11): the kernel
+    # autotuner (cgx.codec.autotune_*) and the producer-fused gradient
+    # quantizer (cgx.codec.producer_*) — docs/OBSERVABILITY.md.
+    "codec", "collective", "faults", "flightrec", "health", "heartbeat",
+    "qerr", "recovery", "ring", "runtime", "sched", "shm", "sra", "step",
+    "trace", "wire", "xla",
 })
 
 
@@ -517,6 +520,59 @@ def check_reducer_reduce_routing(path: Path, tree: ast.Module) -> list[str]:
                 "_staged/_unrolled if it IS the staged oracle",
             )
     return [flagged[k] for k in sorted(flagged)]
+
+
+# Fused-epilogue kernel bodies (names matching this pattern anywhere
+# under ops/) may never materialize a full-width f32 intermediate from
+# decoded peer rows: the audited f32 fold lives in ONE place —
+# ``codec_pallas._decode_accumulate`` (with ``_requant_cast``/
+# ``_raw4_cast`` for the small requantize-cast and raw-chunk reads) —
+# and the int8 fixed-point accumulation mode exists precisely so new
+# kernel code folds rows in the integer level domain. ``_reference``/
+# ``_staged``-suffixed functions are the suite's escape hatch, as in the
+# reducer-routing rule.
+_EPILOGUE_KERNEL_RE = r"(_sra_epilogue|_reduce_rows).*_kernel$"
+
+
+def check_epilogue_f32_intermediates(path: Path, tree: ast.Module) -> list[str]:
+    """Reject ``.astype(jnp.float32)`` (and bare ``float32``) calls inlined
+    into fused-epilogue kernel bodies in ops/ — decoded peer rows must
+    fold through ``_decode_accumulate`` (the one audited f32 conversion
+    site) or stay in the integer domain (``CGX_SRA_ACCUM=int8``)."""
+    import re as _re
+
+    if _LIB_DIR not in path.parts or "ops" not in path.parts:
+        return []
+    out: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not _re.search(_EPILOGUE_KERNEL_RE, node.name):
+            continue
+        if any(s in node.name for s in ("_reference", "_staged")):
+            continue
+        for n in ast.walk(node):
+            if not (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "astype"
+                and n.args
+            ):
+                continue
+            arg = n.args[0]
+            is_f32 = (
+                isinstance(arg, ast.Attribute) and arg.attr == "float32"
+            ) or (isinstance(arg, ast.Name) and arg.id == "float32")
+            if is_f32:
+                out.append(
+                    f"{path}:{n.lineno}: `.astype(float32)` inside fused-"
+                    f"epilogue kernel body {node.name!r} — full-width f32 "
+                    "intermediates on decoded peer rows belong in "
+                    "_decode_accumulate (the audited fold) or the int8 "
+                    "accumulation domain; suffix the function "
+                    "_reference/_staged if it IS the staged oracle"
+                )
+    return out
 
 
 _STAGED_PURE_MANIFEST = "xla_allreduce.py"
@@ -853,6 +909,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_library_hygiene(path, tree))
     out.extend(check_worker_timeline_coverage(path, tree))
     out.extend(check_reducer_reduce_routing(path, tree))
+    out.extend(check_epilogue_f32_intermediates(path, tree))
     out.extend(check_staged_purity(path, tree))
     out.extend(check_schedule_stage_blocking(path, tree))
     out.extend(check_wire_edge_routing(path, tree))

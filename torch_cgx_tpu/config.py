@@ -91,6 +91,13 @@ SCHED_CHUNKS = "CGX_SCHED_CHUNKS"  # pipeline depth (chunks per fusion slice)
 # activations and PowerSGD factors):
 WIRE = "CGX_WIRE"  # auto | on | off — edge-dispatcher engagement
 WIRE_BITS = "CGX_WIRE_BITS"  # env-default bits for unregistered edges
+# Codec roofline round 2 (ops/codec_pallas.py + ops/autotune.py +
+# ops/fused_producer.py — PR 11):
+PALLAS_DB = "CGX_PALLAS_DB"  # auto | on | off — double-buffered DMA kernels
+SRA_ACCUM = "CGX_SRA_ACCUM"  # exact | int8 — epilogue accumulation domain
+AUTOTUNE = "CGX_AUTOTUNE"  # auto | on | off — per-chip tile autotuner
+AUTOTUNE_DIR = "CGX_AUTOTUNE_DIR"  # on-disk autotune cache location
+PRODUCER_FUSE = "CGX_PRODUCER_FUSE"  # auto | on | off — fused grad quantize
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -431,6 +438,100 @@ def sra_epilogue_min_elems() -> int:
         SRA_EPILOGUE_MIN_ELEMS, DEFAULT_SRA_EPILOGUE_MIN_ELEMS
     )
     return max(v, 0)
+
+
+def pallas_db() -> str:
+    """CGX_PALLAS_DB: double-buffered manual-DMA lowering of the flat
+    Pallas codec kernels (quantize / dequantize / fused SRA epilogue):
+
+    * "auto" (default) — double-buffer only where a persisted autotune
+      entry for this chip says the DB lowering measured faster
+      (``ops/autotune.py``); with no tuned entry the grid kernels run
+      unchanged on every backend (tier-1 inertness, and no untested
+      Mosaic lowering ever engages on hardware by default — the
+      BENCH_r05 wedge lesson).
+    * "on" — force the DB kernels anywhere they geometrically apply
+      (interpret mode included — the byte-parity test knob).
+    * "off" — never; the grid kernels run unchanged.
+
+    Deterministic wire bytes are identical between the two lowerings (the
+    per-block math is op-for-op the grid kernel; asserted in
+    tests/test_codec_pallas.py); stochastic draws reseed per block with
+    the block index exactly like the grid's ``program_id`` seeding, so
+    stochastic bytes match too."""
+    mode = _env.get_str_env_or_default(PALLAS_DB, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{PALLAS_DB} must be auto|on|off, got {mode!r}")
+    return mode
+
+
+def sra_accum() -> str:
+    """CGX_SRA_ACCUM: accumulation domain of the fused SRA epilogue's
+    peer-row fold (``codec_pallas._sra_epilogue_kernel``):
+
+    * "exact" (default) — the audited f32 fold (decode each peer row,
+      ``v0 + v1 + ...`` ascending): bit-identical wire bytes vs the
+      staged reference lowering.
+    * "int8" — fixed-point fold: peer rows stay in the int8 level domain
+      and accumulate as ``sum_r lvl_r * s_r`` in int32, where ``s_r`` is
+      the row's per-bucket unit snapped to a 12-fraction-bit fixed-point
+      multiple of the block's max unit — ONE f32 conversion per block
+      instead of one per peer row, and no full-width f32 peer-row
+      intermediate. Wire bytes differ from "exact" within a bounded
+      envelope (unit error <= U/2^13 per row — far inside the
+      quantization envelope; tested); all devices in a program share one
+      mode, so reducer error symmetry holds. Opt-in, like
+      ``CGX_CODEC_ENCODE=mul``."""
+    mode = _env.get_str_env_or_default(SRA_ACCUM, "exact").lower()
+    if mode not in ("exact", "int8"):
+        raise ValueError(f"{SRA_ACCUM} must be exact|int8, got {mode!r}")
+    return mode
+
+
+def autotune_mode() -> str:
+    """CGX_AUTOTUNE: the per-chip codec tile autotuner (``ops/autotune.py``):
+
+    * "auto" (default) — consult the persisted on-disk cache when an
+      entry exists for this (kernel, shape, bits, bucket, chip); fall
+      back to the static heuristics otherwise. Never measures. With no
+      cache file present this is fully inert (the heuristics run
+      unchanged — the tier-1 inertness contract).
+    * "on" — additionally measure-and-persist a missing entry the first
+      time a kernel shape is dispatched on a real device (a short timed
+      sweep per shape; intended for hardware sessions, not CI).
+    * "off" — never consult or measure; static heuristics only."""
+    mode = _env.get_str_env_or_default(AUTOTUNE, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{AUTOTUNE} must be auto|on|off, got {mode!r}")
+    return mode
+
+
+def autotune_dir() -> Optional[str]:
+    """CGX_AUTOTUNE_DIR: directory of the persisted autotune cache
+    (``autotune-<chip-slug>.json``). Unset = ``~/.cache/torch_cgx_tpu``."""
+    v = _env.get_str_env_or_default(AUTOTUNE_DIR, "")
+    return v or None
+
+
+def producer_fuse() -> str:
+    """CGX_PRODUCER_FUSE: producer-fused gradient quantization
+    (``ops/fused_producer.py``) — the backward matmul of a wrapped dense
+    layer emits the layer's SRA stage-1 wire payload directly (already
+    bucketed, already packed), so the dp_grad enters the staged allreduce
+    as a QTensor and the f32 gradient never round-trips HBM:
+
+    * "auto" (default) — engage only on a real TPU backend; everywhere
+      else the wrapped layers lower to the plain matmul and the staged
+      programs stay BIT-IDENTICAL to the unwrapped code (jaxpr-pinned,
+      like ``CGX_WIRE``/``CGX_SCHEDULE``).
+    * "on" — engage on any backend (the CPU test/bench configuration;
+      the fused matmul+quantize kernel still requires aligned geometry,
+      with a compose fallback that quantizes the same values).
+    * "off" — never engage."""
+    mode = _env.get_str_env_or_default(PRODUCER_FUSE, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{PRODUCER_FUSE} must be auto|on|off, got {mode!r}")
+    return mode
 
 
 def bridge_device_codec() -> str:

@@ -16,6 +16,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .layers import CgxDense
+
 
 def dense_attention(q, k, v, *, causal: bool = True, mask=None):
     """(B, H, S, D) einsum attention on the MXU; f32 softmax.
@@ -57,7 +59,7 @@ class MultiHeadAttention(nn.Module):
     def __call__(self, x, mask=None, train: bool = True):
         h = self.n_head
         d_head = self.d_model // h
-        qkv = nn.Dense(3 * self.d_model, dtype=self.dtype, name="attn_qkv")(x)
+        qkv = CgxDense(3 * self.d_model, dtype=self.dtype, name="attn_qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         def heads(t):  # (B, S, D) -> (B, H, S, d)
@@ -69,7 +71,7 @@ class MultiHeadAttention(nn.Module):
         o = attn(heads(q), heads(k), heads(v), causal=self.causal, **kw)
         b, _, s, _ = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b, s, self.d_model)
-        o = nn.Dense(self.d_model, dtype=self.dtype, name="attn_proj")(o)
+        o = CgxDense(self.d_model, dtype=self.dtype, name="attn_proj")(o)
         if self.dropout:
             o = nn.Dropout(self.dropout, deterministic=not train)(o)
         return o
@@ -85,9 +87,9 @@ class Mlp(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = True):
-        y = nn.Dense(self.ratio * self.d_model, dtype=self.dtype, name="mlp_in")(x)
+        y = CgxDense(self.ratio * self.d_model, dtype=self.dtype, name="mlp_in")(x)
         y = nn.gelu(y)
-        y = nn.Dense(self.d_model, dtype=self.dtype, name="mlp_out")(y)
+        y = CgxDense(self.d_model, dtype=self.dtype, name="mlp_out")(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return y

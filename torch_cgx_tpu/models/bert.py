@@ -13,6 +13,7 @@ import jax
 import jax.numpy as jnp
 
 from .attention import Mlp, MultiHeadAttention
+from .layers import CgxDense
 
 
 @dataclasses.dataclass(frozen=True)
@@ -77,7 +78,7 @@ class Bert(nn.Module):
             x = BertLayer(cfg, name=f"layer_{i}")(x, mask=attention_mask,
                                                   train=train)
         # MLM head: transform + tied decoder
-        y = nn.Dense(cfg.d_model, dtype=cfg.dtype, name="mlm_transform")(x)
+        y = CgxDense(cfg.d_model, dtype=cfg.dtype, name="mlm_transform")(x)
         y = nn.gelu(y)
         y = nn.LayerNorm(dtype=jnp.float32, name="mlm_ln")(y)
         logits = y.astype(jnp.float32) @ wte.embedding.astype(jnp.float32).T

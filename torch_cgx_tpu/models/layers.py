@@ -1,0 +1,62 @@
+"""Shared dense layer for the model zoo, wired to the producer-fused
+gradient quantizer.
+
+:class:`CgxDense` is a drop-in for ``flax.linen.Dense`` — identical
+parameter structure (``kernel``/``bias``), initializers, dtype promotion
+and output values — whose kernel contraction routes through
+``ops.fused_producer.matmul``. With ``CGX_PRODUCER_FUSE`` off (the
+default on every non-TPU backend) that wrapper lowers to the bare cast +
+``lax.dot_general`` flax itself stages, so the model's jaxpr is
+bit-identical to the ``nn.Dense`` version (pinned in
+tests/test_fused_producer.py); engaged, the layer's backward emits the
+already-quantized SRA wire payload the compressed allreduce consumes
+directly (see the fused_producer module docstring).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+from flax.linen import dtypes as _dtypes
+
+from ..ops import fused_producer
+
+
+class CgxDense(nn.Module):
+    """``nn.Dense`` twin with a producer-fused kernel contraction."""
+
+    features: int
+    use_bias: bool = True
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    bias_init: Callable = nn.initializers.zeros_init()
+
+    @nn.compact
+    def __call__(self, x):
+        kernel = self.param(
+            "kernel", self.kernel_init, (x.shape[-1], self.features),
+            self.param_dtype,
+        )
+        bias = (
+            self.param("bias", self.bias_init, (self.features,),
+                       self.param_dtype)
+            if self.use_bias
+            else None
+        )
+        # nn.Dense's promote_dtype, with the KERNEL cast folded inside the
+        # wrapped matmul so the f32 cotangent leaf is the wrapper's own
+        # output (the stash's identity-match contract).
+        cd = _dtypes.canonicalize_dtype(x, kernel, bias, dtype=self.dtype)
+        x_p = x.astype(cd) if x.dtype != cd else x
+        y = fused_producer.matmul(
+            x_p, kernel,
+            name="/".join(self.path) + "/kernel",
+            compute_dtype=cd,
+        )
+        if bias is not None:
+            bias_p = bias.astype(cd) if bias.dtype != cd else bias
+            y = y + jnp.reshape(bias_p, (1,) * (y.ndim - 1) + (-1,))
+        return y

@@ -74,12 +74,27 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from . import codec
+from . import autotune, codec
+from .. import config as cfg_mod
 from ..utils import env as _env
 
 LANE_GROUP = codec.LANE_GROUP  # 32
 CHUNK_BUCKETS = codec.CHUNK_BUCKETS  # 32 buckets per sublane-packed chunk
 MAX_BUCKET_ELEMS = 16384  # VMEM guard for the (32, bucket) chunk tile
+
+
+def _use_db(tuned: "autotune.TunedConfig | None") -> bool:
+    """Whether the double-buffered manual-DMA lowering runs for a flat
+    kernel: ``CGX_PALLAS_DB=on`` forces it; "auto" engages only when a
+    persisted autotune entry for this chip measured the DB lowering
+    faster (never an untested Mosaic lowering by default — the BENCH_r05
+    wedge lesson); "off" never."""
+    mode = cfg_mod.pallas_db()
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    return bool(tuned is not None and tuned.db)
 
 
 def supports(n: int, bits: int, bucket_size: int, skip_incomplete: bool) -> bool:
@@ -96,23 +111,44 @@ def supports(n: int, bits: int, bucket_size: int, skip_incomplete: bool) -> bool
     )
 
 
-def _tile_chunks(n_chunks: int, bucket_size: int, bits: int) -> int:
+def _forced_tile_chunks() -> Optional[int]:
+    """The explicit CGX_PALLAS_TILE_CHUNKS override — strongest tier,
+    beating both the heuristic and any autotuned entry (the hardware
+    sweep's per-run knob must always win)."""
+    forced = _env.get_optional_str_env("CGX_PALLAS_TILE_CHUNKS")
+    if not forced:
+        return None
+    try:
+        tc = int(forced)
+    except ValueError:
+        tc = 0
+    if tc < 1:
+        raise ValueError(
+            f"CGX_PALLAS_TILE_CHUNKS must be a positive integer, got {forced!r}"
+        )
+    return tc
+
+
+def _tile_chunks(
+    n_chunks: int,
+    bucket_size: int,
+    bits: int,
+    tuned: "autotune.TunedConfig | None" = None,
+) -> int:
     """Chunks per grid step. Bounded so a block (x + levels + words + out)
     stays well inside VMEM; large tiles amortize per-step grid overhead.
-    Read from the UNJITTED public wrappers so the env override is honored
-    (and validated) on every call, then passed as a static argument."""
-    forced = _env.get_optional_str_env("CGX_PALLAS_TILE_CHUNKS")
-    if forced:
-        try:
-            tc = int(forced)
-        except ValueError:
-            tc = 0
-        if tc < 1:
-            raise ValueError(
-                f"CGX_PALLAS_TILE_CHUNKS must be a positive integer, got {forced!r}"
-            )
-        return tc
+    Resolution order: the CGX_PALLAS_TILE_CHUNKS override, then a
+    measured per-chip autotune entry (``tuned``, still VMEM-capped so a
+    stale cache can never stage an over-budget block), then the static
+    heuristic. Read from the UNJITTED public wrappers so the env override
+    is honored (and validated) on every call, then passed as a static
+    argument."""
+    forced = _forced_tile_chunks()
+    if forced is not None:
+        return forced
     cap = max(1, (1 << 19) // (CHUNK_BUCKETS * bucket_size))
+    if tuned is not None:
+        return int(max(1, min(tuned.tc, cap, max(1, n_chunks))))
     return int(min(16, cap, max(1, n_chunks)))
 
 
@@ -148,18 +184,23 @@ def _encode_lvl(x, bmin, safe, r, maxlvl, encode: str):
     ).astype(jnp.int32)
 
 
-def _pack_strategy() -> str:
+def _pack_strategy(tuned: "autotune.TunedConfig | None" = None) -> str:
     """Bit-plane pack lowering: ``sum`` (cross-sublane reduction of shifted
     bits — the default) or ``butterfly`` (log2(32) pairwise shift-OR folds).
     Both emit identical bytes (CPU-asserted in the suite); the knob exists
     so the faster lowering can be picked empirically per chip generation
-    without a code change."""
-    raw = (_env.get_optional_str_env("CGX_PALLAS_PACK") or "sum").lower()
-    if raw not in ("sum", "butterfly"):
+    without a code change. An explicit CGX_PALLAS_PACK wins; with the env
+    unset, a measured per-chip autotune entry (``tuned.pack``) is used."""
+    raw = (_env.get_optional_str_env("CGX_PALLAS_PACK") or "").lower()
+    if raw and raw not in ("sum", "butterfly"):
         raise ValueError(
             f"CGX_PALLAS_PACK={raw!r}: expected 'sum' or 'butterfly'"
         )
-    return raw
+    if raw:
+        return raw
+    if tuned is not None and tuned.pack in ("sum", "butterfly"):
+        return tuned.pack
+    return "sum"
 
 
 def _pack_planes(lvl, bits: int, sub_axis: int, strategy: str):
@@ -186,10 +227,15 @@ def _pack_planes(lvl, bits: int, sub_axis: int, strategy: str):
     return planes
 
 
-def _stochastic_r(seed_ref, shape):
+def _stochastic_r(seed_ref, shape, block_idx=None):
     """In-kernel U[0,1) rounding offsets from the hardware PRNG. Routed
-    through int32 because Mosaic lacks uint32->f32 (values stay < 2^24)."""
-    pltpu.prng_seed(seed_ref[0, 0] + pl.program_id(0))
+    through int32 because Mosaic lacks uint32->f32 (values stay < 2^24).
+    ``block_idx`` defaults to the grid step; the double-buffered kernels
+    pass their loop index instead — same per-block seed, same draw shape,
+    therefore bit-identical stochastic bytes across the two lowerings."""
+    if block_idx is None:
+        block_idx = pl.program_id(0)
+    pltpu.prng_seed(seed_ref[0, 0] + block_idx)
     rbits = pltpu.bitcast(pltpu.prng_random_bits(shape), jnp.uint32)
     return (rbits >> np.uint32(8)).astype(jnp.int32).astype(
         jnp.float32
@@ -239,11 +285,17 @@ def _dequantize_kernel(words_ref, meta_ref, out_ref, *, bits, tc):
     )
 
 
-def _pipe_tc(n_chunks: int, bucket_size: int) -> int:
+def _pipe_tc(
+    n_chunks: int,
+    bucket_size: int,
+    tuned: "autotune.TunedConfig | None" = None,
+) -> int:
     """Chunks per block for the flat fast path: the largest candidate within
     the VMEM cap that divides the total chunk count (the flat grid tiles all
-    rows' chunks as one contiguous sequence)."""
-    cap = _tile_chunks(n_chunks, bucket_size, 8)
+    rows' chunks as one contiguous sequence). A measured autotune entry
+    (``tuned.tc``) replaces the heuristic candidate, snapped to the same
+    divisibility/VMEM constraints."""
+    cap = _tile_chunks(n_chunks, bucket_size, 8, tuned)
     for tc in range(min(cap, n_chunks), 0, -1):
         if n_chunks % tc == 0:
             return tc
@@ -291,35 +343,19 @@ def _quantize_flat_impl(
     b = bucket_size
     rb = b // 128
     n_chunks = rows * m_pad // (CHUNK_BUCKETS * b)
-    maxlvl = np.float32((1 << bits) - 1)
 
     # Named (not a generic `kernel`) so jaxpr-level guards can count codec
     # invocations by kernel identity (test_reducers codec-invocation guard).
+    # The block math lives in _requantize_block — shared with the fused
+    # SRA epilogue's requantize and the DB lowering, so the wire contract
+    # cannot drift between them. (The rb sublane-group axis reduces FIRST
+    # in there — full-width elementwise folds before the cross-lane
+    # reduction; max/min are order-independent: bytes unchanged.)
     def _quantize_flat_kernel(seed_ref, x_ref, words_ref, meta_ref):
         x4 = x_ref[:].astype(jnp.float32).reshape(tc, CHUNK_BUCKETS, rb, 128)
-        # Reduce the rb (sublane-group) axis FIRST — full-width elementwise
-        # folds — so the expensive cross-lane reduction runs on rb x less
-        # data. Max/min are order-independent: bytes unchanged.
-        bmax = jnp.max(
-            jnp.max(x4, axis=2, keepdims=True), axis=3, keepdims=True
-        )
-        bmin = jnp.min(
-            jnp.min(x4, axis=2, keepdims=True), axis=3, keepdims=True
-        )
-        # Reciprocal-multiply like codec.compute_meta (byte-identity).
-        unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
-        safe = jnp.where(unit > 0, unit, np.float32(1.0))
-        r = _stochastic_r(seed_ref, x4.shape) if stochastic else np.float32(0.5)
-        lvl = _encode_lvl(x4, bmin, safe, r, maxlvl, encode)
-        planes = _pack_planes(lvl, bits, 1, pack)
-        # disjoint bits -> int32 wrap on the s=31 term is exact
-        words_ref[:] = jnp.stack(planes, axis=1).reshape(
-            tc * bits * rb, 128
-        )
-        meta_ref[:] = jnp.concatenate(
-            [unit.reshape(tc * CHUNK_BUCKETS, 1),
-             bmin.reshape(tc * CHUNK_BUCKETS, 1)],
-            axis=1,
+        words_ref[:], meta_ref[:] = _requantize_block(
+            x4, seed_ref, bits=bits, tc=tc, rb=rb, stochastic=stochastic,
+            pack=pack, encode=encode,
         )
 
     xv = xs.reshape(rows * m_pad // 128, 128)
@@ -422,6 +458,293 @@ def _dequantize_flat_impl(
         in_specs=in_specs,
         out_specs=pl.BlockSpec((s_rows, 128), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(
+            (n_chunks * CHUNK_BUCKETS * rb, 128), jnp.float32
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(rows, nb_r * b)
+
+
+# ---------------------------------------------------------------------------
+# Double-buffered manual-DMA lowerings (CGX_PALLAS_DB). The grid kernels
+# above lean on Mosaic's automatic block pipeline; these variants own the
+# whole HBM stream instead: ONE kernel invocation walks the blocks with
+# 2-slot VMEM scratch per stream, starting block k+1's input copy while
+# block k computes and letting block k's OUTPUT copy drain under block
+# k+1's compute — input and output DMA both overlap compute, which the
+# automatic pipeline cannot guarantee for multi-output kernels. The
+# per-block math is the SAME ``_requantize_block``/``_decode_accumulate``
+# helpers as the grid kernels (stochastic draws reseed per block index
+# exactly like the grid's ``program_id`` seeding), so wire bytes are
+# bit-identical between the two lowerings — asserted in interpret mode by
+# tests/test_codec_pallas.py.
+# ---------------------------------------------------------------------------
+
+
+def _slot_store(ref, slot, val):
+    """Predicated store into a 2-slot scratch (dynamic-index VMEM stores
+    are not guaranteed by Mosaic; two predicated static-slot stores are)."""
+
+    @pl.when(slot == 0)
+    def _():
+        ref[0] = val
+
+    @pl.when(slot != 0)
+    def _():
+        ref[1] = val
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "bits", "bucket_size", "stochastic", "interpret", "tc", "pack",
+        "encode",
+    ),
+)
+def _quantize_flat_db_impl(
+    xs: jax.Array,
+    seed: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    stochastic: bool,
+    interpret: bool = False,
+    tc: int = 8,
+    pack: str = "sum",
+    encode: str = "div",
+):
+    """Double-buffered sibling of :func:`_quantize_flat_impl` — same
+    contract, same wire bytes, manual in/out DMA pipeline."""
+    rows, m_pad = xs.shape
+    b = bucket_size
+    rb = b // 128
+    n_chunks = rows * m_pad // (CHUNK_BUCKETS * b)
+    nblk = n_chunks // tc
+    in_rows = tc * CHUNK_BUCKETS * rb
+    w_rows = tc * bits * rb
+    m_rows = tc * CHUNK_BUCKETS
+
+    def _quantize_flat_db_kernel(seed_ref, x_hbm, words_hbm, meta_hbm):
+        def body(xb, wb, mb, in_sem, w_sem, m_sem):
+            def in_dma(slot, i):
+                return pltpu.make_async_copy(
+                    x_hbm.at[pl.ds(i * in_rows, in_rows)], xb.at[slot],
+                    in_sem.at[slot],
+                )
+
+            def w_dma(slot, i):
+                return pltpu.make_async_copy(
+                    wb.at[slot], words_hbm.at[pl.ds(i * w_rows, w_rows)],
+                    w_sem.at[slot],
+                )
+
+            def m_dma(slot, i):
+                return pltpu.make_async_copy(
+                    mb.at[slot], meta_hbm.at[pl.ds(i * m_rows, m_rows)],
+                    m_sem.at[slot],
+                )
+
+            in_dma(0, 0).start()
+
+            def step(i, carry):
+                cur = i % 2
+
+                @pl.when(i + 1 < nblk)
+                def _():
+                    in_dma((i + 1) % 2, i + 1).start()
+
+                in_dma(cur, i).wait()
+
+                # This slot's block-(i-2) output copies must land before
+                # the scratch is overwritten.
+                @pl.when(i >= 2)
+                def _():
+                    w_dma(cur, i - 2).wait()
+                    m_dma(cur, i - 2).wait()
+
+                x4 = xb[cur].astype(jnp.float32).reshape(
+                    tc, CHUNK_BUCKETS, rb, 128
+                )
+                words, meta = _requantize_block(
+                    x4, seed_ref, bits=bits, tc=tc, rb=rb,
+                    stochastic=stochastic, pack=pack, encode=encode,
+                    block_idx=i,
+                )
+                _slot_store(wb, cur, words)
+                _slot_store(mb, cur, meta)
+                w_dma(cur, i).start()
+                m_dma(cur, i).start()
+                return carry
+
+            jax.lax.fori_loop(0, nblk, step, 0)
+            for j in range(max(0, nblk - 2), nblk):  # static drain
+                w_dma(j % 2, j).wait()
+                m_dma(j % 2, j).wait()
+
+        pl.run_scoped(
+            body,
+            xb=pltpu.VMEM((2, in_rows, 128), xs.dtype),
+            wb=pltpu.VMEM((2, w_rows, 128), jnp.int32),
+            mb=pltpu.VMEM((2, m_rows, 2), jnp.float32),
+            in_sem=pltpu.SemaphoreType.DMA((2,)),
+            w_sem=pltpu.SemaphoreType.DMA((2,)),
+            m_sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    xv = xs.reshape(rows * m_pad // 128, 128)
+    return pl.pallas_call(
+        _quantize_flat_db_kernel,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n_chunks * bits * rb, 128), jnp.int32),
+            jax.ShapeDtypeStruct((n_chunks * CHUNK_BUCKETS, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(seed.reshape(1, 1).astype(jnp.int32), xv)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("bits", "bucket_size", "interpret", "tc", "with_add"),
+)
+def _dequantize_flat_db_impl(
+    words: jax.Array,
+    meta: jax.Array,
+    add_to: Optional[jax.Array] = None,
+    *,
+    bits: int,
+    bucket_size: int,
+    interpret: bool = False,
+    tc: int = 8,
+    with_add: bool = False,
+):
+    """Double-buffered sibling of :func:`_dequantize_flat_impl` — same
+    contract (``with_add`` included), same values, manual DMA pipeline."""
+    rows, w_row = words.shape
+    b = bucket_size
+    rb = b // 128
+    nb_r = w_row * LANE_GROUP // (b * bits)
+    n_chunks = rows * nb_r // CHUNK_BUCKETS
+    nblk = n_chunks // tc
+    s_rows = tc * CHUNK_BUCKETS * rb
+    w_rows = tc * bits * rb
+    m_rows = tc * CHUNK_BUCKETS
+
+    def _dequantize_flat_db_kernel(w_hbm, m_hbm, *rest):
+        if with_add:
+            a_hbm, out_hbm = rest
+        else:
+            a_hbm, (out_hbm,) = None, rest
+
+        def body(wbuf, mbuf, abuf, obuf, w_sem, m_sem, a_sem, o_sem):
+            def w_dma(slot, i):
+                return pltpu.make_async_copy(
+                    w_hbm.at[pl.ds(i * w_rows, w_rows)], wbuf.at[slot],
+                    w_sem.at[slot],
+                )
+
+            def m_dma(slot, i):
+                return pltpu.make_async_copy(
+                    m_hbm.at[pl.ds(i * m_rows, m_rows)], mbuf.at[slot],
+                    m_sem.at[slot],
+                )
+
+            def a_dma(slot, i):
+                return pltpu.make_async_copy(
+                    a_hbm.at[pl.ds(i * s_rows, s_rows)], abuf.at[slot],
+                    a_sem.at[slot],
+                )
+
+            def o_dma(slot, i):
+                return pltpu.make_async_copy(
+                    obuf.at[slot], out_hbm.at[pl.ds(i * s_rows, s_rows)],
+                    o_sem.at[slot],
+                )
+
+            def start_in(slot, i):
+                w_dma(slot, i).start()
+                m_dma(slot, i).start()
+                if with_add:
+                    a_dma(slot, i).start()
+
+            start_in(0, 0)
+
+            def step(i, carry):
+                cur = i % 2
+
+                @pl.when(i + 1 < nblk)
+                def _():
+                    start_in((i + 1) % 2, i + 1)
+
+                w_dma(cur, i).wait()
+                m_dma(cur, i).wait()
+                if with_add:
+                    a_dma(cur, i).wait()
+
+                @pl.when(i >= 2)
+                def _():
+                    o_dma(cur, i - 2).wait()
+
+                sub = jax.lax.broadcasted_iota(
+                    jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
+                )
+                lvl = _decode_lvl(wbuf[cur], sub, bits=bits, tc=tc, rb=rb)
+                m2 = mbuf[cur]
+                unit = m2[:, 0:1].reshape(tc, CHUNK_BUCKETS, 1, 1)
+                bmin = m2[:, 1:2].reshape(tc, CHUNK_BUCKETS, 1, 1)
+                vals = (bmin + unit * lvl.astype(jnp.float32)).reshape(
+                    s_rows, 128
+                )
+                if with_add:
+                    vals = abuf[cur] + vals  # acc + decoded — the fused order
+                _slot_store(obuf, cur, vals)
+                o_dma(cur, i).start()
+                return carry
+
+            jax.lax.fori_loop(0, nblk, step, 0)
+            for j in range(max(0, nblk - 2), nblk):
+                o_dma(j % 2, j).wait()
+
+        scratch = dict(
+            wbuf=pltpu.VMEM((2, w_rows, 128), jnp.int32),
+            mbuf=pltpu.VMEM((2, m_rows, 2), jnp.float32),
+            # abuf unused without the fused add — keep it token-sized so
+            # the 2-slot output buffer gets the VMEM instead.
+            abuf=pltpu.VMEM(
+                (2, s_rows, 128) if with_add else (2, 8, 128), jnp.float32
+            ),
+            obuf=pltpu.VMEM((2, s_rows, 128), jnp.float32),
+            w_sem=pltpu.SemaphoreType.DMA((2,)),
+            m_sem=pltpu.SemaphoreType.DMA((2,)),
+            a_sem=pltpu.SemaphoreType.DMA((2,)),
+            o_sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+        pl.run_scoped(body, **scratch)
+
+    wv = words.reshape(rows * w_row // 128, 128)
+    mv = meta.reshape(rows * nb_r, 2)
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [wv, mv]
+    if with_add:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        operands.append(
+            add_to.astype(jnp.float32).reshape(rows * nb_r * b // 128, 128)
+        )
+    out = pl.pallas_call(
+        _dequantize_flat_db_kernel,
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
         out_shape=jax.ShapeDtypeStruct(
             (n_chunks * CHUNK_BUCKETS * rb, 128), jnp.float32
         ),
@@ -574,16 +897,25 @@ def quantize_batch(
         # 128-lane rows — the flat kernel reads the natural flat layout
         # straight from HBM, zero XLA relayout on either side. A plain
         # pallas_call, so it runs under CPU interpret mode too and the
-        # normal suite asserts its bytes against the XLA oracle.
-        words, meta = _quantize_flat_impl(
+        # normal suite asserts its bytes against the XLA oracle. The tile
+        # and pack lowering consult the per-chip autotune cache
+        # (ops/autotune.py); CGX_PALLAS_DB routes to the double-buffered
+        # manual-DMA sibling (same bytes).
+        tuned = autotune.lookup(
+            autotune.KIND_FLAT, n_chunks=rows * c_r, bucket_size=b, bits=bits
+        )
+        impl = (
+            _quantize_flat_db_impl if _use_db(tuned) else _quantize_flat_impl
+        )
+        words, meta = impl(
             xs,
             seed_from_key(key),
             bits=bits,
             bucket_size=b,
             stochastic=stochastic,
             interpret=interpret,
-            tc=_pipe_tc(rows * c_r, b),
-            pack=_pack_strategy(),
+            tc=_pipe_tc(rows * c_r, b, tuned),
+            pack=_pack_strategy(tuned),
             encode=_encode_strategy(),
         )
         return codec.QTensor(
@@ -602,6 +934,10 @@ def quantize_batch(
     word_parts, meta_parts = [], []
     if c_r:
         head = xb[:, : c_r * CHUNK_BUCKETS].reshape(-1, b)
+        tuned = autotune.lookup(
+            autotune.KIND_CHUNKS, n_chunks=rows * c_r, bucket_size=b,
+            bits=bits,
+        )
         words, meta = _quantize_chunks_impl(
             head,
             seed_from_key(key),
@@ -609,8 +945,8 @@ def quantize_batch(
             bucket_size=b,
             stochastic=stochastic,
             interpret=interpret,
-            tc=_tile_chunks(rows * c_r, b, bits),
-            pack=_pack_strategy(),
+            tc=_tile_chunks(rows * c_r, b, bits, tuned),
+            pack=_pack_strategy(tuned),
             encode=_encode_strategy(),
         )
         word_parts.append(words.reshape(rows, c_r * bits * b))
@@ -684,14 +1020,23 @@ def dequantize_batch(
             and q.numel_main == nb_r * b
             and tuple(add_to.shape) == (rows, q.numel_main)
         )
-        vals = _dequantize_flat_impl(
+        tuned = autotune.lookup(
+            autotune.KIND_FLAT, n_chunks=rows * c_r, bucket_size=b,
+            bits=q.bits,
+        )
+        impl = (
+            _dequantize_flat_db_impl
+            if _use_db(tuned)
+            else _dequantize_flat_impl
+        )
+        vals = impl(
             jax.lax.bitcast_convert_type(q.packed, jnp.int32),
             meta,
             add_to if fuse_add else None,
             bits=q.bits,
             bucket_size=b,
             interpret=interpret,
-            tc=_pipe_tc(rows * c_r, b),
+            tc=_pipe_tc(rows * c_r, b, tuned),
             with_add=fuse_add,
         )[:, : q.numel_main]
         if fuse_add:
@@ -708,7 +1053,13 @@ def dequantize_batch(
                 bits=q.bits,
                 bucket_size=b,
                 interpret=interpret,
-                tc=_tile_chunks(rows * c_r, b, q.bits),
+                tc=_tile_chunks(
+                    rows * c_r, b, q.bits,
+                    autotune.lookup(
+                        autotune.KIND_CHUNKS, n_chunks=rows * c_r,
+                        bucket_size=b, bits=q.bits,
+                    ),
+                ),
             )
             parts.append(vals.reshape(rows, c_r * CHUNK_BUCKETS * b))
         if t_r:
@@ -772,43 +1123,112 @@ def supports_reduce(q: codec.QTensor, ws: Optional[int] = None) -> bool:
     return ws * CHUNK_BUCKETS * b <= MAX_REDUCE_BLOCK_ELEMS
 
 
-def _reduce_tc(c_r: int, bucket_size: int, ws: int) -> int:
+def _reduce_tc(
+    c_r: int,
+    bucket_size: int,
+    ws: int,
+    tuned: "autotune.TunedConfig | None" = None,
+) -> int:
     """Chunks per grid step for the fused reduce: largest divisor of the
     per-row chunk count whose ws-way decoded block stays inside the VMEM
     budget. Matches ``_pipe_tc`` whenever the budget allows, so the
     requantize's grid (and its stochastic draw) lines up with the staged
-    stage-2 quantize."""
+    stage-2 quantize. A measured autotune entry (kind "epilogue")
+    replaces the heuristic candidate within the same budget — but the
+    CGX_PALLAS_TILE_CHUNKS override still wins (it routes through
+    ``_pipe_tc``, the strongest tier), and stochastic callers pass
+    ``tuned=None`` so the requantize draw geometry stays pinned to the
+    staged quantize's grid."""
     cap = max(1, MAX_REDUCE_BLOCK_ELEMS // (2 * ws * CHUNK_BUCKETS * bucket_size))
-    cap = min(cap, _pipe_tc(c_r, bucket_size))
+    if tuned is not None and _forced_tile_chunks() is None:
+        cap = min(cap, max(1, tuned.tc))
+    else:
+        cap = min(cap, _pipe_tc(c_r, bucket_size))
     for tc in range(min(cap, c_r), 0, -1):
         if c_r % tc == 0:
             return tc
     return 1
 
 
-def _decode_accumulate(w_ref, m_ref, raw_ref, own_ref, *, bits, tc, ws, rb):
-    """Shared fused-epilogue prologue: decode the ws peer rows of one
+# Fixed-point fraction bits of the int8 accumulation mode: per-row unit
+# scales snap to s_r = round(unit_r / U * 2^12) of the block max unit U, so
+# the per-row per-element product lvl * s_r stays <= 2^20 and a 16-row fold
+# stays <= 2^24 — exact in int32. Unit snap error <= U / 2^13 per row, far
+# inside the quantization envelope (tests/test_codec_pallas.py bounds it).
+_INT8_FRAC_BITS = 12
+
+
+def _decode_lvl(w3, sub, *, bits, tc, rb):
+    """Bit-plane decode of one row's block words (tc*bits*rb, 128) int32
+    -> integer levels (tc, CHUNK_BUCKETS, rb, 128)."""
+    w4 = w3.reshape(tc, bits, rb, 128)
+    lvl = jnp.zeros((tc, CHUNK_BUCKETS, rb, 128), jnp.int32)
+    for w in range(bits):
+        lvl = lvl | (((w4[:, w : w + 1, :, :] >> sub) & 1) << w)
+    return lvl
+
+
+def _decode_accumulate(
+    words, meta, raw, own, *, bits, tc, ws, rb, accum: str = "exact"
+):
+    """Shared fused-epilogue prologue: fold the ws peer rows of one
     tc-chunk block, substitute the raw own chunk (error symmetry: the own
     contribution stays exact through scatter-reduce,
-    scatter_reduce_allgather.cc:116-155), accumulate ascending — the same
-    select-then-sum op order as the staged path, so values (and therefore
-    downstream wire bytes) are bit-identical."""
+    scatter_reduce_allgather.cc:116-155).
+
+    ``words``: (ws, tc*bits*rb, 128) int32 VALUES (the caller reads its
+    refs/scratch slots — grid and DB lowerings share this body);
+    ``meta``: (ws, tc*CHUNK_BUCKETS, 2) f32; ``raw``: the own chunk as
+    (tc, CHUNK_BUCKETS, rb, 128) f32 or None; ``own``: traced row index
+    scalar (-1 = no raw substitution).
+
+    ``accum="exact"`` (default): decode each row to f32 and accumulate
+    ascending — the same select-then-sum op order as the staged path, so
+    values (and therefore downstream wire bytes) are bit-identical. This
+    is the ONE audited full-width f32 conversion site of the epilogue
+    kernels (tools/lint.py rejects `.astype(jnp.float32)` inlined into
+    kernel bodies outside it).
+
+    ``accum="int8"`` (CGX_SRA_ACCUM): peer rows fold in the integer
+    level domain — ``sum_r lvl_r * s_r`` in int32 with per-bucket
+    fixed-point scales ``s_r = round(unit_r/U * 2^12)`` — and convert to
+    f32 ONCE per block instead of once per peer row. Bytes differ from
+    "exact" within the documented envelope (module docstring of the
+    knob, config.sra_accum)."""
     sub = jax.lax.broadcasted_iota(
         jnp.int32, (tc, CHUNK_BUCKETS, rb, 128), 1
     )
+    if accum == "int8":
+        us = [
+            meta[r][:, 0:1].reshape(tc, CHUNK_BUCKETS, 1, 1)
+            for r in range(ws)
+        ]
+        umax = us[0]
+        for r in range(1, ws):
+            umax = jnp.maximum(umax, us[r])
+        usafe = jnp.where(umax > 0, umax, np.float32(1.0))
+        inv = np.float32(1 << _INT8_FRAC_BITS) / usafe
+        acc_i = jnp.zeros((tc, CHUNK_BUCKETS, rb, 128), jnp.int32)
+        bsum = jnp.zeros((tc, CHUNK_BUCKETS, 1, 1), jnp.float32)
+        for r in range(ws):
+            lvl = _decode_lvl(words[r], sub, bits=bits, tc=tc, rb=rb)
+            keep = own != r  # own == -1 keeps every row
+            s_r = jnp.where(
+                keep, jnp.round(us[r] * inv), np.float32(0.0)
+            ).astype(jnp.int32)
+            bmin = meta[r][:, 1:2].reshape(tc, CHUNK_BUCKETS, 1, 1)
+            bsum = bsum + jnp.where(keep, bmin, np.float32(0.0))
+            acc_i = acc_i + lvl * s_r
+        acc = bsum + (
+            usafe * np.float32(2.0 ** -_INT8_FRAC_BITS)
+        ) * acc_i.astype(jnp.float32)
+        if raw is not None:
+            acc = acc + raw
+        return acc
     acc = None
-    raw = None
-    if raw_ref is not None:
-        raw = raw_ref[:].astype(jnp.float32).reshape(
-            tc, CHUNK_BUCKETS, rb, 128
-        )
-    own = own_ref[0, 0]
     for r in range(ws):
-        w4 = w_ref[r].reshape(tc, bits, rb, 128)
-        lvl = jnp.zeros((tc, CHUNK_BUCKETS, rb, 128), jnp.int32)
-        for w in range(bits):
-            lvl = lvl | (((w4[:, w : w + 1, :, :] >> sub) & 1) << w)
-        m2 = m_ref[r]
+        lvl = _decode_lvl(words[r], sub, bits=bits, tc=tc, rb=rb)
+        m2 = meta[r]
         unit = m2[:, 0:1].reshape(tc, CHUNK_BUCKETS, 1, 1)
         bmin = m2[:, 1:2].reshape(tc, CHUNK_BUCKETS, 1, 1)
         vals = bmin + unit * lvl.astype(jnp.float32)
@@ -820,9 +1240,65 @@ def _decode_accumulate(w_ref, m_ref, raw_ref, own_ref, *, bits, tc, ws, rb):
     return acc
 
 
+def _raw4_cast(raw, *, tc, rb):
+    """Upcast + reshape the raw own chunk VALUE of one block (the SRA
+    exactness rule streams it at 1/ws of the decoded size — a small,
+    audited conversion, not a decoded-peer-row materialization)."""
+    return raw.astype(jnp.float32).reshape(tc, CHUNK_BUCKETS, rb, 128)
+
+
+def _read_raw4(raw_ref, *, tc, rb):
+    """Ref-reading sibling of :func:`_raw4_cast` for the grid kernels."""
+    if raw_ref is None:
+        return None
+    return _raw4_cast(raw_ref[:], tc=tc, rb=rb)
+
+
+def _requant_cast(acc, cast_dtype):
+    """The staged path quantizes ``reduced.astype(x.dtype)`` — replicated
+    here so sub-f32 wire dtypes round identically; f32 stages nothing."""
+    if cast_dtype is None or np.dtype(cast_dtype) == np.float32:
+        return acc
+    return acc.astype(cast_dtype).astype(jnp.float32)
+
+
+def _requantize_block(
+    x4, seed_ref, *, bits, tc, rb, stochastic, pack, encode, block_idx=None
+):
+    """Quantize one (tc, CHUNK_BUCKETS, rb, 128) f32 block — op-for-op the
+    ``_quantize_flat_kernel`` body (same meta math, encode lowering, pack
+    and stochastic draw geometry), shared by the flat quantize kernels,
+    the fused SRA epilogue's requantize and the DB lowerings so the wire
+    contract cannot drift between them. Returns
+    ``(words (tc*bits*rb, 128) int32, meta (tc*CHUNK_BUCKETS, 2) f32)``."""
+    maxlvl = np.float32((1 << bits) - 1)
+    bmax = jnp.max(jnp.max(x4, axis=2, keepdims=True), axis=3, keepdims=True)
+    bmin = jnp.min(jnp.min(x4, axis=2, keepdims=True), axis=3, keepdims=True)
+    # Reciprocal-multiply like codec.compute_meta (byte-identity).
+    unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
+    safe = jnp.where(unit > 0, unit, np.float32(1.0))
+    r = (
+        _stochastic_r(seed_ref, x4.shape, block_idx)
+        if stochastic
+        else np.float32(0.5)
+    )
+    lvl = _encode_lvl(x4, bmin, safe, r, maxlvl, encode)
+    planes = _pack_planes(lvl, bits, 1, pack)
+    # disjoint bits -> int32 wrap on the s=31 term is exact
+    words = jnp.stack(planes, axis=1).reshape(tc * bits * rb, 128)
+    meta = jnp.concatenate(
+        [unit.reshape(tc * CHUNK_BUCKETS, 1),
+         bmin.reshape(tc * CHUNK_BUCKETS, 1)],
+        axis=1,
+    )
+    return words, meta
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("bits", "bucket_size", "ws", "with_raw", "interpret", "tc"),
+    static_argnames=(
+        "bits", "bucket_size", "ws", "with_raw", "interpret", "tc", "accum",
+    ),
 )
 def _reduce_rows_impl(
     words: jax.Array,
@@ -836,6 +1312,7 @@ def _reduce_rows_impl(
     with_raw: bool,
     interpret: bool = False,
     tc: int = 8,
+    accum: str = "exact",
 ):
     """Fused K-operand dequantize-accumulate: words (ws, W) int32 + meta
     (ws, nb_r, 2) f32 [+ raw own chunk] -> reduced (nb_r*B,) f32 in one
@@ -850,8 +1327,10 @@ def _reduce_rows_impl(
             raw_ref, out_ref = rest
         else:
             raw_ref, (out_ref,) = None, rest
+        raw4 = _read_raw4(raw_ref, tc=tc, rb=rb)
         acc = _decode_accumulate(
-            w_ref, m_ref, raw_ref, own_ref, bits=bits, tc=tc, ws=ws, rb=rb
+            w_ref[:], m_ref[:], raw4, own_ref[0, 0],
+            bits=bits, tc=tc, ws=ws, rb=rb, accum=accum,
         )
         out_ref[:] = acc.reshape(tc * CHUNK_BUCKETS * rb, 128)
 
@@ -890,7 +1369,7 @@ def _reduce_rows_impl(
     jax.jit,
     static_argnames=(
         "bits", "bucket_size", "ws", "with_raw", "stochastic", "interpret",
-        "tc", "pack", "encode", "cast_dtype",
+        "tc", "pack", "encode", "cast_dtype", "accum",
     ),
 )
 def _sra_epilogue_impl(
@@ -910,51 +1389,39 @@ def _sra_epilogue_impl(
     pack: str = "sum",
     encode: str = "div",
     cast_dtype=None,
+    accum: str = "exact",
 ):
     """The full fused SRA epilogue: dequantize-accumulate (as above) +
     requantize the reduced chunk in the same kernel — returns
     (words (c_r*bits*rb, 128) int32, meta (c_r*32, 2) f32), the stage-2
     wire payload, without ever writing the decoded or reduced floats to
-    HBM. The requantize body is op-for-op ``_quantize_flat_kernel`` on the
-    in-register reduced block (same meta math, same ``div``/``mul`` encode
-    lowering, same pack, same per-program stochastic draw geometry), so
-    deterministic wire bytes match the staged stage-2 quantize exactly.
+    HBM. The requantize body IS ``_requantize_block`` — the same helper
+    ``_quantize_flat_kernel`` runs (same meta math, same ``div``/``mul``
+    encode lowering, same pack, same per-program stochastic draw
+    geometry), so deterministic wire bytes match the staged stage-2
+    quantize exactly (under the default ``accum="exact"`` fold).
     ``cast_dtype``: the staged path quantizes ``reduced.astype(x.dtype)``
-    — replicated here so sub-f32 wire dtypes round the same way."""
+    — replicated (``_requant_cast``) so sub-f32 wire dtypes round the
+    same way."""
     b = bucket_size
     rb = b // 128
     nb_r = meta.shape[1]
     c_r = nb_r // CHUNK_BUCKETS
-    maxlvl = np.float32((1 << bits) - 1)
 
     def _sra_epilogue_kernel(seed_ref, own_ref, w_ref, m_ref, *rest):
         if with_raw:
             raw_ref, words_ref, meta_ref = rest
         else:
             raw_ref, (words_ref, meta_ref) = None, rest
+        raw4 = _read_raw4(raw_ref, tc=tc, rb=rb)
         acc = _decode_accumulate(
-            w_ref, m_ref, raw_ref, own_ref, bits=bits, tc=tc, ws=ws, rb=rb
+            w_ref[:], m_ref[:], raw4, own_ref[0, 0],
+            bits=bits, tc=tc, ws=ws, rb=rb, accum=accum,
         )
-        x4 = acc
-        if cast_dtype is not None and np.dtype(cast_dtype) != np.float32:
-            x4 = acc.astype(cast_dtype).astype(jnp.float32)
-        # Requantize: identical op sequence to _quantize_flat_kernel.
-        bmax = jnp.max(
-            jnp.max(x4, axis=2, keepdims=True), axis=3, keepdims=True
-        )
-        bmin = jnp.min(
-            jnp.min(x4, axis=2, keepdims=True), axis=3, keepdims=True
-        )
-        unit = (bmax - bmin) * np.float32(1.0 / ((1 << bits) - 1))
-        safe = jnp.where(unit > 0, unit, np.float32(1.0))
-        r = _stochastic_r(seed_ref, x4.shape) if stochastic else np.float32(0.5)
-        lvl = _encode_lvl(x4, bmin, safe, r, maxlvl, encode)
-        planes = _pack_planes(lvl, bits, 1, pack)
-        words_ref[:] = jnp.stack(planes, axis=1).reshape(tc * bits * rb, 128)
-        meta_ref[:] = jnp.concatenate(
-            [unit.reshape(tc * CHUNK_BUCKETS, 1),
-             bmin.reshape(tc * CHUNK_BUCKETS, 1)],
-            axis=1,
+        words_ref[:], meta_ref[:] = _requantize_block(
+            _requant_cast(acc, cast_dtype), seed_ref,
+            bits=bits, tc=tc, rb=rb, stochastic=stochastic, pack=pack,
+            encode=encode,
         )
 
     in_specs = [
@@ -996,6 +1463,181 @@ def _sra_epilogue_impl(
     return words_out, meta_out
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "bits", "bucket_size", "ws", "with_raw", "stochastic", "interpret",
+        "tc", "pack", "encode", "cast_dtype", "accum",
+    ),
+)
+def _sra_epilogue_db_impl(
+    words: jax.Array,
+    meta: jax.Array,
+    raw: Optional[jax.Array],
+    own: jax.Array,
+    seed: jax.Array,
+    *,
+    bits: int,
+    bucket_size: int,
+    ws: int,
+    with_raw: bool,
+    stochastic: bool,
+    interpret: bool = False,
+    tc: int = 8,
+    pack: str = "sum",
+    encode: str = "div",
+    cast_dtype=None,
+    accum: str = "exact",
+):
+    """Double-buffered sibling of :func:`_sra_epilogue_impl` — same
+    contract and (under ``accum="exact"``) the same wire bytes; the ws
+    peer-row streams, the raw own chunk and both outputs ride the manual
+    2-slot DMA pipeline (per-peer-row copies, one semaphore per (slot,
+    row))."""
+    b = bucket_size
+    rb = b // 128
+    nb_r = meta.shape[1]
+    c_r = nb_r // CHUNK_BUCKETS
+    nblk = c_r // tc
+    w_rows = tc * bits * rb
+    m_rows = tc * CHUNK_BUCKETS
+    s_rows = tc * CHUNK_BUCKETS * rb
+
+    def _sra_epilogue_db_kernel(seed_ref, own_ref, w_hbm, m_hbm, *rest):
+        if with_raw:
+            r_hbm, wo_hbm, mo_hbm = rest
+        else:
+            r_hbm, (wo_hbm, mo_hbm) = None, rest
+
+        def body(wbuf, mbuf, rbuf, wob, mob, in_sem, r_sem, wo_sem, mo_sem):
+            def w_dma(slot, r, i):
+                return pltpu.make_async_copy(
+                    w_hbm.at[r, pl.ds(i * w_rows, w_rows)],
+                    wbuf.at[slot, r], in_sem.at[slot, r, 0],
+                )
+
+            def m_dma(slot, r, i):
+                return pltpu.make_async_copy(
+                    m_hbm.at[r, pl.ds(i * m_rows, m_rows)],
+                    mbuf.at[slot, r], in_sem.at[slot, r, 1],
+                )
+
+            def r_dma(slot, i):
+                return pltpu.make_async_copy(
+                    r_hbm.at[pl.ds(i * s_rows, s_rows)], rbuf.at[slot],
+                    r_sem.at[slot],
+                )
+
+            def wo_dma(slot, i):
+                return pltpu.make_async_copy(
+                    wob.at[slot], wo_hbm.at[pl.ds(i * w_rows, w_rows)],
+                    wo_sem.at[slot],
+                )
+
+            def mo_dma(slot, i):
+                return pltpu.make_async_copy(
+                    mob.at[slot], mo_hbm.at[pl.ds(i * m_rows, m_rows)],
+                    mo_sem.at[slot],
+                )
+
+            def start_in(slot, i):
+                for r in range(ws):
+                    w_dma(slot, r, i).start()
+                    m_dma(slot, r, i).start()
+                if with_raw:
+                    r_dma(slot, i).start()
+
+            def wait_in(slot, i):
+                for r in range(ws):
+                    w_dma(slot, r, i).wait()
+                    m_dma(slot, r, i).wait()
+                if with_raw:
+                    r_dma(slot, i).wait()
+
+            start_in(0, 0)
+
+            def step(i, carry):
+                cur = i % 2
+
+                @pl.when(i + 1 < nblk)
+                def _():
+                    start_in((i + 1) % 2, i + 1)
+
+                wait_in(cur, i)
+
+                @pl.when(i >= 2)
+                def _():
+                    wo_dma(cur, i - 2).wait()
+                    mo_dma(cur, i - 2).wait()
+
+                raw4 = (
+                    _raw4_cast(rbuf[cur], tc=tc, rb=rb) if with_raw else None
+                )
+                acc = _decode_accumulate(
+                    wbuf[cur], mbuf[cur], raw4, own_ref[0, 0],
+                    bits=bits, tc=tc, ws=ws, rb=rb, accum=accum,
+                )
+                w_out, m_out = _requantize_block(
+                    _requant_cast(acc, cast_dtype), seed_ref,
+                    bits=bits, tc=tc, rb=rb, stochastic=stochastic,
+                    pack=pack, encode=encode, block_idx=i,
+                )
+                _slot_store(wob, cur, w_out)
+                _slot_store(mob, cur, m_out)
+                wo_dma(cur, i).start()
+                mo_dma(cur, i).start()
+                return carry
+
+            jax.lax.fori_loop(0, nblk, step, 0)
+            for j in range(max(0, nblk - 2), nblk):
+                wo_dma(j % 2, j).wait()
+                mo_dma(j % 2, j).wait()
+
+        pl.run_scoped(
+            body,
+            wbuf=pltpu.VMEM((2, ws, w_rows, 128), jnp.int32),
+            mbuf=pltpu.VMEM((2, ws, m_rows, 2), jnp.float32),
+            rbuf=pltpu.VMEM(
+                (2, s_rows, 128) if with_raw else (2, 8, 128), jnp.float32
+            ),
+            wob=pltpu.VMEM((2, w_rows, 128), jnp.int32),
+            mob=pltpu.VMEM((2, m_rows, 2), jnp.float32),
+            in_sem=pltpu.SemaphoreType.DMA((2, ws, 2)),
+            r_sem=pltpu.SemaphoreType.DMA((2,)),
+            wo_sem=pltpu.SemaphoreType.DMA((2,)),
+            mo_sem=pltpu.SemaphoreType.DMA((2,)),
+        )
+
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    operands = [
+        seed.reshape(1, 1).astype(jnp.int32),
+        own.reshape(1, 1).astype(jnp.int32),
+        words.reshape(ws, c_r * bits * rb, 128),
+        meta.reshape(ws, nb_r, 2),
+    ]
+    if with_raw:
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.ANY))
+        operands.append(raw.reshape(nb_r * b // 128, 128))
+    return pl.pallas_call(
+        _sra_epilogue_db_kernel,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((c_r * bits * rb, 128), jnp.int32),
+            jax.ShapeDtypeStruct((c_r * CHUNK_BUCKETS, 2), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+
+
 def reduce_rows_batch(
     q: codec.QTensor,
     *,
@@ -1012,6 +1654,10 @@ def reduce_rows_batch(
     with_raw = raw_row is not None
     own = own_idx if own_idx is not None else jnp.int32(-1)
     nb_r = codec.num_buckets(q.numel_main, q.bucket_size)
+    tuned = autotune.lookup(
+        autotune.KIND_EPILOGUE, n_chunks=nb_r // CHUNK_BUCKETS,
+        bucket_size=q.bucket_size, bits=q.bits, ws=ws,
+    )
     return _reduce_rows_impl(
         words,
         meta,
@@ -1022,7 +1668,8 @@ def reduce_rows_batch(
         ws=ws,
         with_raw=with_raw,
         interpret=interpret,
-        tc=_reduce_tc(nb_r // CHUNK_BUCKETS, q.bucket_size, ws),
+        tc=_reduce_tc(nb_r // CHUNK_BUCKETS, q.bucket_size, ws, tuned),
+        accum=cfg_mod.sra_accum(),
     )[: q.numel]
 
 
@@ -1046,7 +1693,19 @@ def sra_epilogue_batch(
     with_raw = raw_row is not None
     own = own_idx if own_idx is not None else jnp.int32(-1)
     nb_r = codec.num_buckets(q.numel_main, q.bucket_size)
-    words_out, meta_out = _sra_epilogue_impl(
+    # Stochastic requantize: keep the heuristic tile — a tuned epilogue tc
+    # differing from the flat quantize tc would change the per-block
+    # _stochastic_r draw geometry vs the staged stage-2 quantize.
+    tuned = (
+        None
+        if key is not None
+        else autotune.lookup(
+            autotune.KIND_EPILOGUE, n_chunks=nb_r // CHUNK_BUCKETS,
+            bucket_size=q.bucket_size, bits=q.bits, ws=ws,
+        )
+    )
+    impl = _sra_epilogue_db_impl if _use_db(tuned) else _sra_epilogue_impl
+    words_out, meta_out = impl(
         words,
         meta,
         raw_row if with_raw else None,
@@ -1058,10 +1717,11 @@ def sra_epilogue_batch(
         with_raw=with_raw,
         stochastic=key is not None,
         interpret=interpret,
-        tc=_reduce_tc(nb_r // CHUNK_BUCKETS, q.bucket_size, ws),
-        pack=_pack_strategy(),
+        tc=_reduce_tc(nb_r // CHUNK_BUCKETS, q.bucket_size, ws, tuned),
+        pack=_pack_strategy(tuned),
         encode=_encode_strategy(),
         cast_dtype=np.dtype(out_dtype),
+        accum=cfg_mod.sra_accum(),
     )
     return codec.QTensor(
         packed=jax.lax.bitcast_convert_type(words_out, jnp.uint32).reshape(

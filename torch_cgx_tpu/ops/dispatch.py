@@ -199,6 +199,7 @@ def reduce_rows(
     q: codec.QTensor,
     *,
     raw_rows: Optional[jax.Array] = None,
+    raw_row: Optional[jax.Array] = None,
     own_idx: Optional[jax.Array] = None,
     add_to: Optional[jax.Array] = None,
     out_dtype=jnp.float32,
@@ -207,31 +208,40 @@ def reduce_rows(
     reduced values: decode every row, substitute the raw own chunk
     (``raw_rows[own_idx]``) for its own decode when given (the SRA
     own-chunk-exact rule, scatter_reduce_allgather.cc:116-155), and sum.
-    ``add_to`` (flat) is a pre-accumulator (the Ring hop's decompress-add,
-    UnpackArray<ADD>). Fused Pallas kernel on TPU; staged reference ops
-    elsewhere — identical values by construction (interpret-mode
-    byte-check in the suite)."""
+    ``raw_row`` is the pre-sliced alternative — the flat own chunk
+    itself, from a caller that never materializes the full (ws, chunk)
+    raw matrix (the producer-fused path). ``add_to`` (flat) is a
+    pre-accumulator (the Ring hop's decompress-add, UnpackArray<ADD>).
+    Fused Pallas kernel on TPU; staged reference ops elsewhere —
+    identical values by construction (interpret-mode byte-check in the
+    suite)."""
+    if raw_rows is not None and raw_row is not None:
+        raise ValueError("pass raw_rows or raw_row, not both")
     rows = q.batch_rows
+    have_raw = raw_rows is not None or raw_row is not None
     if rows > 1 and add_to is None and _use_fused_reduce(q):
-        raw_row = (
+        rr = (
             _own_row(raw_rows, own_idx, q.numel)
             if raw_rows is not None
-            else None
+            else raw_row
         )
         return codec_pallas.reduce_rows_batch(
-            q, raw_row=raw_row, own_idx=own_idx, interpret=not _on_tpu()
+            q, raw_row=rr, own_idx=own_idx, interpret=not _on_tpu()
         ).astype(out_dtype)
     # Staged reference path (also the fused kernels' byte oracle).
-    if rows == 1 and raw_rows is None:
+    if rows == 1 and not have_raw:
         return dequantize_batch(
             q,
             add_to=None if add_to is None else add_to[None],
             out_dtype=out_dtype,
         )[0]
     vals = dequantize_batch(q, out_dtype=jnp.float32)
-    if raw_rows is not None:
+    if have_raw:
         own = (jnp.arange(rows) == own_idx)[:, None]
-        vals = jnp.where(own, raw_rows.astype(jnp.float32), vals)
+        raw_b = (
+            raw_rows if raw_rows is not None else raw_row[None]
+        ).astype(jnp.float32)
+        vals = jnp.where(own, raw_b, vals)
     red = ordered_rowsum(vals)
     if add_to is not None:
         red = add_to.astype(jnp.float32) + red
@@ -243,6 +253,7 @@ def reduce_rows_requantize(
     cc: CompressionConfig,
     *,
     raw_rows: Optional[jax.Array] = None,
+    raw_row: Optional[jax.Array] = None,
     own_idx: Optional[jax.Array] = None,
     key: Optional[jax.Array] = None,
     out_dtype=jnp.float32,
@@ -250,27 +261,29 @@ def reduce_rows_requantize(
     """The full SRA epilogue: :func:`reduce_rows` + requantize of the
     reduced chunk into a rows=1 QTensor (the stage-2 allgather payload) —
     one fused HBM pass on TPU, the staged decode/sum/quantize reference
-    elsewhere. Wire bytes are identical between the two lowerings on the
-    default deterministic ``div`` encode; ``CGX_CODEC_ENCODE=mul`` applies
-    inside the fused requantize exactly as in the staged quantize (same
-    one-knob flip, PERF_NOTES.md)."""
+    elsewhere. ``raw_row`` is the pre-sliced own chunk (producer-fused
+    callers — see :func:`reduce_rows`). Wire bytes are identical between
+    the two lowerings on the default deterministic ``div`` encode;
+    ``CGX_CODEC_ENCODE=mul`` applies inside the fused requantize exactly
+    as in the staged quantize (same one-knob flip, PERF_NOTES.md)."""
     stochastic = cc.stochastic and key is not None
     if _use_fused_reduce(q, stochastic=stochastic):
-        raw_row = (
+        rr = (
             _own_row(raw_rows, own_idx, q.numel)
             if raw_rows is not None
-            else None
+            else raw_row
         )
         return codec_pallas.sra_epilogue_batch(
             q,
-            raw_row=raw_row,
+            raw_row=rr,
             own_idx=own_idx,
             key=key if stochastic else None,
             out_dtype=out_dtype,
             interpret=not _on_tpu(),
         )
     reduced = reduce_rows(
-        q, raw_rows=raw_rows, own_idx=own_idx, out_dtype=jnp.float32
+        q, raw_rows=raw_rows, raw_row=raw_row, own_idx=own_idx,
+        out_dtype=jnp.float32,
     )
     return quantize_batch(
         reduced.astype(out_dtype)[None], cc, key if stochastic else None

@@ -364,12 +364,20 @@ def allreduce_flat(
     return_roundtrip: bool = False,
     slices: Optional[Sequence[Tuple[int, int]]] = None,
     decision: Optional[topo_router.RouteDecision] = None,
+    pre=None,
 ):
     """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
     shard_map). Slicing by the fusion threshold happens here so oversized
     buffers are chunked like performOperationSingle (.cc:187-199);
     ``slices`` lets allreduce_tree hand in the layout-cache's precomputed
     plan instead of re-deriving it per call.
+
+    ``pre``: a producer-staged stage-1 payload
+    (``ops.fused_producer.Produced``) for a single-slice single-axis SRA
+    buffer — consumed only when the compiled schedule (or its absence)
+    matches the plan the producer quantized against; any mismatch is
+    counted (``cgx.codec.producer_fallback_*``) and the plain quantize
+    runs, never a silently wrong wire.
 
     ``return_roundtrip=True`` also returns this device's wire decode (the
     error-feedback residual base) as a second array. On the single-axis
@@ -405,6 +413,14 @@ def allreduce_flat(
     staged = decision.route == topo_router.ROUTE_STAGED and len(axes) == 1
     n = flat.shape[0]
     ratio = cfg_mod.fake_ratio()
+    if pre is not None and (
+        len(axes) != 1
+        or ratio is not None
+        or (slices is not None and len(slices) != 1)
+    ):
+        metrics.add("cgx.codec.producer_fallbacks")
+        metrics.add("cgx.codec.producer_fallback_routing")
+        pre = None
     tail = None
     if ratio is not None and cc.enabled and n > 1:
         # Debug traffic shaping (mpi_allreduce_operations.cc:130-144): only
@@ -440,34 +456,66 @@ def allreduce_flat(
                 dtype=np.dtype(flat.dtype).str, route=decision.route,
                 route_staged=staged,
             )
+            # Producer-staged payload: usable only when the producer's
+            # block plan matches what THIS call stages (monolithic <->
+            # no schedule, per-block <-> identical table) and the slice
+            # rides the multi-rank SRA transport.
+            use_pre = None
+            if pre is not None:
+                compatible = (
+                    ws > 1
+                    and red == cfg_mod.REDUCTION_SRA
+                    and not cfg_mod.dummy_compression()
+                    and pre.n == ln
+                    and (
+                        (sched is None and pre.q is not None)
+                        or (
+                            sched is not None
+                            and pre.q_blocks is not None
+                            and pre.table == sched.table
+                        )
+                    )
+                )
+                if compatible:
+                    use_pre = pre
+                    pre.consumed = True
+                    metrics.add("cgx.codec.producer_consumed_slices")
+                    metrics.add(
+                        "cgx.codec.producer_consumed_elems", float(ln)
+                    )
+                else:
+                    metrics.add("cgx.codec.producer_fallbacks")
+                    metrics.add("cgx.codec.producer_fallback_plan")
             if sched is not None:
                 ar = functools.partial(
                     xla_mod.staged_pipelined_allreduce
                     if staged
                     else sched_mod.pipelined_quantized_allreduce,
-                    sched=sched,
+                    sched=sched, pre=use_pre,
                 )
                 ar_wire = (
                     functools.partial(
                         xla_mod.staged_pipelined_allreduce_with_wire,
-                        sched=sched,
+                        sched=sched, pre=use_pre,
                     )
                     if staged
                     else functools.partial(
                         sched_mod.pipelined_quantized_allreduce,
-                        sched=sched, with_wire=True,
+                        sched=sched, with_wire=True, pre=use_pre,
                     )
                 )
             else:
-                ar = (
+                ar = functools.partial(
                     xla_mod.staged_quantized_allreduce
                     if staged
-                    else quantized_allreduce
+                    else quantized_allreduce,
+                    pre=use_pre,
                 )
-                ar_wire = (
+                ar_wire = functools.partial(
                     xla_mod.staged_quantized_allreduce_with_wire
                     if staged
-                    else quantized_allreduce_with_wire
+                    else quantized_allreduce_with_wire,
+                    pre=use_pre,
                 )
             if return_roundtrip:
                 red_piece, rt_piece = ar_wire(piece, axes[0], ws, cc, red, k)
@@ -685,6 +733,19 @@ def allreduce_tree(
         if sched_mod.engaged()
         else range(len(groups))
     )
+    # Producer-fused stash (ops/fused_producer.py): standalone groups whose
+    # leaf IS a stashed cotangent (identity match — any transformation of
+    # the gradient between backward and here unmatches it) can consume the
+    # backward-staged wire payload; the group's f32 quantize input then
+    # goes dead and XLA DCEs the producing matmul. Lazy import: the module
+    # pulls reducers/schedule at call time.
+    fp_mod = None
+    if len(axes) == 1:
+        from ..ops import fused_producer as fp_mod
+
+        if not (fp_mod.engaged() and fp_mod.stash_size()):
+            fp_mod = None
+    div_expected = ws_total if (average and ws_total > 1) else 1
     for gi in order:
         g = groups[gi]
         # distinct stochastic-rounding stream per fused group (groups would
@@ -696,6 +757,21 @@ def allreduce_tree(
             if len(leaves) > 1
             else leaves[0].reshape(-1)
         )
+        pre_ent = None
+        if fp_mod is not None and len(g.indices) == 1 and g.cc.enabled:
+            ent = fp_mod.lookup(paths_leaves[g.indices[0]][1])
+            if ent is not None:
+                if (
+                    ent.cc == g.cc
+                    and ent.ws == mesh.shape[axes[0]]
+                    and ent.divisor == div_expected
+                    and ent.n == g.fused_n
+                    and len(g.slices) == 1
+                ):
+                    pre_ent = ent
+                else:
+                    metrics.add("cgx.codec.producer_fallbacks")
+                    metrics.add("cgx.codec.producer_fallback_group")
         with named_scope(
             f"cgx_allreduce_b{g.cc.bits}_{np.dtype(g.dtype).name}"
         ):
@@ -736,13 +812,18 @@ def allreduce_tree(
                     reduced, rt_flat = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, return_roundtrip=True, slices=g.slices,
-                        decision=decision,
+                        decision=decision, pre=pre_ent,
                     )
                 else:
                     reduced = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, slices=g.slices, decision=decision,
+                        pre=pre_ent,
                     )
+                if pre_ent is not None and pre_ent.consumed:
+                    # One payload, one spend: a second allreduce of the
+                    # same tree in this trace re-quantizes normally.
+                    fp_mod.claim(pre_ent.cotangent)
             else:
                 metrics.add("cgx.trace.allreduce.raw_elems", float(fused.shape[0]))
                 _runtime_count("cgx.runtime.allreduce.raw_elems", fused.shape[0])
@@ -767,6 +848,10 @@ def allreduce_tree(
                         "bits": int(g.cc.bits),
                     }
                     _report_qerr(paths_leaves[i][0], leaf, rt_leaf)
+    if fp_mod is not None:
+        # Unclaimed payloads would otherwise pin this trace's tracers
+        # until the next step's begin_step; claimed ones are already gone.
+        fp_mod.drain()
     result = jax.tree_util.tree_unflatten(treedef, out)
     if return_roundtrip:
         return result, jax.tree_util.tree_unflatten(treedef, rt_out)

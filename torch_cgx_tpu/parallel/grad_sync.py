@@ -471,6 +471,12 @@ def make_train_step(
         return P(axes)
 
     def _grads_and_key(params, batch, step_idx):
+        # Producer-fused stash epoch: entries staged by THIS trace's
+        # backward are the only ones its allreduce may claim (trace-time
+        # Python — nothing staged changes when the plane is off).
+        from ..ops import fused_producer as _fp
+
+        _fp.begin_step()
         if wants_rng:
             r = jax.random.fold_in(
                 jax.random.PRNGKey(stochastic_seed or 0), step_idx
@@ -670,6 +676,26 @@ def make_train_step(
         from ..wire import edges as wire_edges
 
         wire_key = wire_edges.cache_key_component()
+        # Producer-fuse component: a CGX_PRODUCER_FUSE flip changes which
+        # gradients enter the wire pre-quantized — it must retrace, never
+        # serve a program from another producer era. Configuring the
+        # producer context happens here too (trace-time state the
+        # backward rules read); consumption self-disarms under the
+        # nonfinite guard and the stateful compressors because their
+        # gradient rewrites break the cotangent-identity match, but the
+        # explicit gate keeps the staged payloads from even being built.
+        from ..ops import fused_producer as _fp
+
+        _fp.configure(
+            mesh, sync_axes, divisor=ws_total,
+            active=(
+                guard == "off"
+                and not error_feedback
+                and powersgd_rank is None
+                and topk_ratio is None
+            ),
+        )
+        producer_key = _fp.cache_key_component()
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
@@ -677,6 +703,7 @@ def make_train_step(
             xla_route,
             sched_key,
             wire_key,
+            producer_key,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.

@@ -127,14 +127,27 @@ def _phase_key(key, salt: int, axis_name: str):
     return jax.random.fold_in(jax.random.fold_in(key, salt), lax.axis_index(axis_name))
 
 
-def _sra_exchange(x, axis_name: str, ws: int, cc, key):
+def _sra_exchange(x, axis_name: str, ws: int, cc, key, pre=None):
     """SRA stage-1 wire: quantize the padded (ws, chunk) rows with the
     phase-1 key and exchange via all_to_all. Returns
     ``(q, q_recv, xs, own_idx)`` — the sent payload, the received peer
     payloads (row j = this device's chunk as peer j quantized it), the raw
     padded rows, and this device's axis position. Factored so every SRA
     variant (plain / with-wire / reduce-scatter) shares ONE wire
-    implementation and the epilogue can be dispatched fused or staged."""
+    implementation and the epilogue can be dispatched fused or staged.
+
+    ``pre``: a producer-staged stage-1 payload
+    (``ops.fused_producer.Produced`` — ``pre.q`` the already-quantized
+    (ws, chunk) rows, ``pre.raw_row`` the raw own chunk): the quantize is
+    skipped entirely and ``xs`` is None — the f32 buffer is never read,
+    which is the whole point (callers substitute ``pre.raw_row`` for the
+    own-row slice of ``xs``)."""
+    if pre is not None:
+        q = pre.q
+        q_recv = jax.tree.map(
+            lambda a: lax.all_to_all(a, axis_name, 0, 0), q
+        )
+        return q, q_recv, None, lax.axis_index(axis_name)
     xs = _pad_rows(x, ws, _chunk_size(x.shape[0], ws))
     q = _quantize_rows(xs, cc, _phase_key(key, 1, axis_name))
     q_recv = jax.tree.map(lambda a: lax.all_to_all(a, axis_name, 0, 0), q)
@@ -195,17 +208,22 @@ def allgather_quantized(
     return vals.reshape(-1)[:n].astype(out_dtype)
 
 
-def _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, out_dtype):
+def _sra_epilogue_q(
+    q_recv, xs, own_idx, axis_name, cc, key, out_dtype, raw_row=None
+):
     """Shared SRA epilogue: the stage-2 wire payload of the reduced chunk,
     via ``dispatch.reduce_rows_requantize`` — ONE fused
     dequant-accumulate-requantize HBM pass on TPU (the (ws, chunk) f32
     intermediate of the staged form never materializes), the staged
-    reference ops elsewhere. Wire bytes identical across lowerings on the
-    default ``div`` encode (jaxpr-guarded in test_reducers)."""
+    reference ops elsewhere. ``raw_row`` is the pre-sliced own chunk of a
+    producer-staged caller (``xs`` is then None). Wire bytes identical
+    across lowerings on the default ``div`` encode (jaxpr-guarded in
+    test_reducers)."""
     return dispatch.reduce_rows_requantize(
         q_recv,
         cc,
         raw_rows=xs,
+        raw_row=raw_row,
         own_idx=own_idx,
         key=_phase_key(key, 2, axis_name) if cc.stochastic else None,
         out_dtype=out_dtype,
@@ -218,6 +236,7 @@ def sra_allreduce(
     ws: int,
     cc: CompressionConfig,
     key: Optional[jax.Array] = None,
+    pre=None,
 ) -> jax.Array:
     """Quantized Scatter-Reduce-AllGather allreduce (the flagship algorithm,
     ``MPI_Allreduce_ScatterReduceAllgather::AllreduceCompressed``).
@@ -227,10 +246,15 @@ def sra_allreduce(
     scatter_reduce_allgather.cc:116-160) is a single dispatched op; stage 2
     all_gathers the requantized chunk and decodes every row — including
     one's own, realizing the requant+self-dequant error-symmetry trick
-    (scatter_reduce_allgather.cc:157-160)."""
+    (scatter_reduce_allgather.cc:157-160). ``pre``: producer-staged
+    stage-1 payload (see :func:`_sra_exchange`) — ``x`` then contributes
+    only its static shape/dtype and its producer is dead code."""
     n = x.shape[0]
-    _, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
-    q_own = _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, x.dtype)
+    _, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key, pre)
+    q_own = _sra_epilogue_q(
+        q_recv, xs, own_idx, axis_name, cc, key, x.dtype,
+        raw_row=pre.raw_row if pre is not None else None,
+    )
     gathered = _gather_rows(q_own, axis_name)
     vals = _dequantize_rows(gathered)  # (ws, chunk)
     return vals.reshape(-1)[:n].astype(x.dtype)
@@ -393,6 +417,7 @@ def sra_allreduce_with_wire(
     ws: int,
     cc: CompressionConfig,
     key: Optional[jax.Array] = None,
+    pre=None,
 ):
     """SRA allreduce that ALSO returns this device's wire decode (the
     error-feedback residual base): ``(reduced, rt)`` where ``rt`` is what
@@ -409,15 +434,21 @@ def sra_allreduce_with_wire(
     (the mirror had to replicate ``_phase_key`` exactly or the residual
     measured a different random draw than the wire's)."""
     n = x.shape[0]
-    q, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key)
+    q, q_recv, xs, own_idx = _sra_exchange(x, axis_name, ws, cc, key, pre)
     own = (jnp.arange(ws) == own_idx)[:, None]
     rt_rows = _dequantize_rows(q)
+    raw_b = (
+        xs if pre is None else pre.raw_row[None]
+    )  # producer path: only the own row is raw, and only it is selected
     rt = (
-        jnp.where(own, xs.astype(rt_rows.dtype), rt_rows)
+        jnp.where(own, raw_b.astype(rt_rows.dtype), rt_rows)
         .reshape(-1)[:n]
         .astype(x.dtype)
     )
-    q_own = _sra_epilogue_q(q_recv, xs, own_idx, axis_name, cc, key, x.dtype)
+    q_own = _sra_epilogue_q(
+        q_recv, xs, own_idx, axis_name, cc, key, x.dtype,
+        raw_row=pre.raw_row if pre is not None else None,
+    )
     gathered = _gather_rows(q_own, axis_name)
     out = _dequantize_rows(gathered).reshape(-1)[:n].astype(x.dtype)
     return out, rt
@@ -527,12 +558,25 @@ def quantized_allreduce_with_wire(
     cc: CompressionConfig,
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
+    pre=None,
 ):
     """:func:`quantized_allreduce` + this device's wire decode ``rt``
     (``(reduced, rt)``) for the error-feedback residual. Exact wires
     (PSUM, compression off, dummy codec, ws == 1 without the force-codec
     knob) round-trip unchanged: ``rt = x``. SRA and all-to-all share the
-    wire payload (quantize-once); Ring uses the hop-0 mirror."""
+    wire payload (quantize-once); Ring uses the hop-0 mirror. ``pre``
+    (producer-staged stage-1 payload) is SRA-only — any other branch with
+    it is a routing bug and raises."""
+    if pre is not None and (
+        reduction != cfg_mod.REDUCTION_SRA
+        or ws == 1
+        or not cc.enabled
+        or cfg_mod.dummy_compression()
+    ):
+        raise ValueError(
+            "producer-staged payloads route only to the multi-rank SRA "
+            f"transport (got reduction={reduction!r}, ws={ws})"
+        )
     if ws == 1:
         out = quantized_allreduce(x, axis_name, ws, cc, reduction, key)
         # force-codec proxy: the single-rank "wire" decode IS the output;
@@ -543,7 +587,7 @@ def quantized_allreduce_with_wire(
     ):
         return quantized_allreduce(x, axis_name, ws, cc, reduction, key), x
     if reduction == cfg_mod.REDUCTION_SRA:
-        return sra_allreduce_with_wire(x, axis_name, ws, cc, key)
+        return sra_allreduce_with_wire(x, axis_name, ws, cc, key, pre)
     if reduction == cfg_mod.REDUCTION_ALLTOALL:
         return alltoall_allreduce_with_wire(x, axis_name, ws, cc, key)
     if reduction == cfg_mod.REDUCTION_RING:
@@ -561,10 +605,22 @@ def quantized_allreduce(
     cc: CompressionConfig,
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
+    pre=None,
 ) -> jax.Array:
     """Dispatch on the reduction algorithm (CGX_*_REDUCTION_TYPE analogue,
     mpi_allreduce_operations.cc:70-115). Flat (non-hierarchical) allreduce
-    of a 1-D buffer inside shard_map."""
+    of a 1-D buffer inside shard_map. ``pre`` (producer-staged stage-1
+    payload) is SRA-only."""
+    if pre is not None and (
+        reduction != cfg_mod.REDUCTION_SRA
+        or ws == 1
+        or not cc.enabled
+        or cfg_mod.dummy_compression()
+    ):
+        raise ValueError(
+            "producer-staged payloads route only to the multi-rank SRA "
+            f"transport (got reduction={reduction!r}, ws={ws})"
+        )
     if ws == 1:
         if cc.enabled and cfg_mod.force_codec():
             # CGX_DEBUG_FORCE_CODEC: emulate the per-rank codec work of a
@@ -609,7 +665,7 @@ def quantized_allreduce(
     if not cc.enabled or reduction == cfg_mod.REDUCTION_PSUM:
         return lax.psum(x, axis_name)
     if reduction == cfg_mod.REDUCTION_SRA:
-        return sra_allreduce(x, axis_name, ws, cc, key)
+        return sra_allreduce(x, axis_name, ws, cc, key, pre)
     if reduction == cfg_mod.REDUCTION_RING:
         return ring_allreduce(x, axis_name, ws, cc, key)
     if reduction == cfg_mod.REDUCTION_ALLTOALL:

@@ -319,6 +319,7 @@ def pipelined_quantized_allreduce(
     sched: CompiledSchedule,
     *,
     with_wire: bool = False,
+    pre=None,
 ):
     """Software-pipelined SRA allreduce of one fusion slice (inside
     shard_map): the slice's (ws, chunk) wire layout is split into the
@@ -336,16 +337,32 @@ def pipelined_quantized_allreduce(
     ``with_wire=True`` also returns this device's wire decode (the EF
     residual base — same quantize-once payload sharing as
     ``sra_allreduce_with_wire``), assembled from the per-block stage-1
-    payloads."""
+    payloads.
+
+    ``pre``: a producer-staged payload (``ops.fused_producer.Produced``)
+    whose ``q_blocks`` were quantized per column block against THIS
+    schedule's table (the consumer verifies the tables match before
+    routing here): each block's quantize is skipped and the raw own
+    chunk comes from ``pre.raw_row`` slices — the f32 buffer is never
+    read."""
     if reduction != cfg_mod.REDUCTION_SRA:
         raise ValueError(
             f"pipelined schedules cover the SRA transport only, got "
             f"{reduction!r} (compiled_schedule should have returned None)"
         )
+    if pre is not None and (
+        pre.q_blocks is None or len(pre.q_blocks) != sched.depth
+    ):
+        raise ValueError(
+            "producer-staged payload's block plan does not match the "
+            "compiled schedule (consumer-side table check missed?)"
+        )
     _note_pipeline(sched, reduction)
     depth = sched.depth
     n = x.shape[0]
-    xs = reducers._pad_rows(x, ws, sched.chunk)  # (ws, chunk), monolithic
+    xs = (
+        reducers._pad_rows(x, ws, sched.chunk) if pre is None else None
+    )  # (ws, chunk), monolithic
     own_idx = lax.axis_index(axis_name)
     own = (jnp.arange(ws) == own_idx)[:, None]
     exchanged: list = [None] * depth
@@ -357,14 +374,23 @@ def pipelined_quantized_allreduce(
         # blocks of one slice must not share fold sequences.
         return jax.random.fold_in(key, c) if key is not None else None
 
+    def _raw_c(c: int):
+        """Block c's slice of the producer raw own row."""
+        off, w = sched.table[c]
+        return lax.slice(pre.raw_row, (off,), (off + w,))
+
     def start(c: int) -> None:
         """Stage 1 of block c: quantize its columns + put on the wire."""
         off, w = sched.table[c]
-        xs_c = lax.slice(xs, (0, off), (ws, off + w))
         kc = _block_key(c)
-        q = reducers._quantize_rows(
-            xs_c, cc, reducers._phase_key(kc, 1, axis_name)
-        )
+        if pre is not None:
+            q = pre.q_blocks[c]
+            xs_c = None
+        else:
+            xs_c = lax.slice(xs, (0, off), (ws, off + w))
+            q = reducers._quantize_rows(
+                xs_c, cc, reducers._phase_key(kc, 1, axis_name)
+            )
         q_recv = jax.tree.map(
             lambda a: lax.all_to_all(a, axis_name, 0, 0), q
         )
@@ -374,13 +400,15 @@ def pipelined_quantized_allreduce(
         """Stages 2+3 of block c: fused epilogue + allgather + decode."""
         kc, q, q_recv, xs_c = exchanged[c]
         q_own = reducers._sra_epilogue_q(
-            q_recv, xs_c, own_idx, axis_name, cc, kc, x.dtype
+            q_recv, xs_c, own_idx, axis_name, cc, kc, x.dtype,
+            raw_row=_raw_c(c) if pre is not None else None,
         )
         gathered = reducers._gather_rows(q_own, axis_name)
         outs[c] = reducers._dequantize_rows(gathered)  # (ws, w)
         if with_wire:
             rt_rows = reducers._dequantize_rows(q)
-            rts[c] = jnp.where(own, xs_c.astype(rt_rows.dtype), rt_rows)
+            raw_b = xs_c if pre is None else _raw_c(c)[None]
+            rts[c] = jnp.where(own, raw_b.astype(rt_rows.dtype), rt_rows)
         exchanged[c] = None  # release the traced intermediates
 
     # The software pipeline: fill one block ahead, then steady-state.

@@ -104,6 +104,7 @@ def staged_quantized_allreduce(
     cc: CompressionConfig,
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
+    pre=None,
 ) -> jax.Array:
     """The staged single-program body for one intra-slice fusion slice
     (inside shard_map): the same quantize -> exchange -> fused epilogue ->
@@ -113,7 +114,9 @@ def staged_quantized_allreduce(
     the trace-time ``cgx.xla.*`` accounting the bridge spans no longer
     cover."""
     _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
-    return reducers.quantized_allreduce(x, axis_name, ws, cc, reduction, key)
+    return reducers.quantized_allreduce(
+        x, axis_name, ws, cc, reduction, key, pre
+    )
 
 
 def staged_quantized_allreduce_with_wire(
@@ -123,6 +126,7 @@ def staged_quantized_allreduce_with_wire(
     cc: CompressionConfig,
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
+    pre=None,
 ):
     """Error-feedback sibling of :func:`staged_quantized_allreduce`:
     ``(reduced, wire_decode)`` from one staged program (the wire decode
@@ -130,7 +134,7 @@ def staged_quantized_allreduce_with_wire(
     wraps)."""
     _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
     return reducers.quantized_allreduce_with_wire(
-        x, axis_name, ws, cc, reduction, key
+        x, axis_name, ws, cc, reduction, key, pre
     )
 
 
@@ -142,18 +146,20 @@ def staged_pipelined_allreduce(
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
     sched=None,
+    pre=None,
 ):
     """Schedule-compiled sibling of :func:`staged_quantized_allreduce`:
     the fusion slice runs as a chunked software pipeline compiled into
     the same single staged program (``parallel/schedule.py`` — chunk k+1
     quantizes while chunk k is on the wire and chunk k-1 runs the fused
     epilogue). Same ``cgx.xla.*`` trace accounting plus the schedule's
-    own ``cgx.sched.*`` counters."""
+    own ``cgx.sched.*`` counters. ``pre``: producer-staged per-block
+    payloads (table pre-verified by the consumer)."""
     from . import schedule as sched_mod
 
     _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
     return sched_mod.pipelined_quantized_allreduce(
-        x, axis_name, ws, cc, reduction, key, sched
+        x, axis_name, ws, cc, reduction, key, sched, pre=pre
     )
 
 
@@ -165,6 +171,7 @@ def staged_pipelined_allreduce_with_wire(
     reduction: str = cfg_mod.REDUCTION_SRA,
     key: Optional[jax.Array] = None,
     sched=None,
+    pre=None,
 ):
     """Error-feedback sibling of :func:`staged_pipelined_allreduce`:
     ``(reduced, wire_decode)``, the per-chunk wire decodes concatenated
@@ -173,7 +180,7 @@ def staged_pipelined_allreduce_with_wire(
 
     _note_staged_slice(x.shape[0], ws, cc, reduction, topology.ROUTE_STAGED)
     return sched_mod.pipelined_quantized_allreduce(
-        x, axis_name, ws, cc, reduction, key, sched, with_wire=True
+        x, axis_name, ws, cc, reduction, key, sched, with_wire=True, pre=pre
     )
 
 

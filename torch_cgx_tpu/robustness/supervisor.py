@@ -121,6 +121,15 @@ def invalidate_trace_caches() -> None:
         sys.modules["torch_cgx_tpu.parallel.schedule"].invalidate_schedule_cache(
             "recovery reconfigure"
         )
+    # Codec autotune memo: entries themselves are chip-keyed (world-size
+    # independent), but the memo is a trace-time cache like the layout
+    # and schedule LRUs — drop it with them so post-recovery traces
+    # re-read the persisted state instead of serving the dead
+    # generation's in-memory image (cgx.codec.autotune_invalidations).
+    if "torch_cgx_tpu.ops.autotune" in sys.modules:
+        sys.modules["torch_cgx_tpu.ops.autotune"].invalidate(
+            "recovery reconfigure"
+        )
     # The health engine's per-peer wait state is a pre-recovery stream
     # too: an evicted peer whose wait EWMA froze at the timeout value
     # would otherwise re-emit a phantom straggler event every cooldown
