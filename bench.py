@@ -871,8 +871,21 @@ def _sched_bridge_rank(rank, ws, initfile, mb, iters, chunks, mode, mdir, q):
     os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = str(BITS)
     os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = str(BUCKET)
     os.environ["CGX_METRICS_DIR"] = mdir
-    os.environ["CGX_SCHED_CHUNKS"] = str(chunks)
-    os.environ["CGX_SCHEDULE"] = "on" if mode == "pipe" else "off"
+    if mode == "plan":
+        # Planner mode (bench.py --planner): the step planner owns the
+        # depth decision through the ENV-ONLY bridge plane — CGX_PLANNER
+        # plus (for the calibrated run) the CGX_PLANNER_MODEL file the
+        # parent wrote. The rank process deliberately does NOT import
+        # the parallel package: it exercises exactly the pure-bridge
+        # path (backend._plan_bridge_chunks, the dependency-light
+        # mirror), and stays import-symmetric with the static ranks so
+        # the A/B measures the decision, not the process footprint.
+        os.environ["CGX_PLANNER"] = "on"
+        os.environ.pop("CGX_SCHEDULE", None)
+        os.environ.pop("CGX_SCHED_CHUNKS", None)
+    else:
+        os.environ["CGX_SCHED_CHUNKS"] = str(chunks)
+        os.environ["CGX_SCHEDULE"] = "on" if mode == "pipe" else "off"
     import zlib
 
     import torch
@@ -901,13 +914,17 @@ def _sched_bridge_rank(rank, ws, initfile, mb, iters, chunks, mode, mdir, q):
         timeline.flush()
         if rank == 0:
             wall = _m.get("cgx.sched.wall_s")
-            q.put({
+            rec = {
                 "t_ms": dt * 1e3,
                 "crc": zlib.crc32(res.numpy().tobytes()),
                 "live_overlap": (
                     _m.get("cgx.sched.overlap_s") / wall if wall else 0.0
                 ),
-            })
+            }
+            if mode == "plan":
+                # the depth the mirror actually ran (gauge set per call)
+                rec["chunks"] = int(_m.get("cgx.plan.bridge_chunks") or 1)
+            q.put(rec)
     finally:
         dist.destroy_process_group()
 
@@ -956,6 +973,14 @@ def _sched_bridge_child(mb: int, ws: int, iters: int, chunks: int,
         rec["overlap_frac"] = (
             round(sum(fracs) / len(fracs), 4) if fracs else 0.0
         )
+        if mode == "plan":
+            # span-calibrated cost model of THIS run (rates + overlap):
+            # computed post-measurement in the child, never in a rank —
+            # the parent fits the per-chunk overhead across runs and
+            # persists the result for the calibrated planner run.
+            from torch_cgx_tpu.parallel import planner as _planner
+
+            rec["model"] = _planner.CostModel.from_spans(mdir).as_dict()
     print(json.dumps(rec))
 
 
@@ -1020,6 +1045,151 @@ def bench_schedule(mb: int = 32, ws: int = 4, iters: int = 4,
             "overlap_frac_monolithic": mono["overlap_frac"],
             "overlap_frac_pipelined": pipe["overlap_frac"],
             "live_overlap_pipelined": round(pipe.get("live_overlap", 0.0), 4),
+            "bridge": "ProcessGroupCGX shm/store, ws real processes",
+        },
+    }
+
+
+def bench_planner(mb: int = 32, ws: int = 4, iters: int = 4) -> dict:
+    """Planner-vs-static record (the ISSUE 12 acceptance row): the full
+    closed loop on the production bridge —
+
+    1. **static baseline**: ``CGX_SCHEDULE=on`` at the default
+       ``CGX_SCHED_CHUNKS`` (the configuration a hand-tuned job runs);
+    2. **calibration run**: ``CGX_PLANNER=on`` under the built-in
+       default model (the mirror's depth), leaving span telemetry;
+    3. the parent builds the span-calibrated ``CostModel`` and fits the
+       per-chunk overhead from the TWO measured (depth, time) points —
+       the rates say how the exposed stage amortizes, the two
+       measurements pin what each extra chunk really costs on this box;
+    4. **planner run**: the calibrated model persisted to a
+       ``CGX_PLANNER_MODEL`` file every rank loads (the group-consistent
+       channel) — the planner's OWN depth decision, measured.
+
+    Static and planner configs take the min of two child runs each (the
+    least-contended estimate — see ``_best_of``). Bit-equality
+    pre-flight across all runs (the deterministic schedule contract:
+    any depth, same bytes), ``overlap_frac`` attached, and
+    predicted-vs-measured carried for ``bench_gate``'s prediction floor
+    (``pred_ratio`` trajectory + ``CGX_GATE_PRED_SLACK`` hard check).
+    ``vs_baseline`` >= 1.0 = the planner's calibrated decision beats
+    (or ties) the static configuration."""
+    import dataclasses
+    import tempfile
+
+    from torch_cgx_tpu.config import DEFAULT_SCHED_CHUNKS
+    from torch_cgx_tpu.parallel import planner as planner_mod
+
+    n = mb * 2**20 // 4
+    if (-(-n // ws)) % BUCKET:
+        raise ValueError(
+            f"--mb {mb} at ws {ws} is not bucket-aligned (ceil(n/ws) must "
+            f"divide by {BUCKET}) — the bit-equality pre-flight needs an "
+            "aligned payload"
+        )
+    me = str(Path(__file__).resolve())
+    env = {**os.environ}
+    for k in ("CGX_SCHEDULE", "CGX_SCHED_CHUNKS", "CGX_PLANNER",
+              "CGX_PLANNER_MODEL"):
+        env.pop(k, None)
+
+    def _best_of(n_runs, extra_env, *args):
+        """min-t_ms of repeated child runs — the least-contended
+        estimate; a shared box's load spikes inflate individual runs by
+        ±25%, and a single-sample A/B would measure the scheduler, not
+        the schedule."""
+        recs = [
+            _run_json_child(
+                [sys.executable, me, "--schedule-bridge-child", *args],
+                {**env, **extra_env},
+            )
+            for _ in range(n_runs)
+        ]
+        return min(recs, key=lambda r: r["t_ms"])
+
+    static = _best_of(
+        2, {}, str(mb), str(ws), str(iters), str(DEFAULT_SCHED_CHUNKS),
+        "pipe",
+    )
+    cal = _run_json_child(
+        [sys.executable, me, "--schedule-bridge-child",
+         str(mb), str(ws), str(iters), "0", "plan"], env,
+    )
+    # Two-point overhead fit: t(c) = B + E/c + c*O with E (the exposed
+    # non-bottleneck stage) from the calibrated rates; the static and
+    # calibration runs measured t at two depths, so O falls out of the
+    # difference (B cancels). Guarded to stay positive.
+    model = planner_mod.CostModel.from_dict(cal["model"])
+    rates_only = dataclasses.replace(model, chunk_overhead_s=0.0)
+    exposed = rates_only.predict_slice(
+        n, ws, BITS, BUCKET, chunks=1, route="bridge"
+    ) - rates_only.predict_slice(
+        n, ws, BITS, BUCKET, chunks=10**9, route="bridge"
+    )
+    c_s, t_s = DEFAULT_SCHED_CHUNKS, static["t_ms"] / 1e3
+    c_c, t_c = max(1, int(cal["chunks"])), cal["t_ms"] / 1e3
+    if c_c != c_s:
+        overhead = ((t_c - t_s) - exposed * (1 / c_c - 1 / c_s)) / (c_c - c_s)
+    else:
+        overhead = model.chunk_overhead_s
+    overhead = max(overhead, 1e-6)
+    fitted = dataclasses.replace(
+        model, chunk_overhead_s=overhead, source=model.source + "+2pt"
+    )
+    with tempfile.TemporaryDirectory() as d:
+        mpath = os.path.join(d, "cost_model.json")
+        fitted.save(mpath)
+        plan = _best_of(
+            2, {"CGX_PLANNER_MODEL": mpath},
+            str(mb), str(ws), str(iters), "0", "plan",
+        )
+    crcs = {static["crc"], cal["crc"], plan["crc"]}
+    if len(crcs) != 1:
+        raise AssertionError(
+            "planner bench: results diverge across runs "
+            f"(crcs {sorted(crcs)}) — the planner must only pick knobs, "
+            "never change bytes"
+        )
+    t_p = plan["t_ms"]
+    depth = max(1, int(plan["chunks"]))
+    # The model's own prediction for the depth it chose, anchored at the
+    # measured calibration point (B from t_c at depth c_c).
+    predicted_ms = (
+        t_c + exposed * (1 / depth - 1 / c_c) + (depth - c_c) * overhead
+    ) * 1e3
+    gbytes = mb * 2**20 / 1e9
+    return {
+        "metric": f"planner_vs_static_{BITS}bit_{mb}MB_x{ws}",
+        "value": round(gbytes / (t_p / 1e3), 3),
+        "unit": "GB/s",
+        # >= 1.0 = the planner's calibrated decision beats the static
+        # configuration — the acceptance bar.
+        "vs_baseline": round(static["t_ms"] / t_p, 3),
+        "overlap_frac": plan["overlap_frac"],
+        # bench_gate's prediction floor: the trajectory key
+        # planner_vs_static_*:pred_ratio plus the hard slack pair.
+        "predicted_step_ms": round(predicted_ms, 3),
+        "measured_step_ms": round(t_p, 3),
+        "pred_ratio": round(predicted_ms / t_p, 4) if t_p else 0.0,
+        # Host-plane measurement (the bridge always runs on host CPU) —
+        # a genuine trajectory, like bench_schedule/shm_bench.
+        "backend": "host",
+        "chip": "host",
+        "detail": {
+            "t_planned_ms": round(t_p, 3),
+            "t_static_ms": round(static["t_ms"], 3),
+            "t_calibration_ms": round(cal["t_ms"], 3),
+            "planner_chunks": depth,
+            "static_chunks": DEFAULT_SCHED_CHUNKS,
+            "calibration_chunks": c_c,
+            "fitted_overhead_ms": round(overhead * 1e3, 3),
+            "cost_model": fitted.source,
+            "ws": ws,
+            "payload_MB": mb,
+            "iters": iters,
+            "results": "bit-equal (crc32 of full tensor asserted, 3 runs)",
+            "overlap_frac_static": static["overlap_frac"],
+            "overlap_frac_planned": plan["overlap_frac"],
             "bridge": "ProcessGroupCGX shm/store, ws real processes",
         },
     }
@@ -1412,6 +1582,30 @@ def main() -> None:
                         f"got {val!r}"
                     )
         result = bench_schedule(**kw)
+        rc = _gate_and_log([result])
+        print(json.dumps(result))
+        sys.exit(rc)
+    if argv and argv[0] == "--planner":
+        # Planner-vs-static record (tools/hw_session.sh queues this):
+        # bridge children are fresh CPU-pinned process groups — the
+        # planner calibrates from the run's own telemetry, the static
+        # child reruns its chosen knobs by hand, and the committed row
+        # carries predicted-vs-measured for the bench_gate floor.
+        _preflight_lint()
+        kw = {}
+        for flag, name in (("--mb", "mb"), ("--ws", "ws"),
+                           ("--iters", "iters")):
+            if flag in argv:
+                idx = argv.index(flag) + 1
+                val = argv[idx] if idx < len(argv) else ""
+                try:
+                    kw[name] = int(val)
+                except ValueError:
+                    sys.exit(
+                        f"bench: {flag} requires an integer value, "
+                        f"got {val!r}"
+                    )
+        result = bench_planner(**kw)
         rc = _gate_and_log([result])
         print(json.dumps(result))
         sys.exit(rc)

@@ -280,3 +280,94 @@ def test_overlap_placeholder_rows_key_cpu_trajectory():
         [_sched_rec(0.01, backend="cpu")], baselines, 30.0
     )
     assert not regressions and not checks
+
+
+# ---------------------------------------------------------------------------
+# Cost-model prediction floor (ISSUE 12): the <metric>:pred_ratio
+# trajectory + the hard CGX_GATE_PRED_SLACK check.
+# ---------------------------------------------------------------------------
+
+
+def test_pred_normalizer_yields_third_trajectory():
+    bg = _load_gate()
+    rec = {
+        "metric": "planner_vs_static_4bit_32MB_x4",
+        "value": 1.2, "unit": "GB/s",
+        "pred_ratio": 1.1,
+        "predicted_step_ms": 110.0, "measured_step_ms": 100.0,
+        "backend": "host", "chip": "host",
+    }
+    # the gated value is prediction ACCURACY min(r, 1/r): symmetric
+    # around the 1.0 ideal, so drift in EITHER direction regresses
+    keys = dict(bg.normalize_all(rec))
+    assert keys["planner_vs_static_4bit_32MB_x4:pred_ratio"] == \
+        pytest.approx(1 / 1.1)
+    # derived from the ms pair when the ratio field is absent
+    del rec["pred_ratio"]
+    keys = dict(bg.normalize_all(rec))
+    assert keys["planner_vs_static_4bit_32MB_x4:pred_ratio"] == \
+        pytest.approx(1 / 1.1)
+    # an underpredicting model maps to the same accuracy
+    rec["pred_ratio"] = 1 / 1.1
+    keys = dict(bg.normalize_all(rec))
+    assert keys["planner_vs_static_4bit_32MB_x4:pred_ratio"] == \
+        pytest.approx(1 / 1.1)
+
+
+def test_pred_placeholder_rows_key_cpu_trajectory():
+    bg = _load_gate()
+    rec = {
+        "metric": "planner_vs_static_4bit_32MB_x4",
+        "pred_ratio": 0.9, "backend": "cpu", "chip": "cpu",
+    }
+    norm = bg.normalize_pred(rec)
+    assert norm is not None
+    assert norm[0].endswith(":pred_ratio@cpu")
+
+
+def test_pred_slack_violation_fails_loudly(monkeypatch):
+    # A record whose measured step exceeds predicted*slack fails the
+    # candidate gate with NO history needed — the planner's own
+    # prediction is the floor (planner regression / cost-model drift).
+    bg = _load_gate()
+    monkeypatch.delenv("CGX_GATE_PRED_SLACK", raising=False)
+    bad = {
+        "metric": "planner_vs_static_4bit_32MB_x4",
+        "predicted_step_ms": 100.0, "measured_step_ms": 151.0,
+    }
+    ok = {
+        "metric": "planner_vs_static_4bit_32MB_x4",
+        "predicted_step_ms": 100.0, "measured_step_ms": 149.0,
+    }
+    fails = bg.check_pred_slack([bad, ok])
+    assert len(fails) == 1
+    assert fails[0]["metric"] == "planner_vs_static_4bit_32MB_x4:pred_slack"
+    # env knob moves the floor
+    monkeypatch.setenv("CGX_GATE_PRED_SLACK", "2.0")
+    assert bg.check_pred_slack([bad]) == []
+    # explicit argument wins over env
+    assert len(bg.check_pred_slack([bad], 1.2)) == 1
+
+
+def test_pred_ratio_regression_fails_the_gate():
+    bg = _load_gate()
+    history = [
+        {"metric": "planner_vs_static_4bit_32MB_x4", "pred_ratio": r,
+         "backend": "host", "chip": "host"}
+        for r in (1.0, 1.05, 0.95)
+    ]
+    baselines = bg.build_baselines(history)
+    # accuracies: (1.0, 1/1.05, 0.95) -> median 1/1.05
+    assert baselines["planner_vs_static_4bit_32MB_x4:pred_ratio"] == \
+        pytest.approx(1 / 1.05)
+    # drift in EITHER direction fails: heavy underprediction...
+    cand = [{"metric": "planner_vs_static_4bit_32MB_x4", "pred_ratio": 0.4,
+             "backend": "host", "chip": "host"}]
+    regressions, _checks = bg.gate(cand, baselines, 30.0)
+    assert len(regressions) == 1
+    assert regressions[0]["metric"].endswith(":pred_ratio")
+    # ...and unbounded OVERprediction (ratio 5.0 -> accuracy 0.2)
+    cand = [{"metric": "planner_vs_static_4bit_32MB_x4", "pred_ratio": 5.0,
+             "backend": "host", "chip": "host"}]
+    regressions, _checks = bg.gate(cand, baselines, 30.0)
+    assert len(regressions) == 1

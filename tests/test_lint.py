@@ -747,3 +747,78 @@ def test_linter_accepts_codec_metric_namespace(tmp_path):
     proc = _run_lint(bad)
     assert proc.returncode == 1
     assert "undocumented cgx sub-namespace" in proc.stdout
+
+
+def test_linter_flags_registry_mutation_outside_planner(tmp_path):
+    # ISSUE 12: once the planner owns the layout/schedule/plan LRUs and
+    # the controller registry writes, a NEW library module mutating them
+    # directly forks the decision plane — lint failure.
+    ldir = tmp_path / "torch_cgx_tpu" / "parallel"
+    ldir.mkdir(parents=True)
+    bad = ldir / "newlever.py"
+    bad.write_text(
+        "from ..wire import edges\n"
+        "def tweak(cfg):\n"
+        "    edges.set_edge_config('moe_a2a', '.*', cfg)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "registry mutation" in proc.stdout
+    bad2 = ldir / "newlever2.py"
+    bad2.write_text(
+        "from . import allreduce\n"
+        "def reset():\n"
+        "    allreduce.invalidate_layout_cache('my own reasons')\n"
+    )
+    proc = _run_lint(bad2)
+    assert proc.returncode == 1
+    assert "registry mutation" in proc.stdout
+
+
+def test_linter_accepts_registry_mutation_in_owner_and_legacy(tmp_path):
+    # The planner itself and the legacy inert path (controller/adaptive/
+    # supervisor/registry homes) stay allowlisted.
+    pdir = tmp_path / "torch_cgx_tpu" / "parallel"
+    pdir.mkdir(parents=True)
+    owner = pdir / "planner.py"
+    owner.write_text(
+        "from ..wire import edges\n"
+        "def adopt(cfg):\n"
+        "    edges.set_edge_config('moe_a2a', '.*', cfg)\n"
+    )
+    wdir = tmp_path / "torch_cgx_tpu" / "wire"
+    wdir.mkdir()
+    legacy = wdir / "controller.py"
+    legacy.write_text(
+        "from . import edges\n"
+        "def _apply(cfg):\n"
+        "    edges.set_edge_config('moe_a2a', '.*', cfg)\n"
+    )
+    proc = _run_lint(owner, legacy)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_registry_rule_scoped_to_library(tmp_path):
+    # Tests/tools/benches legitimately poke registries to set up
+    # scenarios — out of scope.
+    ok = tmp_path / "mytest.py"
+    ok.write_text(
+        "import torch_cgx_tpu.wire.edges as edges\n"
+        "def setup(cfg):\n"
+        "    edges.set_edge_config('moe_a2a', '.*', cfg)\n"
+    )
+    proc = _run_lint(ok)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_plan_metric_namespace(tmp_path):
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.plan.cache_hits')\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout

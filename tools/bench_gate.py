@@ -37,6 +37,18 @@ gate makes it mechanical:
   floors from BASELINE.json apply. A schedule change that quietly
   re-serializes communication fails here even when GB/s barely moves
   (ROADMAP item 2's explicit ask).
+* **prediction floor** — records carrying the step planner's own
+  cost-model prediction (``pred_ratio`` = predicted / measured step
+  time, plus the raw ``predicted_step_ms``/``measured_step_ms`` pair —
+  the ``bench.py --planner`` rows) gate a third trajectory,
+  ``<metric>:pred_ratio``, whose gated value is prediction ACCURACY
+  ``min(r, 1/r)`` — 1.0 = perfect model, and drift in EITHER direction
+  (under- or over-prediction) regresses. Candidates additionally
+  meet a HARD floor: a record whose measured step time exceeds
+  ``predicted * CGX_GATE_PRED_SLACK`` (env; ``--pred-slack`` overrides;
+  default 1.5) fails loudly regardless of trajectory history — a
+  planner regression and cost-model drift are both caught, the ISSUE 12
+  ask. ``@cpu`` separation applies exactly as for throughput.
 * **candidate** — a fresh run's JSON records (``--candidate file`` or
   ``-`` for stdin, same schemas the tools print).
 * **verdict** — a candidate value more than ``--threshold`` percent
@@ -162,12 +174,96 @@ def normalize_overlap(rec: dict) -> Optional[Tuple[str, float]]:
     return key, float(v)
 
 
+# Cost-model prediction floor (ISSUE 12): planner bench records carry
+# the model's own step-time prediction next to the measurement. The
+# gated trajectory value is prediction ACCURACY — min(r, 1/r) of the
+# predicted/measured ratio, 1.0 = perfect, lower = drift in EITHER
+# direction (a one-sided higher-is-better ratio gate would fail a model
+# whose overprediction improved toward 1.0 and could never fail one
+# drifting into unbounded overprediction). The hard slack check below
+# additionally catches a blown UNDERprediction in a single candidate
+# run with no history.
+_PRED_SUFFIX = ":pred_ratio"
+_DEFAULT_PRED_SLACK = 1.5
+
+
+def pred_slack() -> float:
+    """CGX_GATE_PRED_SLACK: how far a measured step time may exceed the
+    planner's prediction before the candidate fails outright."""
+    try:
+        v = float(os.environ.get("CGX_GATE_PRED_SLACK", ""))
+    except ValueError:
+        return _DEFAULT_PRED_SLACK
+    return v if v > 0 else _DEFAULT_PRED_SLACK
+
+
+def normalize_pred(rec: dict) -> Optional[Tuple[str, float]]:
+    """(``<metric>:pred_ratio`` key, accuracy ``min(r, 1/r)``) for
+    records carrying the planner's prediction, or None. The raw ratio
+    ``r`` (predicted/measured) is taken from the record when present,
+    else derived from the ``predicted_step_ms``/``measured_step_ms``
+    pair; the gated value is symmetric around the 1.0 ideal."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return None
+    metric = rec.get("metric")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return None
+    v = rec.get("pred_ratio")
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        p, m = rec.get("predicted_step_ms"), rec.get("measured_step_ms")
+        if (
+            isinstance(p, (int, float)) and isinstance(m, (int, float))
+            and not isinstance(p, bool) and not isinstance(m, bool)
+            and m > 0
+        ):
+            v = p / m
+        else:
+            return None
+    if v <= 0:
+        return None
+    key = f"{metric}{_PRED_SUFFIX}"
+    if is_placeholder(rec):
+        key += _PLACEHOLDER_SUFFIX
+    return key, min(float(v), 1.0 / float(v))
+
+
+def check_pred_slack(
+    candidates: List[dict], slack: Optional[float] = None
+) -> List[dict]:
+    """The HARD prediction floor over a candidate set: any record whose
+    measured step time exceeds ``predicted * slack`` fails loudly (no
+    baseline history needed — the planner's own prediction IS the
+    floor)."""
+    slack = pred_slack() if slack is None else slack
+    out: List[dict] = []
+    for rec in candidates:
+        if not isinstance(rec, dict) or rec.get("unresolved"):
+            continue
+        metric = rec.get("metric")
+        p, m = rec.get("predicted_step_ms"), rec.get("measured_step_ms")
+        if not metric or not isinstance(p, (int, float)) or not isinstance(
+            m, (int, float)
+        ) or isinstance(p, bool) or isinstance(m, bool) or p <= 0:
+            continue
+        if m > p * slack:
+            key = f"{metric}:pred_slack"
+            if is_placeholder(rec):
+                key += _PLACEHOLDER_SUFFIX
+            out.append({
+                "metric": key,
+                "value": round(m, 3),
+                "baseline": round(p * slack, 3),
+                "delta_pct": round((p * slack - m) / (p * slack) * 100.0, 1),
+            })
+    return out
+
+
 def normalize_all(rec: dict) -> List[Tuple[str, float]]:
     """Every gated (key, higher-is-better value) pair one record yields:
     its throughput trajectory and, when present, its overlap-fraction
-    trajectory."""
+    and prediction-ratio trajectories."""
     out = []
-    for fn in (normalize, normalize_overlap):
+    for fn in (normalize, normalize_overlap, normalize_pred):
         norm = fn(rec)
         if norm is not None:
             out.append(norm)
@@ -309,6 +405,12 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="max tolerated drop vs baseline, percent (default 30)",
     )
     ap.add_argument(
+        "--pred-slack", type=float, default=None,
+        help="hard prediction floor: fail a candidate whose measured "
+             "step time exceeds predicted*slack (default: "
+             "$CGX_GATE_PRED_SLACK or 1.5)",
+    )
+    ap.add_argument(
         "--smoke", action="store_true",
         help="self-check the committed trajectory (latest vs history)",
     )
@@ -339,6 +441,11 @@ def main(argv: Optional[List[str]] = None) -> int:
             pass
         baselines = build_baselines(history, published)
         regressions, checks = gate(candidates, baselines, args.threshold)
+        # The hard prediction floor needs no history: the planner's own
+        # cost-model prediction rides in the record.
+        slack_fails = check_pred_slack(candidates, args.pred_slack)
+        checks.extend(slack_fails)
+        regressions.extend(slack_fails)
     else:
         ap.error("one of --candidate or --smoke is required")
         return 2  # unreachable; argparse exits

@@ -163,16 +163,38 @@ def summarize(data: dict) -> dict:
                     row["phase"] = "retry"
                 recovery_events.append(row)
     # Newest exporter line per rank folds in counters the dumps may miss.
+    step_p50 = None  # measured step time (the planner section's contrast)
+    # Planner gauges are LEVELS, never tallies: they fold max-within-rank
+    # into their own table (NOT rank_counters, whose totals sum across
+    # ranks — 4 ranks at pred_ratio 0.97 must not report 3.88).
+    plan_gauges_by_rank: Dict[int, Dict[str, float]] = defaultdict(dict)
     for rank, lines in data["metrics"].items():
         if not lines:
             continue
         for k, v in (lines[-1].get("counters") or {}).items():
             if isinstance(v, (int, float)):
                 _fold_counter(rank, k, v, flat=False)
+        for k, v in (lines[-1].get("gauges") or {}).items():
+            if isinstance(v, (int, float)) and k.startswith("cgx.plan."):
+                g = plan_gauges_by_rank[rank]
+                g[k] = max(g.get(k, 0.0), v)
+        p50 = ((lines[-1].get("histograms") or {}).get("cgx.step.time_s")
+               or {}).get("p50")
+        if isinstance(p50, (int, float)):
+            step_p50 = max(step_p50 or 0.0, p50)
     totals: Counter = Counter()
     for per_rank in rank_counters.values():
         for k, v in per_rank.items():
             totals[k] += v
+    # Planner decision/prediction gauges can also arrive via the dump
+    # headers' flat snapshot; scrub them from the summed totals (levels,
+    # not tallies) — the planner section below reports them max-folded.
+    _PLAN_GAUGE_PREFIXES = (
+        "cgx.plan.slice_", "cgx.plan.predicted_", "cgx.plan.pred_",
+        "cgx.plan.bridge_chunks",
+    )
+    for k in [k for k in totals if k.startswith(_PLAN_GAUGE_PREFIXES)]:
+        del totals[k]
     summary["counters"] = dict(totals)
     summary["faults"] = {
         k[len("cgx.faults."):]: int(v)
@@ -241,6 +263,53 @@ def summarize(data: dict) -> dict:
             "edges": dict(edge_bytes),
             "controller_bits": ctl_bits,
             "counters": wire_counters,
+        }
+    # Whole-step planner (parallel/planner.py): plan-cache efficiency,
+    # the cost model's predicted step time vs the measured one, and the
+    # per-slice decisions the plan staged. Counters sum across ranks;
+    # the prediction/decision gauges take max-within-rank then
+    # max-across (a decision is a level, not a tally).
+    plan_counters = {
+        k: v for k, v in totals.items()
+        if k.startswith("cgx.plan.")
+        and not k.startswith(
+            ("cgx.plan.slice_", "cgx.plan.predicted_", "cgx.plan.pred_",
+             "cgx.plan.bridge_chunks")
+        )
+    }
+    plan_gauges: Dict[str, float] = {}
+    plan_slices: Dict[str, Dict[str, int]] = defaultdict(dict)
+    for per_rank in list(rank_counters.values()) + list(
+        plan_gauges_by_rank.values()
+    ):
+        for k, v in per_rank.items():
+            if k.startswith("cgx.plan.slice_chunks."):
+                label = k[len("cgx.plan.slice_chunks."):]
+                plan_slices[label]["chunks"] = int(
+                    max(plan_slices[label].get("chunks", 0), v)
+                )
+            elif k.startswith("cgx.plan.slice_bits."):
+                label = k[len("cgx.plan.slice_bits."):]
+                plan_slices[label]["bits"] = int(
+                    max(plan_slices[label].get("bits", 0), v)
+                )
+            elif k in ("cgx.plan.predicted_step_s", "cgx.plan.pred_ratio",
+                       "cgx.plan.bridge_chunks"):
+                plan_gauges[k] = max(plan_gauges.get(k, 0.0), v)
+    if plan_counters or plan_gauges or plan_slices:
+        hits = plan_counters.get("cgx.plan.cache_hits", 0.0)
+        misses = plan_counters.get("cgx.plan.cache_misses", 0.0)
+        measured = step_p50
+        summary["planner"] = {
+            "cache_hit_rate": (
+                round(hits / (hits + misses), 3) if hits + misses else None
+            ),
+            "predicted_step_s": plan_gauges.get("cgx.plan.predicted_step_s"),
+            "measured_step_s": measured,
+            "pred_ratio": plan_gauges.get("cgx.plan.pred_ratio"),
+            "bridge_chunks": plan_gauges.get("cgx.plan.bridge_chunks"),
+            "slices": {k: dict(v) for k, v in sorted(plan_slices.items())},
+            "counters": plan_counters,
         }
     # Codec plane: autotune cache efficiency + producer-fuse consumption
     # (counters summed across ranks) and the measured roofline fraction
@@ -374,6 +443,36 @@ def render(summary: dict) -> str:
             for label, b in sorted(w["controller_bits"].items()):
                 parts.append(f"    {label}: {int(b)}")
         for k, v in sorted(w.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("planner"):
+        p = summary["planner"]
+        parts.append("\n== planner (whole-step mega-schedule) ==")
+        if p.get("cache_hit_rate") is not None:
+            parts.append(f"  plan cache hit rate: {p['cache_hit_rate']:.1%}")
+        if p.get("predicted_step_s"):
+            line = (
+                f"  predicted step: {p['predicted_step_s'] * 1e3:.2f} ms"
+            )
+            if p.get("measured_step_s"):
+                line += (
+                    f"  measured p50: {p['measured_step_s'] * 1e3:.2f} ms"
+                    f"  (pred/meas "
+                    f"{p['predicted_step_s'] / p['measured_step_s']:.2f})"
+                )
+            parts.append(line)
+        if p.get("pred_ratio"):
+            parts.append(f"  pred_ratio gauge: {p['pred_ratio']:.2f}")
+        if p.get("bridge_chunks"):
+            parts.append(
+                f"  bridge depth hint: {int(p['bridge_chunks'])} chunks"
+            )
+        if p.get("slices"):
+            rows = [
+                (label, d.get("chunks", "-"), d.get("bits", "-"))
+                for label, d in p["slices"].items()
+            ]
+            parts.append(_fmt_table(rows, ("slice", "chunks", "bits")))
+        for k, v in sorted(p.get("counters", {}).items()):
             parts.append(f"  {k}: {v:g}")
     if summary.get("codec"):
         c = summary["codec"]

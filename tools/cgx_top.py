@@ -8,7 +8,7 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  atune$  roofl  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  straggler  gen  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
@@ -25,6 +25,12 @@ dumps — and renders one row per rank:
 * **sched$** — schedule-cache hit rate ``hits/(hits+misses)`` from the
   ``cgx.sched.cache_*`` counters (a low rate mid-run means plans are
   being re-derived — churning configs or an invalidation storm).
+* **plan$** — step-plan cache hit rate (``cgx.plan.cache_*`` — the
+  whole-step planner's LRU, same reading as sched$).
+* **pred** — predicted-vs-measured step time (``cgx.plan.pred_ratio``,
+  or predicted-step gauge / step-time p50 live): < 1 means the
+  planner's cost model underpredicts reality — drift toward the
+  ``bench_gate`` prediction floor.
 * **atune$** — codec-autotune cache hit rate from the
   ``cgx.codec.autotune_*`` counters (``-`` until the tuner is
   consulted; climbs as the persisted per-chip cache warms).
@@ -234,6 +240,33 @@ def _sched_cache(m: Dict[str, float]) -> str:
     return f"{hits / total * 100:.0f}%"
 
 
+def _plan_cache(m: Dict[str, float]) -> str:
+    """Step-plan cache hit rate (``cgx.plan.cache_*`` — the whole-step
+    planner's LRU; a low rate mid-run means plans are being re-derived:
+    model churn or an invalidation storm)."""
+    hits = m.get("cgx.plan.cache_hits", 0.0)
+    misses = m.get("cgx.plan.cache_misses", 0.0)
+    total = hits + misses
+    if not total:
+        return "-"
+    return f"{hits / total * 100:.0f}%"
+
+
+def _pred(m: Dict[str, float]) -> str:
+    """Predicted-vs-measured step time: the ``cgx.plan.pred_ratio``
+    gauge when the StepPlanner published it, else derived live from the
+    predicted-step gauge over the step-time histogram p50. < 1 = the
+    cost model underpredicts reality (drift toward the bench_gate
+    slack floor)."""
+    v = m.get("cgx.plan.pred_ratio", 0.0)
+    if not v:
+        pred = m.get("cgx.plan.predicted_step_s", 0.0)
+        p50 = m.get("cgx.step.time_s.p50", 0.0)
+        if pred and p50:
+            v = pred / p50
+    return f"{v:.2f}" if v else "-"
+
+
 def _autotune_cache(m: Dict[str, float]) -> str:
     """Codec autotune cache hit rate (``cgx.codec.autotune_*``) — a
     hardware session watches this climb as the persisted per-chip cache
@@ -279,8 +312,8 @@ def render(directory: str, state: dict) -> str:
         f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
-               "edges", "overlap", "sched$", "atune$", "roofl",
-               "straggler", "gen", "last_fault")
+               "edges", "overlap", "sched$", "plan$", "pred", "atune$",
+               "roofl", "straggler", "gen", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -294,6 +327,8 @@ def render(directory: str, state: dict) -> str:
             _edge_wire(m),
             _overlap(m),
             _sched_cache(m),
+            _plan_cache(m),
+            _pred(m),
             _autotune_cache(m),
             _roofline(m),
             _straggler(d["status"]),

@@ -121,6 +121,11 @@ session() {
   # are CPU-pinned process groups — never touches the device transport,
   # and the record carries the cgx_trace overlap_frac the gate floors on.
   run_cpu 900 "sched pipelined vs monolithic" env JAX_PLATFORMS=cpu python bench.py --schedule --mb 32 --ws 4
+  # Whole-step planner vs static knobs (ISSUE 12): bridge children are
+  # CPU-pinned process groups; the record carries overlap_frac AND the
+  # planner's predicted-vs-measured step time for the bench_gate
+  # prediction floor.
+  run_cpu 900 "planner vs static" env JAX_PLATFORMS=cpu python bench.py --planner --mb 32 --ws 4
   # Unified wire plane (ISSUE 10): per-edge compressed-vs-raw records.
   # The child probes for real chips itself and falls back to a forced CPU
   # multi-device platform, so this step never wedges the device transport.

@@ -391,9 +391,13 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # "codec" joined with the roofline round-2 work (PR 11): the kernel
     # autotuner (cgx.codec.autotune_*) and the producer-fused gradient
     # quantizer (cgx.codec.producer_*) — docs/OBSERVABILITY.md.
+    # "plan" is the whole-step planner family (PR 12): plan-LRU
+    # hits/misses/invalidations, per-slice chunk/bit gauges, the
+    # predicted-step gauge and the bridge depth hints —
+    # docs/OBSERVABILITY.md "Metric namespaces".
     "codec", "collective", "faults", "flightrec", "health", "heartbeat",
-    "qerr", "recovery", "ring", "runtime", "sched", "shm", "sra", "step",
-    "trace", "wire", "xla",
+    "plan", "qerr", "recovery", "ring", "runtime", "sched", "shm", "sra",
+    "step", "trace", "wire", "xla",
 })
 
 
@@ -825,6 +829,73 @@ def check_wire_edge_routing(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+# Registry-ownership gate (ISSUE 12): the whole-step planner
+# (parallel/planner.py) owns the decision registries — the layout LRU,
+# the schedule LRU and the controller's bit writes. New library code must
+# route registry mutations through the planner (a new perf lever is a
+# cost-model change, not a new registry writer). The allowlist is the
+# planner itself plus the LEGACY INERT PATH: the registries' own modules
+# (their internal clear/invalidate plumbing), the recovery supervisor's
+# invalidation ladder, and the pre-planner writers (adaptive.apply_bit_
+# allocation, the WireController's _apply, checkpoint restore) that the
+# planner drives but does not replace.
+_REGISTRY_MUTATORS = frozenset({
+    "invalidate_layout_cache", "invalidate_schedule_cache",
+    "invalidate_plan_cache", "layout_cache_clear", "schedule_cache_clear",
+    "plan_cache_clear", "set_edge_config", "set_layer_pattern_config",
+})
+_REGISTRY_OWNER_SUFFIXES = (
+    ("parallel", "planner.py"),      # the owner
+    ("parallel", "allreduce.py"),    # layout LRU home + cascade
+    ("parallel", "schedule.py"),     # schedule LRU home
+    ("parallel", "adaptive.py"),     # legacy offline bit solver
+    ("wire", "controller.py"),       # legacy closed-loop bit writes
+    ("wire", "edges.py"),            # edge-registry home
+    ("robustness", "supervisor.py"),  # recovery invalidation ladder
+    ("config.py",),                  # registry definitions themselves
+    ("checkpoint.py",),              # snapshot restore re-registers
+)
+
+
+def check_planner_registry_ownership(path: Path, tree: ast.Module) -> list[str]:
+    """Reject direct layout-LRU / schedule-LRU / plan-LRU / controller
+    registry writes in library code outside ``parallel/planner.py`` and
+    the legacy inert path above — once the planner owns the registries,
+    a new subsystem mutating them directly would fork the decision plane
+    the planner exists to unify (docs/PERF_NOTES.md "Whole-step
+    mega-schedule"). Tests/tools/benches are out of scope (they
+    legitimately poke registries to set up scenarios)."""
+    parts = tuple(path.parts)
+    if _LIB_DIR not in parts:
+        return []
+    rel = parts[parts.index(_LIB_DIR) + 1:]
+    if any(
+        len(s) <= len(rel) and rel[len(rel) - len(s):] == s
+        for s in _REGISTRY_OWNER_SUFFIXES
+    ):
+        return []
+    findings: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if name in _REGISTRY_MUTATORS:
+            findings.append(
+                f"{path}:{node.lineno}: registry mutation '{name}()' "
+                "outside parallel/planner.py and the legacy inert path — "
+                "the step planner owns the layout/schedule/plan LRUs and "
+                "the controller registry writes; route the decision "
+                "through the planner (tools/lint.py "
+                "_REGISTRY_OWNER_SUFFIXES; docs/PERF_NOTES.md 'Whole-step "
+                "mega-schedule')"
+            )
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -913,6 +984,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_staged_purity(path, tree))
     out.extend(check_schedule_stage_blocking(path, tree))
     out.extend(check_wire_edge_routing(path, tree))
+    out.extend(check_planner_registry_ownership(path, tree))
     return out
 
 

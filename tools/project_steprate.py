@@ -8,18 +8,14 @@ grid of interconnect regimes, combine
 * the measured single-chip numbers (compute step time, codec throughput —
   newest matching entries in ``BENCH_LOG.jsonl``, falling back to the
   BASELINE.md round-3 table when the log has none), and
-* the exact wire-byte formulas the runtime wire counters meter
-  (``codec.wire_bytes`` — meta + bit-plane payload; the counters count
-  elems per executed step, the formula maps elems to wire bytes),
-
-into projected per-step times under the standard ring/SRA allreduce cost
-model: ``t_wire = 2 * (ws-1)/ws * bytes_on_wire / link_bw`` per rank
-(send+receive of every byte but your own chunk's — both SRA and ring move
-exactly this much per rank, scatter_reduce_allgather.cc:94-202).
-
-Per-rank codec work per step, from the SRA accounting used by
-``CGX_DEBUG_FORCE_CODEC`` (reducers.py:quantized_allreduce): quantize
-``n*(1 + 1/ws)`` elems, dequantize ``n*(2 - 1/ws)`` elems.
+* the planner's cost model (``parallel/planner.py CostModel`` — the
+  SAME predict_slice/predict_step the whole-step scheduler solves
+  against and ``bench_gate``'s prediction floor checks): wire bytes =
+  meta + bit-plane payload, ``t_wire = 2 * (ws-1)/ws * bytes_on_wire /
+  link_bw`` per rank, and the ``CGX_DEBUG_FORCE_CODEC`` SRA codec
+  accounting (quantize ``n*(1 + 1/ws)`` elems, dequantize
+  ``n*(2 - 1/ws)``) — this tool used to carry its own copy of those
+  formulas and could silently drift from what the planner optimizes.
 
 This is a PROJECTION, not a measurement: single-chip codec times are real
 hardware numbers, link bandwidths are the regime labels in the table, and
@@ -42,8 +38,6 @@ import os
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-
-from torch_cgx_tpu.ops import codec  # noqa: E402
 
 # BASELINE.md round-3 measured fallbacks (one v5e chip, scan-slope timing).
 R3 = {
@@ -130,21 +124,36 @@ def newest_codec_numbers(log_path: str, bits: int = 4, bucket: int = 512):
 
 
 def project(grad_bytes: float, ws: int, bits: int, bucket: int, m) -> list:
+    """Projected per-step times, predicted by the PLANNER'S cost model
+    (``parallel/planner.py CostModel`` — the same predict_slice /
+    predict_step the whole-step scheduler solves against and bench_gate
+    floors on) instead of this tool's former ad-hoc formulas: one model
+    per interconnect regime, measured codec rates in, zero per-chunk
+    overhead and no overlap credit (chunks=1, reverse_order=False — the
+    conservative monolithic projection; real pipelined overlap only
+    makes the compressed column better)."""
+    import dataclasses as _dc
+
+    from torch_cgx_tpu.parallel.planner import CostModel
+
     n = int(grad_bytes // 4)
-    wire_q = codec.wire_bytes(n, bits, bucket, 4)
-    wire_f = grad_bytes
-    # Per-rank codec seconds (SRA accounting; throughput is per input byte
-    # for quantize, per output byte for dequantize).
-    t_codec = (
-        grad_bytes * (1 + 1 / ws) / (m["quantize_GBps_in"] * 1e9)
-        + grad_bytes * (2 - 1 / ws) / (m["dequantize_GBps_out"] * 1e9)
+    base = CostModel(
+        quantize_gbps=m["quantize_GBps_in"],
+        dequantize_gbps=m["dequantize_GBps_out"],
+        overlap_frac=0.0,
+        chunk_overhead_s=0.0,
+        compute_s=m["compute_ms"] / 1e3,
+        source="project_steprate",
     )
-    t_comp = m["compute_ms"] / 1e3
     rows = []
     for name, bw in REGIMES:
-        factor = 2 * (ws - 1) / ws
-        t_f = t_comp + factor * wire_f / bw
-        t_q = t_comp + t_codec + factor * wire_q / bw
+        model = _dc.replace(base, wire_gbps=bw / 1e9)
+        t_f = model.predict_step(
+            [model.predict_slice(n, ws, 32, bucket)], reverse_order=False
+        )
+        t_q = model.predict_step(
+            [model.predict_slice(n, ws, bits, bucket)], reverse_order=False
+        )
         rows.append(
             {
                 "regime": name,

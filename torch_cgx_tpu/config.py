@@ -91,6 +91,10 @@ SCHED_CHUNKS = "CGX_SCHED_CHUNKS"  # pipeline depth (chunks per fusion slice)
 # activations and PowerSGD factors):
 WIRE = "CGX_WIRE"  # auto | on | off — edge-dispatcher engagement
 WIRE_BITS = "CGX_WIRE_BITS"  # env-default bits for unregistered edges
+# Whole-step mega-schedule planner (parallel/planner.py — PR 12):
+PLANNER = "CGX_PLANNER"  # auto | on | off — step-level plan compiler
+PLANNER_AVG_BITS = "CGX_PLANNER_AVG_BITS"  # joint-solve bit budget (0 = off)
+PLANNER_MODEL = "CGX_PLANNER_MODEL"  # calibrated CostModel json (group-wide)
 # Codec roofline round 2 (ops/codec_pallas.py + ops/autotune.py +
 # ops/fused_producer.py — PR 11):
 PALLAS_DB = "CGX_PALLAS_DB"  # auto | on | off — double-buffered DMA kernels
@@ -399,6 +403,58 @@ def schedule_mode() -> str:
     if mode not in ("auto", "on", "off"):
         raise ValueError(f"{SCHEDULE} must be auto|on|off, got {mode!r}")
     return mode
+
+
+def planner_mode() -> str:
+    """CGX_PLANNER: engagement of the whole-step schedule planner
+    (``parallel/planner.py``) — the step-level compiler that sees every
+    fusion slice and wire edge of a train step at once and jointly picks
+    (pipeline depth, bit-width, emission order) against a trace-
+    calibrated cost model:
+
+    * "auto" (default) — plan only on a real TPU backend; on every
+      CPU/CI path no plan is derived and the staged programs, store keys
+      and wire bytes are bit-identical to the pre-planner code
+      (jaxpr-pinned in tests/test_planner.py).
+    * "on" — plan on any backend (the CPU test/bench configuration) and
+      let the bridge worker loop consume depth hints too (the bridge is
+      a host plane, so "auto means TPU" never applies there).
+    * "off" — never plan; the static knobs (``CGX_SCHED_CHUNKS``,
+      per-layer bits) govern exactly as before.
+    """
+    mode = _env.get_str_env_or_default(PLANNER, "auto").lower()
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"{PLANNER} must be auto|on|off, got {mode!r}")
+    return mode
+
+
+def planner_avg_bits() -> float:
+    """CGX_PLANNER_AVG_BITS: payload-weighted average bit-width budget of
+    the planner's joint solve — when set, the planner re-allocates bits
+    across a step's fusion slices (marginal allocation, the
+    ``adaptive.solve_bit_allocation`` solver) instead of keeping each
+    slice's resolved width. 0 (default) = keep resolved widths (the
+    bit-equality configuration: a plan then changes only chunking and
+    emission order, never wire bytes)."""
+    v = _env.get_float_env_or_default(PLANNER_AVG_BITS, 0.0)
+    if v and not 1.0 <= v <= float(MAX_BITS):
+        raise ValueError(
+            f"{PLANNER_AVG_BITS} must be 0 (off) or in [1, {MAX_BITS}], got {v}"
+        )
+    return v
+
+
+def planner_model_path() -> Optional[str]:
+    """CGX_PLANNER_MODEL: path of a persisted calibrated cost model
+    (``planner.CostModel.save``'s json) every rank loads at decision
+    time — the group-consistency channel for calibrated models: the SAME
+    bytes reach every rank (JAX-side or pure-bridge), so planner depth
+    decisions can never diverge across a group the way per-process
+    in-memory calibration could. Unset (default) = the built-in default
+    model (or a model installed in-process via
+    ``planner.set_cost_model``)."""
+    v = _env.get_str_env_or_default(PLANNER_MODEL, "")
+    return v or None
 
 
 DEFAULT_SCHED_CHUNKS = 4

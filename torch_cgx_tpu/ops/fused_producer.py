@@ -347,10 +347,21 @@ def _maybe_stash(name: str, w, dw, x, g) -> None:
     if red != cfg_mod.REDUCTION_SRA:
         return _fallback("reduction")
     decision = topo_router.route(mesh, (axis,))
+    # Step-plan depth (CGX_PLANNER): the consumer's allreduce will chunk
+    # this slice at the PLANNER'S depth when engaged, so the producer
+    # must quantize its blocks against the same table or the pre-staged
+    # payload falls back on every step (pre.table == sched.table check).
+    # decide_slice gates engagement itself; bits may differ under an
+    # avg-bits budget — the consumer's cc-identity check handles that
+    # (counted fallback), so only the depth is adopted here.
+    from ..parallel import planner as planner_mod
+
+    dec = planner_mod.decide_slice(n, ws, cc, red, route=decision.route)
     sched = sched_mod.compiled_schedule(
         n, ws, cc, reduction=red, dtype=np.dtype(jnp.float32).str,
         route=decision.route,
         route_staged=decision.route == topo_router.ROUTE_STAGED,
+        chunks=dec.chunks if dec is not None else None,
     )
     div = _CFG["divisor"]
 

@@ -37,6 +37,7 @@ from ..utils.logging import metrics
 from ..utils.tracing import named_scope
 from ..utils.tree import path_str
 from . import mesh as mesh_mod
+from . import planner as planner_mod
 from . import schedule as sched_mod
 from . import topology as topo_router
 from .reducers import (
@@ -280,6 +281,10 @@ def invalidate_layout_cache(reason: str = "reconfigure") -> None:
     # wedge the bridge's in-flight window against peers on the fresh
     # plan, so the two caches cycle together.
     sched_mod.invalidate_schedule_cache(reason)
+    # Step plans sit above both: they were solved for this world's
+    # layouts, so they cycle with them (the planner's single
+    # invalidation path — docs/PERF_NOTES.md "Whole-step mega-schedule").
+    planner_mod.invalidate_plan_cache(reason)
     from ..utils.logging import get_logger
 
     get_logger().info("allreduce layout cache invalidated (%s)", reason)
@@ -365,6 +370,7 @@ def allreduce_flat(
     slices: Optional[Sequence[Tuple[int, int]]] = None,
     decision: Optional[topo_router.RouteDecision] = None,
     pre=None,
+    plan: Optional[Sequence] = None,
 ):
     """Allreduce one fused flat buffer over 1 or 2 mesh axes (inside
     shard_map). Slicing by the fusion threshold happens here so oversized
@@ -398,7 +404,14 @@ def allreduce_flat(
     bit-identical to the pre-router code. ``decision`` lets allreduce_tree
     hand in its one-per-call routing decision — it cannot differ between
     fusion groups of the same (mesh, axes) call, so per-group
-    re-classification would only re-scan the mesh for the same answer."""
+    re-classification would only re-scan the mesh for the same answer.
+
+    ``plan``: this group's per-fusion-slice ``planner.SliceDecision``
+    sequence (aligned with ``slices``) when the step planner is engaged
+    — each decision overrides the pipeline depth handed to the schedule
+    compiler (and, under a ``CGX_PLANNER_AVG_BITS`` budget, the slice's
+    wire width). None (planner disengaged) keeps every static-knob path
+    bit-identical."""
     from . import xla_allreduce as xla_mod
 
     if decision is None:
@@ -431,13 +444,16 @@ def allreduce_flat(
         tail = lax.slice(flat, (m,), (n,))
         flat, n = lax.slice(flat, (0,), (m,)), m
         slices = None
+        plan = None  # the plan was solved for the unshaped slice list
     if slices is None:
         slices = _fusion_slices(n, np.dtype(flat.dtype).itemsize)
     pieces = []
     rt_pieces = []
-    for off, ln in slices:
+    for si, (off, ln) in enumerate(slices):
         piece = lax.slice(flat, (off,), (off + ln,))
         k = jax.random.fold_in(key, off) if key is not None else None
+        # Step-plan decision for this fusion slice (None = legacy knobs).
+        dec = plan[si] if plan is not None and si < len(plan) else None
         if len(axes) == 1:
             ws = mesh.shape[axes[0]]
             red = (
@@ -445,16 +461,29 @@ def allreduce_flat(
                 if axes[0] != mesh_mod.CROSS_AXIS
                 else topo.cross_reduction
             )
+            # Planner bit override (CGX_PLANNER_AVG_BITS joint solve):
+            # the slice ships at the plan's width. With no budget the
+            # decision carries the resolved bits and this is a no-op.
+            cc_s = cc
+            if (
+                dec is not None
+                and cc.enabled
+                and 1 <= dec.bits <= cfg_mod.MAX_BITS
+                and dec.bits != cc.bits
+            ):
+                cc_s = dataclasses.replace(cc, bits=dec.bits)
             # Schedule compiler (CGX_SCHEDULE, parallel/schedule.py): a
             # multi-chunk plan pipelines this fusion slice — chunk k+1
             # quantizes while chunk k is on the wire and chunk k-1 runs
             # the fused epilogue, all inside the same staged program.
             # None (the default everywhere off-TPU with the knob unset)
-            # keeps the monolithic path bit-identical.
+            # keeps the monolithic path bit-identical. A step-plan
+            # decision replaces the static depth knob.
             sched = sched_mod.compiled_schedule(
-                ln, ws, cc, reduction=red,
+                ln, ws, cc_s, reduction=red,
                 dtype=np.dtype(flat.dtype).str, route=decision.route,
                 route_staged=staged,
+                chunks=dec.chunks if dec is not None else None,
             )
             # Producer-staged payload: usable only when the producer's
             # block plan matches what THIS call stages (monolithic <->
@@ -466,6 +495,9 @@ def allreduce_flat(
                     ws > 1
                     and red == cfg_mod.REDUCTION_SRA
                     and not cfg_mod.dummy_compression()
+                    # A planner bit override un-matches the producer's
+                    # payload (it was quantized at the resolved width).
+                    and cc_s is cc
                     and pre.n == ln
                     and (
                         (sched is None and pre.q is not None)
@@ -518,11 +550,11 @@ def allreduce_flat(
                     pre=use_pre,
                 )
             if return_roundtrip:
-                red_piece, rt_piece = ar_wire(piece, axes[0], ws, cc, red, k)
+                red_piece, rt_piece = ar_wire(piece, axes[0], ws, cc_s, red, k)
                 pieces.append(red_piece)
                 rt_pieces.append(rt_piece)
             else:
-                pieces.append(ar(piece, axes[0], ws, cc, red, k))
+                pieces.append(ar(piece, axes[0], ws, cc_s, red, k))
         elif len(axes) == 2:
             cross_axis, intra_axis = axes
             pieces.append(
@@ -716,6 +748,26 @@ def allreduce_tree(
         paths_leaves, treedef, compress_small,
         route_key=(decision.route, decision.topo.kind),
     ).groups
+    # Whole-step plan (CGX_PLANNER, parallel/planner.py): when engaged,
+    # the planner sees ALL fusion slices of this layout at once and
+    # jointly picks (pipeline depth, bits, emission order) against its
+    # trace-calibrated cost model. Disengaged (the default everywhere
+    # off-TPU) it returns None and every legacy path below is
+    # bit-identical — the jaxpr pin in tests/test_planner.py.
+    plan = None
+    if len(axes) == 1 and planner_mod.engaged(
+        decision.route == topo_router.ROUTE_STAGED
+    ):
+        topo_p = topology or cfg_mod.topology_from_env()
+        red_p = (
+            topo_p.intra_reduction
+            if axes[0] != mesh_mod.CROSS_AXIS
+            else topo_p.cross_reduction
+        )
+        plan = planner_mod.plan_for_layout(
+            groups, mesh.shape[axes[0]], route=decision.route,
+            reduction=red_p,
+        )
     out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     rt_out: List[Optional[jax.Array]] = [None] * len(flat_leaves)
     # Emission order of the fused groups: with the schedule compiler
@@ -729,7 +781,9 @@ def allreduce_tree(
     # unset off-TPU the order (and the whole staged program) is
     # unchanged.
     order = (
-        sched_mod.dispatch_order(len(groups))
+        plan.order
+        if plan is not None
+        else sched_mod.dispatch_order(len(groups))
         if sched_mod.engaged()
         else range(len(groups))
     )
@@ -808,17 +862,18 @@ def allreduce_tree(
                 timeline.instant("allreduce_group", **group_rec)
                 # qerr stats need this device's wire decode even when the
                 # caller (no error feedback) didn't ask for it.
+                g_plan = plan.decisions[gi] if plan is not None else None
                 if return_roundtrip or qerr:
                     reduced, rt_flat = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, return_roundtrip=True, slices=g.slices,
-                        decision=decision, pre=pre_ent,
+                        decision=decision, pre=pre_ent, plan=g_plan,
                     )
                 else:
                     reduced = allreduce_flat(
                         fused, g.cc, mesh=mesh, axes=axes, topology=topology,
                         key=g_key, slices=g.slices, decision=decision,
-                        pre=pre_ent,
+                        pre=pre_ent, plan=g_plan,
                     )
                 if pre_ent is not None and pre_ent.consumed:
                     # One payload, one spend: a second allreduce of the

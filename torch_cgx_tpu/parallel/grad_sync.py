@@ -668,6 +668,14 @@ def make_train_step(
         from . import schedule as sched_mod
 
         sched_key = sched_mod.cache_key_component()
+        # Step-planner component: a CGX_PLANNER flip or an ADOPTED
+        # re-plan (the planner bumps its plan version only when the
+        # calibrated model actually moved) must retrace; an unchanged
+        # re-plan keeps the key — the no-retrace-storm half of the
+        # planner's idempotency contract.
+        from . import planner as planner_mod
+
+        planner_key = planner_mod.cache_key_component()
         # Wire-plane component: a CGX_WIRE/CGX_WIRE_BITS flip changes what
         # any routed edge inside loss_fn (ring-attention hops, MoE
         # dispatch) stages — it must retrace, never serve a trace from
@@ -704,6 +712,7 @@ def make_train_step(
             sched_key,
             wire_key,
             producer_key,
+            planner_key,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.
@@ -754,6 +763,7 @@ def make_train_step(
                 registry_version=version,
                 xla_route=list(xla_route),
                 schedule=list(sched_key),
+                planner=list(planner_key),
             )
             timeline.instant(
                 "train_step_trace",

@@ -200,14 +200,14 @@ def cache_key_component() -> Tuple:
     return (cfg_mod.schedule_mode(), cfg_mod.sched_chunks())
 
 
-def _schedule_key(n, ws, dtype, cc, route) -> Tuple:
+def _schedule_key(n, ws, dtype, cc, route, chunks) -> Tuple:
     return (
         int(n),
         int(ws),
         str(dtype),
         cc,
         route,
-        cfg_mod.sched_chunks(),
+        int(chunks),
         _chip_fingerprint(),
         cfg_mod.registry_version(),
     )
@@ -248,20 +248,30 @@ def compiled_schedule(
     dtype="float32",
     route: str = "staged",
     route_staged: bool = True,
+    chunks: Optional[int] = None,
 ) -> Optional[CompiledSchedule]:
     """The compiled pipeline plan for one fusion slice, or ``None`` when
     pipelining does not engage (mode off/auto-on-CPU, compression off,
     ws == 1, a non-SRA reduction — Ring already pipelines hop-wise by
     construction, all-to-all is the debug path — or a payload too small
     to split). Plans come from the bounded LRU
-    (``cgx.sched.cache_hits``/``cache_misses``)."""
+    (``cgx.sched.cache_hits``/``cache_misses``).
+
+    ``chunks``: an explicit depth decision from the step planner
+    (``parallel/planner.py``). When given it REPLACES both the
+    ``CGX_SCHED_CHUNKS`` knob and the mode gate — the planner's own
+    engagement gate already decided this slice pipelines (the planner is
+    the schedule compiler's front end, not a bypass: depth 1 still
+    degrades to None/monolithic and every other gate above holds)."""
     if ws <= 1 or not cc.enabled or cfg_mod.dummy_compression():
         return None
     if reduction != cfg_mod.REDUCTION_SRA:
         return None
-    if not _engaged(route_staged):
-        return None
-    key = _schedule_key(n, ws, dtype, cc, route)
+    if chunks is None:
+        if not _engaged(route_staged):
+            return None
+        chunks = cfg_mod.sched_chunks()
+    key = _schedule_key(n, ws, dtype, cc, route, chunks)
     hit = _SCHED_CACHE.get(key)
     if hit is not None:
         _SCHED_CACHE.move_to_end(key)
@@ -271,7 +281,7 @@ def compiled_schedule(
     _SCHED_STATS["misses"] += 1
     metrics.add("cgx.sched.cache_misses")
     chunk = reducers.chunk_layout(n, ws)[0]
-    table = chunk_table(chunk, cfg_mod.sched_chunks(), cc.bucket_size)
+    table = chunk_table(chunk, chunks, cc.bucket_size)
     sched: Optional[CompiledSchedule] = None
     if len(table) >= 2:
         sched = CompiledSchedule(table=table, n=n, ws=ws, chunk=chunk, cc=cc)

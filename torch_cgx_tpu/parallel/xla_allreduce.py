@@ -44,6 +44,7 @@ quantize/epilogue kernel pair per shard.
 
 from __future__ import annotations
 
+import dataclasses
 from collections import OrderedDict
 from typing import Dict, Optional, Sequence
 
@@ -272,12 +273,24 @@ def _cache_put(key, fn) -> None:
         _PROGRAM_CACHE.popitem(last=False)
 
 
-def _build_flat_program(mesh, axis, ws, cc, reduction, with_key, route):
+def _build_flat_program(
+    mesh, axis, ws, cc, reduction, with_key, route, sched=None, donate=False
+):
     """One staged program: shard_map over ``axis``, body = the staged
-    quantize -> exchange -> epilogue -> all_gather composition."""
+    quantize -> exchange -> epilogue -> all_gather composition — the
+    schedule-pipelined body when ``sched`` is given (the planner plane).
+    ``donate=True`` donates the input stack (the planner's donated-buffer
+    contract: the plan owns its step buffer, so the reduced output reuses
+    it instead of double-buffering ws*n floats)."""
 
     def body(x, key):
         _note_staged_slice(x.shape[1], ws, cc, reduction, route)
+        if sched is not None:
+            from . import schedule as sched_mod
+
+            return sched_mod.pipelined_quantized_allreduce(
+                x[0], axis, ws, cc, reduction, key, sched
+            )[None]
         return reducers.quantized_allreduce(
             x[0], axis, ws, cc, reduction, key
         )[None]
@@ -289,9 +302,10 @@ def _build_flat_program(mesh, axis, ws, cc, reduction, with_key, route):
         out_specs=P(axis),
         check_vma=False,  # pallas_call has no shard_map replication rule
     )
+    donate_args = (0,) if donate else ()
     if not with_key:
-        return jax.jit(lambda x: sharded(x, None))
-    return jax.jit(sharded)
+        return jax.jit(lambda x: sharded(x, None), donate_argnums=donate_args)
+    return jax.jit(sharded, donate_argnums=donate_args)
 
 
 def _two_level_permutation(flat_devices, tl_mesh) -> np.ndarray:
@@ -430,6 +444,71 @@ def staged_allreduce(
             mesh, axis, ws, cc, reduction, key is not None, decision.route
         )
         _cache_put(kp, fn)
+    arr = jax.device_put(per_rank, NamedSharding(mesh, P(axis)))
+    return fn(arr, key) if key is not None else fn(arr)
+
+
+def staged_allreduce_planned(
+    per_rank,
+    *,
+    mesh=None,
+    axis: Optional[str] = None,
+    cc: Optional[CompressionConfig] = None,
+    reduction: Optional[str] = None,
+    key: Optional[jax.Array] = None,
+):
+    """Planner-staged sibling of :func:`staged_allreduce` (the
+    ``planner.planned_allreduce`` entry point): the step plan's
+    (chunks, bits) decision for the whole ``(ws, n)`` payload applied as
+    ONE donated-buffer XLA program — the schedule-pipelined staged body
+    at the plan's depth, input stack donated. Falls back to
+    :func:`staged_allreduce` whenever nothing plans (planner disengaged,
+    raw config, non-SRA reduction, a payload too small to split), so the
+    call is always answerable. Programs ride the same bounded LRU under
+    a ``"planned"`` key kind that folds in the planner's cache-key
+    component — an adopted re-plan compiles a fresh program, an
+    unchanged one hits."""
+    from . import planner as planner_mod
+    from . import schedule as sched_mod
+
+    mesh = mesh if mesh is not None else mesh_mod.flat_mesh()
+    axis = axis or mesh.axis_names[0]
+    cc = cc or cfg_mod.default_compression_config()
+    reduction = reduction or cfg_mod.topology_from_env().intra_reduction
+    per_rank = jnp.asarray(per_rank)
+    ws = mesh.shape[axis]
+    n = per_rank.shape[-1]
+    decision = topology.route(mesh, (axis,), allow_remesh=True)
+    dec = planner_mod.decide_slice(
+        n, ws, cc, reduction, route=decision.route
+    )
+    if dec is None:
+        return staged_allreduce(
+            per_rank, mesh=mesh, axis=axis, cc=cc, reduction=reduction,
+            key=key,
+        )
+    cc_s = cc
+    if cc.enabled and 1 <= dec.bits <= cfg_mod.MAX_BITS and dec.bits != cc.bits:
+        cc_s = dataclasses.replace(cc, bits=dec.bits)
+    sched = sched_mod.compiled_schedule(
+        n, ws, cc_s, reduction=reduction,
+        dtype=np.dtype(per_rank.dtype).str, route=decision.route,
+        chunks=dec.chunks,
+    )
+    metrics.add("cgx.plan.staged_calls")
+    kp = _program_key(
+        mesh, axis, n, per_rank.dtype, cc_s, reduction, decision.route,
+        key is not None, "planned",
+        topo=(dec.chunks, planner_mod.cache_key_component()),
+    )
+    fn = _cache_get(kp)
+    if fn is None:
+        fn = _build_flat_program(
+            mesh, axis, ws, cc_s, reduction, key is not None,
+            decision.route, sched=sched, donate=True,
+        )
+        _cache_put(kp, fn)
+        metrics.add("cgx.plan.staged_programs")
     arr = jax.device_put(per_rank, NamedSharding(mesh, P(axis)))
     return fn(arr, key) if key is not None else fn(arr)
 

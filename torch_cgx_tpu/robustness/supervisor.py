@@ -121,6 +121,14 @@ def invalidate_trace_caches() -> None:
         sys.modules["torch_cgx_tpu.parallel.schedule"].invalidate_schedule_cache(
             "recovery reconfigure"
         )
+    # Step plans sit above the layout/schedule LRUs they were solved
+    # for; the allreduce arm cascades into the planner already, so this
+    # arm covers only a process that loaded the planner without the
+    # tree-allreduce layer (the eager planned-program plane).
+    if "torch_cgx_tpu.parallel.allreduce" not in sys.modules:
+        planner = sys.modules.get("torch_cgx_tpu.parallel.planner")
+        if planner is not None:
+            planner.invalidate_plan_cache("recovery reconfigure")
     # Codec autotune memo: entries themselves are chip-keyed (world-size
     # independent), but the memo is a trace-time cache like the layout
     # and schedule LRUs — drop it with them so post-recovery traces

@@ -54,6 +54,7 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import sys
 import threading
 import time
 import queue as _queue
@@ -330,6 +331,101 @@ def _requantize_frames(
 
 
 # ---------------------------------------------------------------------------
+def _planner_mod():
+    """The step planner (``parallel/planner.py``) — but ONLY when some
+    JAX-side caller already imported it: the bridge must never import the
+    parallel package itself (the dependency-light contract of
+    ``_sched_chunk_table`` below), so a pure bridge process sees None
+    and uses the dependency-light default-model mirror below."""
+    return sys.modules.get("torch_cgx_tpu.parallel.planner")
+
+
+# Dependency-light duplicate of planner.bridge_chunks under the DEFAULT
+# cost model (planner.CostModel.default()'s constants) — the same
+# discipline as _sched_chunk_table: engagement is decided by ENV alone
+# (cfg.planner_mode() == "on", identical on every launcher-configured
+# rank), and a rank that never imported the parallel package derives the
+# SAME depth as one that did, so mixed JAX/pure-bridge groups can never
+# frame the collective differently. Ranks that install a CALIBRATED
+# model must install the same bytes group-wide (bench.py --planner
+# builds it from the shared span files) — the same group-consistency
+# contract every CGX_* env knob already carries.
+# tests/test_planner.py pins this mirror against planner.bridge_chunks.
+_PLAN_CHUNK_CANDIDATES = (1, 2, 4, 8, 16)
+_PLAN_DEFAULT_RATES = (8.0, 16.0, 1.0, 100e-6)  # q GB/s, d GB/s, wire GB/s, overhead s
+
+# CGX_PLANNER_MODEL mirror cache: (path, mtime_ns) -> rate tuple.
+_PLAN_MODEL_CACHE: dict = {}
+
+
+def _plan_model_rates() -> Tuple[float, float, float, float]:
+    """The mirror's rate source: the CGX_PLANNER_MODEL file when set —
+    the SAME bytes the JAX-side planner loads, so calibrated decisions
+    stay group-consistent — else the built-in default constants. A
+    bad/missing file falls back to defaults (never crashes the loop)."""
+    path = cfg.planner_model_path()
+    if not path:
+        return _PLAN_DEFAULT_RATES
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return _PLAN_DEFAULT_RATES
+    key = (path, mtime)
+    hit = _PLAN_MODEL_CACHE.get(key)
+    if hit is not None:
+        return hit
+    try:
+        import json as _json
+
+        with open(path) as f:
+            d = _json.load(f)
+        rates = (
+            float(d.get("quantize_gbps", _PLAN_DEFAULT_RATES[0])),
+            float(d.get("dequantize_gbps", _PLAN_DEFAULT_RATES[1])),
+            float(d.get("wire_gbps", _PLAN_DEFAULT_RATES[2])),
+            float(d.get("chunk_overhead_s", _PLAN_DEFAULT_RATES[3])),
+        )
+    except (OSError, ValueError, TypeError):
+        return _PLAN_DEFAULT_RATES
+    _PLAN_MODEL_CACHE.clear()
+    _PLAN_MODEL_CACHE[key] = rates
+    return rates
+
+
+def _plan_bridge_chunks(width: int, bucket: int, ws: int, bits: int) -> int:
+    """Model argmin over feasible depths of one rank-chunk (mirrors
+    ``planner.CostModel.predict_slice`` + ``bridge_chunks``), rates from
+    :func:`_plan_model_rates`."""
+    if width <= 0 or ws <= 1:
+        return 1
+    q, d, w, over = _plan_model_rates()
+    n = width * ws
+    compressed = 1 <= bits <= 8
+    t_codec = (
+        4.0 * n * (1 + 1 / ws) / (q * 1e9)
+        + 4.0 * n * (2 - 1 / ws) / (d * 1e9)
+    ) if compressed else 0.0
+    if compressed:
+        # codec.wire_bytes duplicated dependency-light: per-bucket meta
+        # (2 x 4-byte elems) + bit-plane words at the 32-lane grid.
+        nb = -(-n // max(1, bucket))
+        wire_bytes = 8.0 * nb + 4.0 * (-(-n // 32)) * bits
+    else:
+        wire_bytes = 4.0 * n
+    t_wire = 2.0 * (ws - 1) / ws * wire_bytes / (w * 1e9)
+    bottleneck = max(t_codec, t_wire)
+    exposed_full = t_codec + t_wire - bottleneck
+    units = width // max(1, bucket)
+    best_c, best_t = 1, float("inf")
+    for c in _PLAN_CHUNK_CANDIDATES:
+        if c > max(1, units):
+            continue
+        t = bottleneck + exposed_full / c + c * over
+        if t < best_t - 1e-15:
+            best_c, best_t = c, t
+    return best_c
+
+
 # Compiled-schedule chunk plan (parallel/schedule.py), duplicated here in
 # dependency-light form — same reason as the topology taxonomy below: the
 # bridge must not import the parallel package into every rank process.
@@ -1454,6 +1550,29 @@ class ProcessGroupCGX(dist.ProcessGroup):
         for b in buckets:
             align = _math.lcm(align, max(1, b))
         chunks = cfg.sched_chunks()
+        # Step-planner depth decision (CGX_PLANNER=on): engagement is
+        # ENV-ONLY (identical on every launcher-configured rank). A rank
+        # with the planner loaded asks its cost model; one without uses
+        # the dependency-light default-model mirror — pinned equal under
+        # the default model, so mixed JAX/pure-bridge groups always
+        # derive the same depth and the group-global framing invariant
+        # holds. Calibrated models must be installed group-wide (the
+        # _plan_bridge_chunks contract note).
+        if cfg.planner_mode() == "on" and sizes:
+            bits = next(
+                (c.bits for (_o, _n, c) in layers if c.enabled), 32
+            )
+            pl = _planner_mod()
+            if pl is not None:
+                chunks = pl.bridge_chunks(
+                    max(sizes), align, len(sizes), bits, chunks
+                )
+            else:
+                chunks = _plan_bridge_chunks(
+                    max(sizes), align, len(sizes), bits
+                )
+                metrics.add("cgx.plan.bridge_hints")
+                metrics.set("cgx.plan.bridge_chunks", float(chunks))
         tables = [
             _sched_chunk_table(s, chunks, align) for s in sizes
         ]
@@ -1633,7 +1752,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         windows; per-chunk store keys). The knob unset keeps this
         monolithic body byte-identical, store keys included."""
         _group, me, ws, dummy = self._group_ctx(ranks, force_raw)
-        if ws > 1 and cfg.schedule_mode() == "on":
+        # Pipelined engagement is ENV-ONLY (schedule knob or planner
+        # mode), never process-local import state: every rank of a
+        # launcher-configured group answers this gate identically.
+        if ws > 1 and (
+            cfg.schedule_mode() == "on" or cfg.planner_mode() == "on"
+        ):
             sizes, _offs = _chunk_split(fused.shape[0], ws, layers)
             tables = self._sched_tables(sizes, layers)
             if tables is not None:

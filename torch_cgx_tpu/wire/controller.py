@@ -68,6 +68,14 @@ class WireController:
     ``min_observations`` — a layer/edge needs at least this many qerr
     samples before it joins the solve (a single warm-up sample is a
     noisy basis for a retrace).
+
+    Since the whole-step planner landed (``parallel/planner.py``), the
+    sanctioned driver is ``planner.StepPlanner(avg_bits=...)``, which
+    owns a controller (``every=0``) and runs this re-solve inside its own
+    calibrate→plan loop — the lint ownership rule
+    (``tools/lint.py check_planner_registry_ownership``) rejects new
+    registry writers outside the planner; this module's ``_apply`` is the
+    legacy inert path it allowlists.
     """
 
     def __init__(
@@ -101,6 +109,13 @@ class WireController:
         if self.every and self._count % self.every == 0:
             return self.update()
         return None
+
+    def gather_stats(self):
+        """Public alias of :meth:`_gather_stats` — the planner's
+        cost-model calibration reads the same (numel, bits, qerr) tables
+        this controller solves from (one telemetry surface, two
+        consumers)."""
+        return self._gather_stats()
 
     def _gather_stats(self):
         """LayerStats from the live qerr histograms + the trace-time
