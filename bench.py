@@ -1196,6 +1196,219 @@ def bench_planner(mb: int = 32, ws: int = 4, iters: int = 4) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# bench.py --async-dcn (ISSUE 13): asynchronous cross-slice plane vs the
+# synchronous two-level path under an injected slow DCN edge. 2 fake
+# slices (CGX_SHM_HOST_ID) x ws/2 ranks; the slow edge is a
+# `slow_rank:<10x step>@rank=<sliceB leader>@edge=dcn` fault — on the
+# sync path it sits on the critical path (every rank stalls behind the
+# cross exchange), on the async path the same fault fires inside the
+# dedicated sender thread and the step never feels it. The committed
+# record carries the speedup, a convergence-proxy loss delta (distance
+# to the global optimum of a deterministic quadratic), and the round-0
+# delta crc of two repeated async runs (bit-reproducible under the
+# fixed seed). Host-plane measurement, tagged backend "host".
+# ---------------------------------------------------------------------------
+
+
+def _async_dcn_rank(rank, ws, initfile, mb, iters, h, mode, delay_ms, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = str(BITS)
+    os.environ["CGX_COMPRESSION_BUCKET_SIZE"] = str(BUCKET)
+    half = ws // 2
+    # two fake slices on one real box; the byte plane stays off so the
+    # intra stage rides the store deterministically on any CI box
+    os.environ["CGX_SHM_HOST_ID"] = f"slice{rank // half}"
+    os.environ["CGX_SHM"] = "0"
+    if delay_ms > 0:
+        os.environ["CGX_FAULTS"] = (
+            f"slow_rank:{delay_ms}ms@rank={half}@edge=dcn"
+        )
+    if mode == "async":
+        os.environ["CGX_ASYNC"] = "on"
+        os.environ["CGX_ASYNC_H"] = str(h)
+        # speed bench, not a staleness trial: the slow edge may lag many
+        # rounds and must not trip the bound mid-measurement
+        os.environ["CGX_ASYNC_MAX_LAG"] = str(1 << 20)
+    import datetime
+
+    import torch
+    import torch.distributed as dist
+
+    from torch_cgx_tpu.torch_backend.backend import ProcessGroupCGX
+
+    n = mb * 2**20 // 4
+    store = dist.FileStore(initfile, ws)
+    pg = ProcessGroupCGX(store, rank, ws, datetime.timedelta(seconds=120))
+    plane = None
+    if mode == "async":
+        from torch_cgx_tpu.parallel import async_plane as ap
+
+        def mem():
+            si, ns_, leaders, lg, gen = pg.async_slice_info()
+            return ap.Membership(
+                slice_idx=si, n_slices=ns_, leaders=tuple(leaders),
+                global_ranks=tuple(lg), generation=gen,
+            )
+
+        si0, _n2, leaders0, _lg0, _g0 = pg.async_slice_info()
+        # transport_fn/intra_fn: re-resolved per generation (the sender
+        # is rebuilt after a reconfigure); leaders fold + publish, the
+        # slice's other ranks apply the leader's exact fold bytes.
+        plane = ap.AsyncPlane(
+            membership_fn=mem,
+            transport_fn=pg.async_sender,
+            intra_fn=pg.async_intra,
+            is_leader=(rank == leaders0[si0]),
+        )
+    # deterministic quadratic: per-rank target t_r, loss 0.5||p - t_r||^2,
+    # global optimum mean(t_r); params start identical on every rank
+    rng = np.random.default_rng(7)
+    targets = rng.standard_normal((ws, n)).astype(np.float32)
+    p = np.zeros(n, np.float32)
+    denom = ws if mode == "sync" else half  # async: intra-slice mean
+    lr = 0.5
+    t0 = time.perf_counter()
+    for step in range(iters):
+        if step == 1:
+            t0 = time.perf_counter()  # exclude the warm step
+        g = p - targets[rank]
+        t = torch.from_numpy(g.copy())
+        pg.allreduce([t]).wait()
+        p = p - lr * (t.numpy() / denom)
+        if plane is not None:
+            p = plane.maybe_outer_step(step, p)
+    dt = (time.perf_counter() - t0) / max(1, iters - 1)
+    if rank == 0:
+        opt = targets.mean(axis=0)
+        rec = {
+            "t_ms": dt * 1e3,
+            "opt_dist": float(
+                np.linalg.norm(p - opt) / max(np.linalg.norm(opt), 1e-9)
+            ),
+        }
+        if plane is not None and plane.first_delta_crc is not None:
+            rec["delta_crc"] = int(plane.first_delta_crc)
+        q.put(rec)
+    pg.shutdown()
+
+
+def _async_dcn_child(mb: int, ws: int, iters: int, h: int, mode: str,
+                     delay_ms: int) -> None:
+    """Child: one 2-slice bridge run (ws real processes) in the given
+    mode; prints one JSON line with timing + the convergence proxy."""
+    import multiprocessing as mp
+    import tempfile
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    with tempfile.TemporaryDirectory() as d:
+        initfile = os.path.join(d, "init")
+        procs = [
+            ctx.Process(
+                target=_async_dcn_rank,
+                args=(r, ws, initfile, mb, iters, h, mode, delay_ms, q),
+            )
+            for r in range(ws)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            rec = q.get(timeout=600)
+        finally:
+            for p in procs:
+                p.join(timeout=60)
+                if p.is_alive():
+                    p.terminate()
+    print(json.dumps(rec))
+
+
+def bench_async_dcn(mb: int = 8, ws: int = 4, iters: int = 6,
+                    h: int = 2) -> dict:
+    """Async-vs-sync cross-slice record (the ISSUE 13 acceptance row):
+
+    1. unfaulted sync run → the base step time the fault scales from;
+    2. sync run with a 10x ``slow_rank@edge=dcn`` fault on slice B's
+       leader — the synchronous two-level path stalls every step;
+    3. async run (``CGX_ASYNC=on``) under the SAME fault — the cross
+       stage leaves the critical path, deltas ship every ``h`` steps
+       through the sender thread;
+    4. a repeat of (3): the round-0 delta crc must match byte-for-byte
+       (deterministic codec under the fixed seed).
+
+    ``vs_baseline`` = faulted-sync / faulted-async step time (the
+    acceptance floor is 1.5x); the convergence proxy (distance to the
+    quadratic's global optimum after the same number of steps) rides in
+    ``detail`` as ``loss_delta``."""
+    if ws % 2 or ws < 4:
+        raise ValueError(f"--ws {ws} must be even and >= 4 (2 slices)")
+    me = str(Path(__file__).resolve())
+    env = {**os.environ}
+    for k in ("CGX_ASYNC", "CGX_ASYNC_H", "CGX_FAULTS", "CGX_SHM_HOST_ID"):
+        env.pop(k, None)
+
+    def run(mode: str, delay_ms: int) -> dict:
+        return _run_json_child(
+            [sys.executable, me, "--async-dcn-child", str(mb), str(ws),
+             str(iters), str(h), mode, str(delay_ms)], env,
+        )
+
+    base = run("sync", 0)
+    delay_ms = max(50, int(round(10 * base["t_ms"])))
+    sync_f = run("sync", delay_ms)
+    async_f = run("async", delay_ms)
+    async_r = run("async", delay_ms)
+    crc_a, crc_r = async_f.get("delta_crc"), async_r.get("delta_crc")
+    if crc_a is None or crc_r is None:
+        # A missing crc means NO outer round ever fired — the async arm
+        # did zero cross-slice work and the "speedup" would really
+        # measure skipping reconciliation entirely. Fail loudly instead
+        # of committing a vacuous record.
+        raise AssertionError(
+            f"async-dcn bench: no outer round fired in the async run "
+            f"(h={h} vs iters={iters}?) — raise --iters or lower --h"
+        )
+    if crc_a != crc_r:
+        raise AssertionError(
+            "async-dcn bench: round-0 delta crc differs across repeated "
+            f"runs ({crc_a:#x} vs {crc_r:#x}) — the deterministic-delta "
+            "contract of parallel/async_plane.py is broken"
+        )
+    t_sync, t_async = sync_f["t_ms"], async_f["t_ms"]
+    gbytes = mb * 2**20 / 1e9
+    return {
+        "metric": f"async_vs_sync_xslice_{BITS}bit_{mb}MB_x{ws}",
+        "value": round(gbytes / (t_async / 1e3), 3),
+        "unit": "GB/s",
+        "vs_baseline": round(t_sync / t_async, 3),
+        # Host-plane measurement (the bridge always runs on host CPU) —
+        # a genuine trajectory, like bench_schedule/shm_bench.
+        "backend": "host",
+        "chip": "host",
+        "detail": {
+            "t_sync_faulted_ms": round(t_sync, 3),
+            "t_async_faulted_ms": round(t_async, 3),
+            "t_sync_clean_ms": round(base["t_ms"], 3),
+            "slow_edge_ms": delay_ms,
+            "ws": ws,
+            "slices": 2,
+            "payload_MB": mb,
+            "iters": iters,
+            "async_h": h,
+            "opt_dist_sync": sync_f["opt_dist"],
+            "opt_dist_async": async_f["opt_dist"],
+            "loss_delta": round(
+                async_f["opt_dist"] - sync_f["opt_dist"], 6
+            ),
+            "delta_crc": async_f.get("delta_crc"),
+            "deltas": "bit-reproducible (round-0 wire crc equal across "
+                      "2 runs under the fixed seed)",
+            "bridge": "ProcessGroupCGX store bridge, ws real processes, "
+                      "2 fake slices via CGX_SHM_HOST_ID",
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
 # Unified wire plane (ISSUE 10): each routed edge's collective raw vs
 # compressed on the same payload — ring-attention/pipeline ppermute hops and
 # the MoE dispatch all_to_all through wire.dispatch, with a bit-equality
@@ -1519,6 +1732,34 @@ def main() -> None:
     if argv and argv[0] == "--wire-child":
         _wire_child(int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]))
         return
+    if argv and argv[0] == "--async-dcn-child":
+        _async_dcn_child(
+            int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]),
+            argv[5], int(argv[6]),
+        )
+        return
+    if argv and argv[0] == "--async-dcn":
+        # Async-vs-sync cross-slice record (tools/hw_session.sh queues
+        # this): bridge children are fresh CPU-pinned process groups —
+        # runs on any box without touching the device transport.
+        _preflight_lint()
+        kw = {}
+        for flag, name in (("--mb", "mb"), ("--ws", "ws"),
+                           ("--iters", "iters"), ("--h", "h")):
+            if flag in argv:
+                idx = argv.index(flag) + 1
+                val = argv[idx] if idx < len(argv) else ""
+                try:
+                    kw[name] = int(val)
+                except ValueError:
+                    sys.exit(
+                        f"bench: {flag} requires an integer value, "
+                        f"got {val!r}"
+                    )
+        result = bench_async_dcn(**kw)
+        rc = _gate_and_log([result])
+        print(json.dumps(result))
+        sys.exit(rc)
     if argv and argv[0] == "--wire":
         # Per-edge wire-plane records (tools/hw_session.sh queues this):
         # the child is a fresh subprocess (real chips when available, a

@@ -822,3 +822,79 @@ def test_linter_accepts_plan_metric_namespace(tmp_path):
     )
     proc = _run_lint(good)
     assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_flags_unbounded_result_in_async_plane(tmp_path):
+    # Async-plane blocking gate (ISSUE 13 satellite): the decoupled
+    # cross-slice exchange must NEVER block on DCN — an unconditional
+    # .result() in parallel/async_plane.py or torch_backend/
+    # async_bridge.py is a lint failure.
+    adir = tmp_path / "torch_cgx_tpu" / "torch_backend"
+    adir.mkdir(parents=True)
+    bad = adir / "async_bridge.py"
+    bad.write_text(
+        "def _ship(fut):\n"
+        "    return fut.result()\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "never block on DCN" in proc.stdout
+
+
+def test_linter_flags_wait_key_without_timeout_in_async_plane(tmp_path):
+    # A _wait_key-style blocking header wait has no place in the async
+    # plane: it only touches already-published bytes.
+    adir = tmp_path / "torch_cgx_tpu" / "parallel"
+    adir.mkdir(parents=True)
+    bad = adir / "async_plane.py"
+    bad.write_text(
+        "def poll(group, key):\n"
+        "    group._wait_key(key)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "wait_key" in proc.stdout and "already-published" in proc.stdout
+
+
+def test_linter_async_gate_allows_bounded_and_out_of_scope(tmp_path):
+    # .result(timeout=...) passes inside the async plane, and other
+    # modules stay unconstrained by this rule.
+    adir = tmp_path / "torch_cgx_tpu" / "torch_backend"
+    adir.mkdir(parents=True)
+    ok = adir / "async_bridge.py"
+    ok.write_text(
+        "def _ship(fut, t):\n"
+        "    return fut.result(timeout=t)\n"
+    )
+    other = adir / "other_module.py"
+    other.write_text(
+        "def f(fut):\n"
+        "    return fut.result()\n"
+    )
+    proc = _run_lint(ok, other)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_async_metric_namespace(tmp_path):
+    # `cgx.async.*` is a documented sub-namespace (the PR 13 family);
+    # a typo'd family still fails.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.async.rounds')\n"
+        "    metrics.set('cgx.async.lag_rounds', 2.0)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.asynch.rounds')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "asynch" in proc.stdout

@@ -168,6 +168,8 @@ def summarize(data: dict) -> dict:
     # into their own table (NOT rank_counters, whose totals sum across
     # ranks — 4 ranks at pred_ratio 0.97 must not report 3.88).
     plan_gauges_by_rank: Dict[int, Dict[str, float]] = defaultdict(dict)
+    # Async-plane gauges are levels too (worst lag, wire rate, route H).
+    async_gauges: Dict[str, float] = {}
     for rank, lines in data["metrics"].items():
         if not lines:
             continue
@@ -178,6 +180,8 @@ def summarize(data: dict) -> dict:
             if isinstance(v, (int, float)) and k.startswith("cgx.plan."):
                 g = plan_gauges_by_rank[rank]
                 g[k] = max(g.get(k, 0.0), v)
+            elif isinstance(v, (int, float)) and k.startswith("cgx.async."):
+                async_gauges[k] = max(async_gauges.get(k, 0.0), v)
         p50 = ((lines[-1].get("histograms") or {}).get("cgx.step.time_s")
                or {}).get("p50")
         if isinstance(p50, (int, float)):
@@ -194,6 +198,15 @@ def summarize(data: dict) -> dict:
         "cgx.plan.bridge_chunks",
     )
     for k in [k for k in totals if k.startswith(_PLAN_GAUGE_PREFIXES)]:
+        del totals[k]
+    # Async-plane gauges (levels, not tallies) scrub the same way —
+    # 4 ranks at lag 2 must not report lag 8 in the summed totals (the
+    # exporter-line fold above already max-folded them per rank).
+    _ASYNC_GAUGE_PREFIXES = (
+        "cgx.async.lag", "cgx.async.wire_gbps", "cgx.async.backlog",
+        "cgx.async.route_",
+    )
+    for k in [k for k in totals if k.startswith(_ASYNC_GAUGE_PREFIXES)]:
         del totals[k]
     summary["counters"] = dict(totals)
     summary["faults"] = {
@@ -334,6 +347,30 @@ def summarize(data: dict) -> dict:
             "roofline_frac": round(roofline, 4) if roofline else None,
             "counters": codec_counters,
         }
+    # Asynchronous cross-slice plane (PR 13): outer-round progress,
+    # on-time rate, worst lag and the sender's measured DCN rate.
+    # Counters sum across ranks; gauges are levels (max-folded above).
+    async_counters = {
+        k: v for k, v in totals.items() if k.startswith("cgx.async.")
+    }
+    if async_counters or async_gauges:
+        rounds = async_counters.get("cgx.async.rounds", 0.0)
+        on_time = async_counters.get("cgx.async.rounds_on_time", 0.0)
+        summary["async"] = {
+            "rounds": int(rounds),
+            "on_time_rate": (
+                round(on_time / rounds, 3) if rounds else None
+            ),
+            "worst_lag_rounds": int(
+                async_gauges.get("cgx.async.lag_rounds", 0.0)
+            ),
+            "wire_gbps": async_gauges.get("cgx.async.wire_gbps") or None,
+            "route_h": (
+                int(async_gauges["cgx.async.route_h"])
+                if async_gauges.get("cgx.async.route_h") else None
+            ),
+            "counters": async_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -473,6 +510,21 @@ def render(summary: dict) -> str:
             ]
             parts.append(_fmt_table(rows, ("slice", "chunks", "bits")))
         for k, v in sorted(p.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("async"):
+        a = summary["async"]
+        parts.append("\n== async (decoupled cross-slice plane) ==")
+        parts.append(f"  outer rounds: {a['rounds']}")
+        if a.get("on_time_rate") is not None:
+            parts.append(f"  on-time rate: {a['on_time_rate']:.1%}")
+        parts.append(f"  worst peer lag: {a['worst_lag_rounds']} round(s)")
+        if a.get("wire_gbps"):
+            parts.append(
+                f"  sender DCN rate: {a['wire_gbps']:.4f} GB/s"
+            )
+        if a.get("route_h"):
+            parts.append(f"  planner route H: {a['route_h']}")
+        for k, v in sorted(a.get("counters", {}).items()):
             parts.append(f"  {k}: {v:g}")
     if summary.get("codec"):
         c = summary["codec"]

@@ -8,7 +8,7 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  lag  async$  straggler  gen  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
@@ -38,6 +38,13 @@ dumps — and renders one row per rank:
   ``cgx.codec.roofline_frac`` gauge ``bench.py --codec-roofline``
   publishes): how close the codec kernels sit to the chip's HBM
   roofline, live, so a hardware session can watch tuning converge.
+* **lag** — the async cross-slice plane's worst peer staleness in outer
+  rounds (the ``cgx.async.lag_rounds`` gauge; ``-`` until an outer
+  round has run). Climbing toward ``CGX_ASYNC_MAX_LAG`` means a slice's
+  deltas stopped arriving — the eviction vote's early warning.
+* **async$** — share of outer rounds where every peer's delta arrived
+  on time (``cgx.async.rounds_on_time / cgx.async.rounds``): the
+  decoupled exchange's health number, same reading as sched$/plan$.
 * **straggler** — the health engine's worst per-peer skew score as
   ``score→peer`` (needs CGX_HEALTH on the ranks).
 * **gen** — the recovery generation gauge (``cgx.recovery.generation``).
@@ -207,7 +214,7 @@ def _wire_ratio(m: Dict[str, float]) -> str:
 
 _EDGE_ABBREV = {
     "moe_a2a": "moe", "ring_kv": "kv", "pp_act": "pp",
-    "powersgd_factor": "psgd", "dp_grad": "dp",
+    "powersgd_factor": "psgd", "dp_grad": "dp", "xslice_delta": "xd",
 }
 
 
@@ -287,6 +294,23 @@ def _roofline(m: Dict[str, float]) -> str:
     return f"{v:.2f}" if v else "-"
 
 
+def _async_lag(m: Dict[str, float]) -> str:
+    """Worst peer-slice staleness in outer rounds (``cgx.async.
+    lag_rounds``) — ``-`` until the async plane has run a round."""
+    if not m.get("cgx.async.rounds"):
+        return "-"
+    return f"{int(m.get('cgx.async.lag_rounds', 0.0))}"
+
+
+def _async_rate(m: Dict[str, float]) -> str:
+    """On-time outer-round rate (``cgx.async.rounds_on_time`` over
+    ``cgx.async.rounds``) — the decoupled exchange's health number."""
+    total = m.get("cgx.async.rounds", 0.0)
+    if not total:
+        return "-"
+    return f"{m.get('cgx.async.rounds_on_time', 0.0) / total * 100:.0f}%"
+
+
 def _straggler(status: Optional[dict]) -> str:
     scores = (status or {}).get("straggler_scores") or {}
     if not scores:
@@ -313,7 +337,7 @@ def render(directory: str, state: dict) -> str:
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
                "edges", "overlap", "sched$", "plan$", "pred", "atune$",
-               "roofl", "straggler", "gen", "last_fault")
+               "roofl", "lag", "async$", "straggler", "gen", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -331,6 +355,8 @@ def render(directory: str, state: dict) -> str:
             _pred(m),
             _autotune_cache(m),
             _roofline(m),
+            _async_lag(m),
+            _async_rate(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
             _last_fault(d["last_fault"]),

@@ -126,6 +126,11 @@ session() {
   # planner's predicted-vs-measured step time for the bench_gate
   # prediction floor.
   run_cpu 900 "planner vs static" env JAX_PLATFORMS=cpu python bench.py --planner --mb 32 --ws 4
+  # Asynchronous cross-slice plane (ISSUE 13): async-vs-sync step time
+  # under an injected slow DCN edge. Bridge children are CPU-pinned
+  # process groups — never touches the device transport; resumable like
+  # every other step (its marker skips it on re-runs).
+  run_cpu 900 "async dcn plane" env JAX_PLATFORMS=cpu python bench.py --async-dcn --mb 8 --ws 4
   # Unified wire plane (ISSUE 10): per-edge compressed-vs-raw records.
   # The child probes for real chips itself and falls back to a forced CPU
   # multi-device platform, so this step never wedges the device transport.

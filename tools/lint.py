@@ -395,9 +395,12 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # hits/misses/invalidations, per-slice chunk/bit gauges, the
     # predicted-step gauge and the bridge depth hints —
     # docs/OBSERVABILITY.md "Metric namespaces".
-    "codec", "collective", "faults", "flightrec", "health", "heartbeat",
-    "plan", "qerr", "recovery", "ring", "runtime", "sched", "shm", "sra",
-    "step", "trace", "wire", "xla",
+    # "async" is the asynchronous cross-slice plane (PR 13): outer-round
+    # counters, the sender-thread wire gauge, lag gauges and the
+    # planner's route prediction — docs/OBSERVABILITY.md.
+    "async", "codec", "collective", "faults", "flightrec", "health",
+    "heartbeat", "plan", "qerr", "recovery", "ring", "runtime", "sched",
+    "shm", "sra", "step", "trace", "wire", "xla",
 })
 
 
@@ -896,6 +899,81 @@ def check_planner_registry_ownership(path: Path, tree: ast.Module) -> list[str]:
     return findings
 
 
+# Async-plane blocking gate (PR 13): the whole point of the decoupled
+# cross-slice exchange is that the train step NEVER blocks on DCN — so
+# nothing in parallel/async_plane.py or torch_backend/async_bridge.py may
+# park a thread on an unbounded wait. An unconditional `.result()` (no
+# timeout) or a `_wait_key`-style call without a timeout keyword would put
+# a dead peer right back on the critical path the plane exists to leave.
+_ASYNC_PLANE_FILES = (
+    ("parallel", "async_plane.py"),
+    ("torch_backend", "async_bridge.py"),
+)
+
+
+def _is_async_plane_file(path: Path) -> bool:
+    parts = tuple(path.parts)
+    if _LIB_DIR not in parts:
+        return False
+    rel = parts[parts.index(_LIB_DIR) + 1:]
+    return any(
+        len(s) <= len(rel) and rel[len(rel) - len(s):] == s
+        for s in _ASYNC_PLANE_FILES
+    )
+
+
+def check_async_sender_blocking(path: Path, tree: ast.Module) -> list[str]:
+    """No blocking store/shm waits in the async plane's bodies:
+
+    * an UNCONDITIONAL ``.result()`` (no ``timeout=``) on a future parks
+      the sender thread (or worse, the training loop) forever behind a
+      payload a dead peer will never deliver;
+    * any call whose name contains ``wait_key`` without a timeout-ish
+      keyword is the bridge's blocking header wait — the async plane
+      must only touch bytes that are already published
+      (publish-after-write counters), never wait for ones that are not.
+
+    ``.result(timeout=...)`` and explicitly-bounded waits pass. Scope is
+    the two async-plane files only (the sync bridge keeps its own
+    bounded-wait rules)."""
+    if not _is_async_plane_file(path):
+        return []
+    findings: list[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            bounded = any(
+                kw.arg and "timeout" in kw.arg.lower() for kw in n.keywords
+            )
+            if name == "result" and isinstance(fn, ast.Attribute):
+                if not bounded and not n.args:
+                    findings.append(
+                        f"{path}:{n.lineno}: unconditional '.result()' in "
+                        f"async-plane body {node.name!r} — the decoupled "
+                        "cross-slice exchange must never block on DCN; "
+                        "bound it with timeout= (tools/lint.py "
+                        "check_async_sender_blocking; docs/PERF_NOTES.md "
+                        "'Asynchronous cross-slice plane')"
+                    )
+            elif "wait_key" in name and not bounded:
+                findings.append(
+                    f"{path}:{n.lineno}: blocking '{name}' without a "
+                    f"timeout in async-plane body {node.name!r} — the "
+                    "async plane only touches already-published bytes "
+                    "(publish-after-write), it never waits for a header "
+                    "(tools/lint.py check_async_sender_blocking)"
+                )
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path) -> set[str] | None:
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed, not imported — lint must not execute library code).
@@ -985,6 +1063,7 @@ def check_file(path: Path) -> list[str]:
     out.extend(check_schedule_stage_blocking(path, tree))
     out.extend(check_wire_edge_routing(path, tree))
     out.extend(check_planner_registry_ownership(path, tree))
+    out.extend(check_async_sender_blocking(path, tree))
     return out
 
 

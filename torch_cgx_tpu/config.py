@@ -102,6 +102,15 @@ SRA_ACCUM = "CGX_SRA_ACCUM"  # exact | int8 — epilogue accumulation domain
 AUTOTUNE = "CGX_AUTOTUNE"  # auto | on | off — per-chip tile autotuner
 AUTOTUNE_DIR = "CGX_AUTOTUNE_DIR"  # on-disk autotune cache location
 PRODUCER_FUSE = "CGX_PRODUCER_FUSE"  # auto | on | off — fused grad quantize
+# Asynchronous cross-slice plane (parallel/async_plane.py +
+# torch_backend/async_bridge.py — PR 13): decoupled DCN exchange with
+# hierarchical local-SGD, bounded staleness and planner-aware H.
+ASYNC = "CGX_ASYNC"  # off | on | auto — decoupled cross-slice outer loop
+ASYNC_H = "CGX_ASYNC_H"  # inner steps per outer round (0 = planner decides)
+ASYNC_MAX_LAG = "CGX_ASYNC_MAX_LAG"  # bounded staleness, in outer rounds
+ASYNC_OUTER = "CGX_ASYNC_OUTER"  # outer optimizer: sgd | nesterov
+ASYNC_OUTER_LR = "CGX_ASYNC_OUTER_LR"  # outer learning rate
+ASYNC_OUTER_MOMENTUM = "CGX_ASYNC_OUTER_MOMENTUM"  # nesterov momentum
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -817,6 +826,110 @@ def snapshot_every() -> int:
     from the current state without replay."""
     v = _env.get_int_env_or_default(SNAPSHOT_EVERY, 0)
     return max(v, 0)
+
+
+# ---------------------------------------------------------------------------
+# Asynchronous cross-slice plane (PR 13 — docs/PERF_NOTES.md "Asynchronous
+# cross-slice plane").
+# ---------------------------------------------------------------------------
+
+ASYNC_OUTER_OPTS = ("sgd", "nesterov")
+DEFAULT_ASYNC_H = 8
+DEFAULT_ASYNC_MAX_LAG = 4
+# Aggressive default width for the xslice_delta edge when neither a
+# registered edge config nor CGX_WIRE_BITS says otherwise: deltas cross the
+# slowest fabric in the system, and local-SGD tolerates coarse outer
+# quantization because error feedback carries the residual forward.
+DEFAULT_ASYNC_DELTA_BITS = 4
+
+
+def async_mode() -> str:
+    """CGX_ASYNC: engagement of the asynchronous cross-slice plane
+    (``parallel/async_plane.py``) — intra-slice gradients keep the staged
+    synchronous allreduce while cross-slice exchange becomes a decoupled
+    local-SGD outer loop shipping compressed parameter deltas every
+    ``CGX_ASYNC_H`` steps through a dedicated sender thread:
+
+    * "off" (default) — never engage. Staged programs, store keys and
+      wire bytes are bit-identical to the pre-async code (pinned by
+      tests/test_async_plane.py): the knob-unset inertness contract every
+      CGX_* plane carries.
+    * "on" — engage anywhere the group spans slices. Group-global and
+      env-only (the launcher sets it identically on every rank), because
+      "skip the cross exchange" is a branch every rank must take together
+      or the bridge collective deadlocks — the ``engaged_bridge``
+      discipline.
+    * "auto" — the step planner decides per topology: the async plane
+      engages (and picks H) only where the planner's sync-vs-async cost
+      curves say the decoupled exchange wins (``planner.async_route``).
+      Inert on every CPU/CI path without ``CGX_PLANNER=on`` — the
+      ``CGX_SCHEDULE`` gate discipline.
+    """
+    mode = _env.get_str_env_or_default(ASYNC, "off").lower()
+    if mode not in ("off", "on", "auto"):
+        raise ValueError(f"{ASYNC} must be off|on|auto, got {mode!r}")
+    return mode
+
+
+def async_engaged() -> bool:
+    """The group-global bridge-plane gate: explicit ``CGX_ASYNC=on`` only.
+    "auto" resolves through the planner at the AsyncPlane tier (where the
+    payload and topology are known); the bridge's skip-the-cross-stage
+    branch must be derivable from env alone on every rank — a per-process
+    planner decision diverging across ranks would deadlock the
+    collective."""
+    return async_mode() == "on"
+
+
+def async_h() -> int:
+    """CGX_ASYNC_H: inner steps per outer round — how often a slice ships
+    its compressed parameter delta across DCN. 0 (default) = let the
+    planner pick H from its cost curves under ``CGX_ASYNC=auto``
+    (``DEFAULT_ASYNC_H`` when the planner is off)."""
+    v = _env.get_int_env_or_default(ASYNC_H, 0)
+    return max(v, 0)
+
+
+def async_max_lag() -> int:
+    """CGX_ASYNC_MAX_LAG: bounded staleness — the most outer rounds a peer
+    slice may fall behind before the health plane's ``async_lag`` event
+    escalates to an :class:`~.robustness.errors.AsyncStalenessError` (the
+    recovery ladder's entry, same as a bridge timeout). Floor 1: a bound
+    of 0 would re-synchronize every round and defeat the plane."""
+    v = _env.get_int_env_or_default(ASYNC_MAX_LAG, DEFAULT_ASYNC_MAX_LAG)
+    return max(v, 1)
+
+
+def async_outer() -> str:
+    """CGX_ASYNC_OUTER: the outer optimizer applied to the aggregated
+    cross-slice delta — "sgd" (default; lr 1.0 makes the outer step plain
+    local-SGD averaging) or "nesterov" (DiLoCo's outer momentum)."""
+    v = _env.get_str_env_or_default(ASYNC_OUTER, "sgd").lower()
+    if v not in ASYNC_OUTER_OPTS:
+        raise ValueError(
+            f"{ASYNC_OUTER} must be one of {ASYNC_OUTER_OPTS}, got {v!r}"
+        )
+    return v
+
+
+def async_outer_lr() -> float:
+    """CGX_ASYNC_OUTER_LR: outer learning rate (default 1.0 — with the
+    sgd outer that is exact delta averaging)."""
+    v = _env.get_float_env_or_default(ASYNC_OUTER_LR, 1.0)
+    if v <= 0:
+        raise ValueError(f"{ASYNC_OUTER_LR} must be > 0, got {v}")
+    return v
+
+
+def async_outer_momentum() -> float:
+    """CGX_ASYNC_OUTER_MOMENTUM: nesterov momentum of the outer optimizer
+    (default 0.9, the DiLoCo setting; ignored under the sgd outer)."""
+    v = _env.get_float_env_or_default(ASYNC_OUTER_MOMENTUM, 0.9)
+    if not 0.0 <= v < 1.0:
+        raise ValueError(
+            f"{ASYNC_OUTER_MOMENTUM} must be in [0, 1), got {v}"
+        )
+    return v
 
 
 NONFINITE_POLICIES = ("off", "skip", "exact")

@@ -63,6 +63,11 @@ STRAGGLER = "straggler"
 STEP_REGRESSION = "step_regression"
 QERR_SLO = "qerr_slo"
 ARENA_PRESSURE = "arena_pressure"
+# Asynchronous cross-slice plane (PR 13): a peer slice's outer rounds are
+# falling behind this slice's — raised by the async plane's bounded-
+# staleness bookkeeping, long before any bridge wait could expire
+# (the async plane never blocks on DCN, so no wait ever WOULD expire).
+ASYNC_LAG = "async_lag"
 
 # Wait-signal floor: peer skew is judged relative to the median peer, but
 # a baseline of ~0 (healthy peers answer in microseconds) would make any
@@ -287,6 +292,29 @@ class HealthEngine:
             self._step_p50.update(dt)
             self._step_p99.update(dt)
 
+    def note_async_lag(
+        self, suspect: int, lag: float, threshold: float
+    ) -> Optional[HealthEvent]:
+        """Async-plane hook: peer slice (leader ``suspect``, a GLOBAL
+        rank) is ``lag`` outer rounds behind. Gauged every call; an event
+        is emitted the moment the lag crosses ``threshold`` — no sustain
+        window (outer rounds are already H steps apart; by the second
+        crossing the staleness bound itself may have tripped), but the
+        per-(kind, suspect) cooldown still applies so a stuck peer is one
+        event stream, not one event per inner step. Returns the emitted
+        event (None when below threshold or inside the cooldown)."""
+        metrics.set(f"cgx.async.lag.r{int(suspect)}", round(float(lag), 4))
+        if lag < threshold:
+            return None
+        ev = HealthEvent(
+            kind=ASYNC_LAG, rank=self.rank, value=round(float(lag), 6),
+            threshold=float(threshold), suspect=int(suspect),
+            detail=(("lag_rounds", float(lag)),),
+            ts=round(time.time(), 6),
+            t_mono=round(time.perf_counter(), 6),
+        )
+        return ev if self._emit(ev) else None
+
     def rebind_rank(self, rank: int) -> None:
         """Late rank bind (see ``maybe_start``): the engine may be
         auto-started by ``make_train_step`` before the process knows its
@@ -311,14 +339,20 @@ class HealthEngine:
             }
             self._peers.clear()
             self._inflight.clear()
+            # async_lag streams are peer-attributed too: an evicted
+            # slice leader's cooldown entry must not suppress (or its
+            # stale gauge misreport) the new generation's lag stream.
             self._sustain = {
-                k: v for k, v in self._sustain.items() if k[0] != STRAGGLER
+                k: v for k, v in self._sustain.items()
+                if k[0] not in (STRAGGLER, ASYNC_LAG)
             }
             self._last_emit = {
-                k: v for k, v in self._last_emit.items() if k[0] != STRAGGLER
+                k: v for k, v in self._last_emit.items()
+                if k[0] not in (STRAGGLER, ASYNC_LAG)
             }
         for peer in dropped:
             metrics.set(f"cgx.health.straggler.r{peer}", 0.0)
+            metrics.set(f"cgx.async.lag.r{peer}", 0.0)
 
     # -- consumers ---------------------------------------------------------
 
@@ -641,6 +675,19 @@ def note_step(dt: float) -> None:
     eng = _engine
     if eng is not None:
         eng.note_step(dt)
+
+
+def note_async_lag(
+    suspect: Optional[int], lag: float, threshold: float
+) -> Optional["HealthEvent"]:
+    """Async-plane hook: report a peer slice's outer-round lag (no-op
+    when the engine is off or the suspect is unknown). Returns the
+    emitted ``async_lag`` event, if any — the async plane folds it into
+    its own error detail when the staleness bound trips."""
+    eng = _engine
+    if eng is None or suspect is None or suspect < 0:
+        return None
+    return eng.note_async_lag(suspect, lag, threshold)
 
 
 def forget_peers() -> None:

@@ -1,0 +1,683 @@
+"""Asynchronous cross-slice plane: hierarchical local-SGD over DCN.
+
+The topology router (PR 7) split intra-slice ICI from cross-slice DCN,
+but both levels still ran synchronously — one slow DCN edge stalled
+every chip in every slice, every step (ROADMAP item 4's named soft
+spot). This module decouples the slow tier ("The Big Send-off", arxiv
+2504.18658: hierarchical collectives win exactly when the slow tier
+leaves the critical path):
+
+* **inner loop** — each slice keeps its existing staged synchronous
+  allreduce (planned, pipelined, producer-fused: nothing in the staged
+  program changes; under ``CGX_ASYNC=on`` the bridge's two-level path
+  simply skips its cross stage);
+* **outer loop** — every ``CGX_ASYNC_H`` inner steps a slice computes
+  its parameter delta against the outer **anchor**, compresses it
+  through the wire-plane codec path (edge kind ``xslice_delta`` in
+  ``wire/edges.py``, error feedback riding the per-slice residual), and
+  hands the wire bytes to a dedicated sender thread
+  (``torch_backend/async_bridge.py``) — the train step NEVER blocks on
+  DCN. Arrived peer deltas fold into the anchor at round boundaries
+  through a configurable outer optimizer (SGD averaging, or Nesterov
+  momentum — the DiLoCo outer step), in deterministic (peer, round)
+  order so every slice that saw the same rounds holds bit-identical
+  anchors;
+* **bounded staleness** — a peer slice more than ``CGX_ASYNC_MAX_LAG``
+  outer rounds behind raises ``async_lag`` HealthEvents (the PR 6
+  plane; they feed the PR 5 eviction vote as suspect hints) and then an
+  :class:`~..robustness.errors.AsyncStalenessError` — a
+  ``BridgeTimeoutError`` subclass, so the recovery supervisor's ladder
+  runs exactly as for an expired bridge wait;
+* **deterministic recovery** — the outer state (anchor, EF residual,
+  momentum, round, per-peer bookkeeping) is a plain numpy pytree that
+  rides the PR 5 in-memory snapshots; an outer round is tagged with the
+  group generation, and replay restores inner params and outer
+  EF/momentum state bit-identically (the chaos soak in
+  tests/test_async_plane.py pins a faulted run's post-rollback replay
+  against a fault-free survivor-only run);
+* **planner-aware** — under ``CGX_ASYNC=auto`` the PR 12 planner's
+  sync-vs-async cost curves (``planner.async_route``, calibrated from
+  live ``cgx.async.*`` telemetry) decide engagement and pick H per
+  topology instead of a static knob (GC3, arxiv 2201.11840: the
+  schedule compiler owns the decoupling decision).
+
+With ``CGX_ASYNC`` unset the module is inert: no state allocates, no
+byte ships, and staged programs / store keys / wire bytes are
+bit-identical to the pre-async code (pinned in
+tests/test_async_plane.py).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import threading
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+from ..observability import flightrec
+from ..observability import health as health_mod
+from ..ops import codec_host
+from ..robustness.errors import AsyncStalenessError
+from ..utils.logging import get_logger, metrics
+from ..wire import edges as wire_edges
+
+log = get_logger()
+
+# Live planes, reset by supervisor.invalidate_trace_caches: per-peer round
+# bookkeeping and pending deltas describe the dead generation's
+# membership (the controller-cadence reset class).
+_PLANES: "weakref.WeakSet" = weakref.WeakSet()
+_PLANES_LOCK = threading.Lock()
+
+
+def reset_planes(reason: str = "reconfigure") -> None:
+    """Mark every live plane's membership stale (post-recovery hook): the
+    next outer boundary re-derives slice leaders from the survivor host
+    map at the bumped generation instead of folding rounds from (or
+    naming as suspects) evicted peers."""
+    with _PLANES_LOCK:
+        planes = list(_PLANES)
+    for p in planes:
+        p.mark_membership_stale()
+    if planes:
+        metrics.add("cgx.async.membership_resets")
+        log.info("async plane membership marked stale (%s)", reason)
+
+
+@dataclasses.dataclass(frozen=True)
+class Membership:
+    """One slice's view of the cross-slice group: which slice it is, how
+    many slices exist, and the GROUP-LOCAL + GLOBAL ranks of every
+    slice's leader (by slice index — the eviction-vote attribution and
+    the regression-pinned re-derivation surface)."""
+
+    slice_idx: int
+    n_slices: int
+    leaders: Tuple[int, ...]  # group-local leader rank per slice
+    global_ranks: Tuple[int, ...]  # global leader rank per slice
+    generation: int = 0
+
+    @classmethod
+    def from_hosts(
+        cls,
+        hosts: Sequence[str],
+        my_rank: int,
+        global_ranks: Optional[Sequence[int]] = None,
+        generation: int = 0,
+    ) -> "Membership":
+        """Derive from the CURRENT per-rank host map (after an eviction:
+        the survivor-filtered map at the bumped generation) — the
+        :func:`topology.slice_leaders` walk, so an evicted rank can never
+        be named leader."""
+        from . import topology as topo
+
+        leaders = topo.slice_leaders(hosts)
+        globals_ = (
+            list(global_ranks) if global_ranks is not None
+            else list(range(len(hosts)))
+        )
+        # slice index = position of my host's leader (leaders are in
+        # first-seen host order, the slice-id order by construction —
+        # the same derivation backend.async_slice_info uses)
+        my_slice = [hosts[r] for r in leaders].index(hosts[my_rank])
+        return cls(
+            slice_idx=my_slice,
+            n_slices=len(leaders),
+            leaders=tuple(leaders),
+            global_ranks=tuple(globals_[r] for r in leaders),
+            generation=int(generation),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Outer optimizer (SGD / Nesterov momentum — the DiLoCo pair).
+# ---------------------------------------------------------------------------
+
+
+def outer_update(
+    agg: np.ndarray,
+    momentum: np.ndarray,
+    *,
+    kind: str,
+    lr: float,
+    mu: float,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """(anchor update, new momentum) for one aggregated outer delta.
+
+    "sgd": ``lr * agg`` (lr 1.0 = exact local-SGD delta averaging).
+    "nesterov": ``m' = mu*m + agg``; update ``lr * (agg + mu*m')`` — the
+    Nesterov look-ahead form DiLoCo uses for its outer optimizer.
+    Pure f32 numpy on both paths, so replay is bit-exact."""
+    agg = agg.astype(np.float32, copy=False)
+    if kind == "sgd":
+        return (np.float32(lr) * agg), momentum
+    m_new = np.float32(mu) * momentum + agg
+    return np.float32(lr) * (agg + np.float32(mu) * m_new), m_new
+
+
+def init_outer_state(
+    flat_params: np.ndarray, membership: Membership
+) -> Dict[str, Any]:
+    """Fresh outer state for one slice: the anchor starts at the current
+    params (delta 0), EF and momentum at zero, round at 0. A plain
+    dict-of-numpy pytree so ``checkpoint.snapshot_in_memory`` host-copies
+    it unchanged (rung-4 substrate)."""
+    flat = np.asarray(flat_params, np.float32).reshape(-1)
+    return {
+        "anchor": flat.copy(),
+        "ef": np.zeros_like(flat),
+        "momentum": np.zeros_like(flat),
+        "round": 0,
+        "generation": int(membership.generation),
+        # highest peer round folded so far, per peer slice (-1 = none)
+        "applied": {
+            int(p): -1
+            for p in range(membership.n_slices)
+            if p != membership.slice_idx
+        },
+        # staleness-clock floor: lag is measured against
+        # max(applied, lag_floor - 1), so a post-recovery stream starts
+        # its clock at the re-derivation round
+        "lag_floor": 0,
+        # arrived-but-unapplied decoded deltas: peer -> [(round, vec)]
+        "pending": {},
+    }
+
+
+# ---------------------------------------------------------------------------
+# The plane.
+# ---------------------------------------------------------------------------
+
+
+class AsyncPlane:
+    """One slice's end of the asynchronous cross-slice exchange.
+
+    ``transport`` — post/poll endpoint (``AsyncBridgeSender`` on the
+    bridge, ``LocalAsyncTransport.bind(...)`` in tests).
+    ``membership_fn`` — returns the CURRENT :class:`Membership`;
+    re-invoked after a recovery reconfiguration (``reset_planes``) so
+    slice leaders re-derive from the survivor list at the bumped
+    generation (the PR 13 regression fix).
+    ``name`` — the edge name this plane's deltas resolve under in the
+    edge registry (``resolve_edge("xslice_delta", name)``).
+    ``h`` — inner steps per outer round; None resolves ``CGX_ASYNC_H``,
+    then (under ``auto`` with the planner engaged) the planner's cost
+    curves, then ``DEFAULT_ASYNC_H``.
+
+    Thread model: every method runs on the training-loop thread; only
+    the transport's sender thread touches the store. Nothing here blocks
+    — ``maybe_outer_step`` is an enqueue plus a poll of already-arrived
+    bytes.
+    """
+
+    def __init__(
+        self,
+        transport=None,
+        membership_fn: Callable[[], Membership] = None,  # type: ignore[assignment]
+        *,
+        name: str = "outer",
+        h: Optional[int] = None,
+        max_lag: Optional[int] = None,
+        is_leader: bool = True,
+        intra=None,
+        transport_fn: Optional[Callable[[], Any]] = None,
+        intra_fn: Optional[Callable[[], Any]] = None,
+    ):
+        if membership_fn is None:
+            raise TypeError("AsyncPlane requires a membership_fn")
+        if transport is None and transport_fn is None and is_leader:
+            raise TypeError(
+                "AsyncPlane: a leader needs a transport (or transport_fn)"
+            )
+        if not is_leader and intra is None and intra_fn is None:
+            raise TypeError(
+                "AsyncPlane: a non-leader needs an intra channel (intra "
+                "or intra_fn) — it applies the leader's fold bytes "
+                "instead of polling the DCN streams"
+            )
+        # transport_fn/intra_fn re-resolve per membership refresh — a
+        # recovery reconfiguration rebuilds the sender at the bumped
+        # generation (ProcessGroupCGX.async_sender), and a plane holding
+        # the STOPPED pre-recovery sender by value would resurrect its
+        # thread under the dead generation's key namespace.
+        self._transport_fn = transport_fn
+        self._transport = (
+            transport if transport is not None
+            else (transport_fn() if transport_fn is not None else None)
+        )
+        self._intra_fn = intra_fn
+        self._intra = intra if intra is not None else (
+            intra_fn() if intra_fn is not None else None
+        )
+        self._membership_fn = membership_fn
+        self.name = name
+        self._h_arg = h
+        self._max_lag_arg = max_lag
+        # Only a slice's leader POSTS its delta (one writer per stream)
+        # and FOLDS peer rounds (arrival instants differ across slice
+        # members, so independent folding would diverge them); with an
+        # ``intra`` channel wired, non-leaders apply the leader's exact
+        # fold bytes instead (the two-level leader scheme applied to the
+        # outer loop). Without one (single-rank slices — the JAX plane,
+        # tests) every rank is its own leader.
+        self.is_leader = bool(is_leader)
+        self.membership = membership_fn()
+        self.state: Optional[Dict[str, Any]] = None
+        self._membership_stale = False
+        self._auto_decision: Optional[Tuple[str, int]] = None
+        # Reproducibility probe: crc32 of the FIRST posted wire frame —
+        # deterministic under a fixed seed (round 0's delta precedes any
+        # fold), so repeated runs must agree byte-for-byte (the
+        # bench.py --async-dcn acceptance check reads it).
+        self.first_delta_crc: Optional[int] = None
+        with _PLANES_LOCK:
+            _PLANES.add(self)
+
+    # -- knobs -------------------------------------------------------------
+
+    def delta_config(self) -> wire_edges.EdgeConfig:
+        """The xslice_delta edge's wire treatment: a registered
+        ``(xslice_delta, pattern)`` entry wins, then ``CGX_WIRE_BITS``,
+        then the plane's own aggressive default
+        (``DEFAULT_ASYNC_DELTA_BITS`` with error feedback on — deltas
+        cross the slowest fabric, and EF carries the coarse-width
+        residual forward)."""
+        ec = wire_edges.resolve_edge(wire_edges.EDGE_XSLICE_DELTA, self.name)
+        if ec is None:
+            ec = wire_edges.EdgeConfig(
+                cc=CompressionConfig(
+                    bits=cfg_mod.DEFAULT_ASYNC_DELTA_BITS, bucket_size=0
+                ),
+                error_feedback=True,
+            ).resolved()
+        return ec
+
+    def max_lag(self) -> int:
+        return (
+            self._max_lag_arg if self._max_lag_arg is not None
+            else cfg_mod.async_max_lag()
+        )
+
+    def h(self, numel: Optional[int] = None) -> int:
+        """Inner steps per outer round: explicit > ``CGX_ASYNC_H`` >
+        planner cost curves (auto) > ``DEFAULT_ASYNC_H``."""
+        if self._h_arg:
+            return max(1, int(self._h_arg))
+        env_h = cfg_mod.async_h()
+        if env_h:
+            return env_h
+        decision = self._planner_decision(numel)
+        if decision is not None:
+            return max(1, decision[1])
+        return cfg_mod.DEFAULT_ASYNC_H
+
+    def engaged(self, numel: Optional[int] = None) -> bool:
+        """"on" engages; "auto" defers to the planner's sync-vs-async
+        cost curves (inert when the planner itself is off — the
+        CGX_SCHEDULE gate discipline); "off" never."""
+        mode = cfg_mod.async_mode()
+        if mode == "off":
+            return False
+        if self.membership.n_slices <= 1:
+            return False  # nothing crosses DCN
+        if mode == "on":
+            return True
+        decision = self._planner_decision(numel)
+        return decision is not None and decision[0] == "async"
+
+    def _planner_decision(
+        self, numel: Optional[int]
+    ) -> Optional[Tuple[str, int]]:
+        """(route, H) from the planner's cost curves, memoized per plane
+        (the planner's own cache keys carry the model fingerprint; this
+        memo only avoids re-solving every inner step). None when the
+        planner is not engaged or the payload is still unknown."""
+        if self._auto_decision is not None:
+            return self._auto_decision
+        if numel is None:
+            return None
+        from . import planner as planner_mod
+
+        if not planner_mod.engaged():
+            # Memoized too: "auto without the planner" is inert, and an
+            # unmemoized None would make wants_params hand the full
+            # device→host param flatten to maybe_outer_step EVERY step
+            # just to re-learn it. A mid-run CGX_PLANNER flip re-solves
+            # through reset_planes (membership refresh clears the memo).
+            self._auto_decision = ("sync", cfg_mod.DEFAULT_ASYNC_H)
+            return self._auto_decision
+        cc = self.delta_config().cc
+        route, h_best = planner_mod.async_route(
+            int(numel), self.membership.n_slices, cc.bits, cc.bucket_size
+        )
+        self._auto_decision = (route, h_best)
+        return self._auto_decision
+
+    # -- membership lifecycle ---------------------------------------------
+
+    def mark_membership_stale(self) -> None:
+        self._membership_stale = True
+
+    def _refresh_membership(self) -> None:
+        """Re-derive slice membership from the CURRENT survivor list at
+        the bumped generation (the regression fix: the cached membership
+        could name an evicted rank as cross-slice leader). Peer round
+        bookkeeping restarts — post-recovery rounds are a new stream,
+        the same contract as the qerr-cadence reset — while anchor, EF
+        and momentum survive (they are training state, not derived
+        bookkeeping)."""
+        new = self._membership_fn()
+        old = self.membership
+        self.membership = new
+        self._membership_stale = False
+        self._auto_decision = None  # topology changed: re-solve the route
+        # Re-resolve the transports: the group rebuilt its sender (and
+        # intra channel) at the bumped generation; the pre-recovery
+        # objects are stopped and namespace-dead.
+        if self._transport_fn is not None:
+            self._transport = self._transport_fn()
+        if self._intra_fn is not None:
+            self._intra = self._intra_fn()
+        if self.state is not None:
+            self.state["generation"] = int(new.generation)
+            # Fresh streams accept EVERY round (applied = -1): without a
+            # rendezvous-agreed replay point (CGX_SNAPSHOT_EVERY=0) a
+            # slower survivor legitimately resumes at an earlier round,
+            # and a caught-up baseline would silently drop its deltas as
+            # stale forever. The staleness CLOCK is floored at the
+            # re-derivation round instead (lag_floor): it measures only
+            # post-recovery lag, never the rounds the dead generation's
+            # stream carried — so neither a spurious trip nor a dropped
+            # contribution.
+            self.state["applied"] = {
+                int(p): -1
+                for p in range(new.n_slices)
+                if p != new.slice_idx
+            }
+            self.state["lag_floor"] = int(self.state["round"])
+            self.state["pending"] = {}
+        metrics.add("cgx.async.membership_rederived")
+        flightrec.record(
+            "async_membership",
+            generation=new.generation,
+            n_slices=new.n_slices,
+            slice_idx=new.slice_idx,
+            leaders=list(new.leaders),
+            was=list(old.leaders),
+        )
+
+    # -- snapshot / replay (rung-4 substrate) ------------------------------
+
+    def export_state(self) -> Optional[Dict[str, Any]]:
+        """Deep host copy of the outer state (include it in the tree the
+        supervisor snapshots — replay then restores inner params AND
+        outer EF/momentum bit-identically)."""
+        return copy.deepcopy(self.state)
+
+    def restore_state(self, state: Optional[Dict[str, Any]]) -> None:
+        self.state = copy.deepcopy(state)
+
+    # -- the outer loop ----------------------------------------------------
+
+    def wants_params(self, step_idx: int) -> bool:
+        """Cheap pre-gate for the train-step hook: whether
+        :meth:`maybe_outer_step` would do anything with the params this
+        step. False lets the caller skip the device→host flatten
+        entirely (a no-op boundary check must not cost a full param
+        copy per step). Drains the transport on engaged non-boundary
+        steps as a side effect (drain needs no params)."""
+        if cfg_mod.async_mode() == "off":
+            return False
+        if self.membership.n_slices <= 1:
+            return False
+        if cfg_mod.async_mode() == "auto" and self._auto_decision is None:
+            return True  # the route solve needs the payload size once
+        if not self.engaged(None):
+            return False
+        if (int(step_idx) + 1) % self.h(None) != 0:
+            if self.state is not None:
+                self._drain()
+            return False
+        return True
+
+    def maybe_outer_step(self, step_idx: int, flat_params: np.ndarray):
+        """Drive the outer loop from the training loop, host-side: on a
+        non-boundary step this drains the transport into the pending
+        buffer and returns the params UNCHANGED (and with the plane
+        disengaged it is a pure identity — the knob-unset inertness
+        pin); on a boundary it runs :meth:`outer_round`. Never blocks:
+        the post is an enqueue, the poll reads only published bytes."""
+        flat = np.asarray(flat_params, np.float32).reshape(-1)
+        if not self.engaged(flat.size):
+            return flat_params
+        if self._membership_stale:
+            self._refresh_membership()
+        if self.state is None:
+            self.state = init_outer_state(flat, self.membership)
+        if (int(step_idx) + 1) % self.h(flat.size) != 0:
+            self._drain()
+            return flat_params
+        return self.outer_round(flat)
+
+    def _drain(self) -> None:
+        """Fold transport arrivals into the pending buffer (decode
+        deferred to the boundary — the arrival order across peers is
+        nondeterministic, the boundary fold order is not). Leaders only:
+        with an intra channel wired, non-leaders never touch the DCN
+        streams (they apply the leader's fold bytes instead, and the
+        streams' reader refcounts are sized for one consumer per peer
+        slice)."""
+        st = self.state
+        assert st is not None
+        if self._intra is not None and not self.is_leader:
+            return
+        for peer, round_idx, payload in self._transport.poll():
+            if peer == self.membership.slice_idx:
+                continue
+            if peer not in st["applied"]:
+                # a post-eviction stream re-derivation dropped this peer
+                metrics.add("cgx.async.stale_drops")
+                continue
+            st["pending"].setdefault(int(peer), []).append(
+                (int(round_idx), np.frombuffer(bytes(payload), np.uint8))
+            )
+
+    def _decode(self, buf: np.ndarray, n: int, cc: CompressionConfig):
+        q = codec_host.from_bytes(
+            buf, n, cc.bits, max(1, cc.bucket_size), np.float32,
+            skip_incomplete=cc.skip_incomplete_buckets,
+        )
+        return codec_host.dequantize(q, out_dtype=np.float32)
+
+    def outer_round(self, flat: np.ndarray) -> np.ndarray:
+        """One outer boundary: post this slice's compressed delta
+        (non-blocking), fold every arrived round through the outer
+        optimizer, enforce the staleness bound, and return the merged
+        anchor as the new inner params."""
+        st = self.state
+        assert st is not None
+        mem = self.membership
+        if not self.is_leader:
+            if self._intra is None:
+                raise RuntimeError(
+                    "AsyncPlane: non-leader has no intra channel "
+                    "(intra_fn returned None?) — a follower applies the "
+                    "leader's fold bytes, it cannot run the fold itself"
+                )
+            return self._outer_round_follower(st)
+        cc = self.delta_config().cc
+        use_ef = self.delta_config().error_feedback
+        delta = flat - st["anchor"]
+        d_eff = delta + st["ef"] if use_ef else delta
+        q = codec_host.quantize(
+            d_eff, cc.bits, max(1, cc.bucket_size),
+            skip_incomplete_buckets=cc.skip_incomplete_buckets,
+        )
+        wire = q.to_bytes()
+        decoded = codec_host.dequantize(q, out_dtype=np.float32)
+        if use_ef:
+            st["ef"] = d_eff - decoded
+        wire_b_bytes = wire.tobytes()
+        if self.first_delta_crc is None:
+            import zlib
+
+            self.first_delta_crc = zlib.crc32(wire_b_bytes)
+        # the decoded (not raw) delta is what every peer folds — folding
+        # it locally too keeps all slices' anchors bit-identical; only
+        # the slice leader posts (one writer per stream)
+        if self.is_leader:
+            self._transport.post(st["round"], wire_b_bytes)
+        raw_b, wire_b = 4.0 * d_eff.size, float(wire.nbytes)
+        metrics.add(
+            f"cgx.wire.bytes_raw.{wire_edges.EDGE_XSLICE_DELTA}", raw_b
+        )
+        metrics.add(
+            f"cgx.wire.bytes_wire.{wire_edges.EDGE_XSLICE_DELTA}", wire_b
+        )
+        self._drain()
+        # -- fold: own decoded + every arrived peer round <= ours, in
+        # deterministic (peer, round) order, each scaled 1/n_slices
+        scale = np.float32(1.0 / mem.n_slices)
+        agg = decoded * scale
+        applied_rounds = 0
+        for peer in sorted(st["pending"]):
+            rounds = sorted(st["pending"][peer], key=lambda rv: rv[0])
+            keep: List[Tuple[int, np.ndarray]] = []
+            for r, buf in rounds:
+                if r > st["round"]:
+                    keep.append((r, buf))  # from a future boundary
+                    continue
+                if r <= st["applied"].get(peer, -1):
+                    metrics.add("cgx.async.stale_drops")
+                    continue
+                agg += self._decode(buf, flat.size, cc) * scale
+                st["applied"][peer] = r
+                applied_rounds += 1
+            if keep:
+                st["pending"][peer] = keep
+            else:
+                st["pending"].pop(peer, None)
+        update, st["momentum"] = outer_update(
+            agg, st["momentum"],
+            kind=cfg_mod.async_outer(),
+            lr=cfg_mod.async_outer_lr(),
+            mu=cfg_mod.async_outer_momentum(),
+        )
+        st["anchor"] = st["anchor"] + update
+        this_round = st["round"]
+        st["round"] = this_round + 1
+        if self._intra is not None:
+            # Two-level leader scheme, outer edition: the slice's
+            # non-leaders apply these exact bytes — independent folding
+            # would diverge slice members, since peer rounds reach each
+            # rank's poll at different instants. Published BEFORE the
+            # staleness check so a tripping boundary still leaves the
+            # slice internally consistent.
+            self._intra.publish(
+                this_round, update.astype(np.float32, copy=False).tobytes()
+            )
+        # -- staleness bookkeeping + the bounded-staleness gate
+        max_lag = self.max_lag()
+        lag_floor = int(st.get("lag_floor", 0))
+        worst_lag, worst_peer = 0, None
+        for peer, last in sorted(st["applied"].items()):
+            lag = this_round - max(last, lag_floor - 1)
+            if lag > worst_lag:
+                worst_lag, worst_peer = lag, peer
+            health_mod.note_async_lag(
+                mem.global_ranks[peer] if peer < len(mem.global_ranks)
+                else None,
+                lag, float(max_lag),
+            )
+        metrics.set("cgx.async.lag_rounds", float(worst_lag))
+        metrics.add("cgx.async.rounds")
+        if worst_lag <= 1:
+            metrics.add("cgx.async.rounds_on_time")
+        metrics.add("cgx.async.rounds_folded", float(applied_rounds))
+        flightrec.record(
+            "async_round",
+            round=this_round,
+            generation=st["generation"],
+            folded=applied_rounds,
+            lag=worst_lag,
+            wire_bytes=int(wire_b),
+            bits=cc.bits,
+        )
+        if worst_lag > max_lag and worst_peer is not None:
+            suspect_local = (
+                mem.leaders[worst_peer]
+                if worst_peer < len(mem.leaders) else worst_peer
+            )
+            raise AsyncStalenessError(
+                f"async cross-slice plane: slice {worst_peer} (leader "
+                f"group-local rank {suspect_local}) is {worst_lag} outer "
+                f"rounds behind round {this_round} "
+                f"(CGX_ASYNC_MAX_LAG={max_lag}, generation "
+                f"{st['generation']}) — its deltas stopped arriving",
+                suspects=[suspect_local],
+                lag=worst_lag,
+                round=this_round,
+            )
+        return st["anchor"].copy()
+
+    def _outer_round_follower(self, st: Dict[str, Any]) -> np.ndarray:
+        """Non-leader boundary with an intra channel: apply the leader's
+        round fold byte-for-byte. The wait is intra-slice (the fast
+        tier — the same fabric the sync intra stage blocks on every
+        step), bounded, and raises ``BridgeTimeoutError`` into the
+        recovery ladder if the leader died or raised mid-boundary."""
+        this_round = st["round"]
+        buf = self._intra.fetch(this_round)
+        update = np.frombuffer(buf, np.float32)
+        if update.size != st["anchor"].size:
+            raise RuntimeError(
+                f"async intra broadcast: round {this_round} update has "
+                f"{update.size} elements, anchor has {st['anchor'].size} "
+                "— slice members disagree on the flattened param layout"
+            )
+        st["anchor"] = st["anchor"] + update
+        st["round"] = this_round + 1
+        # deliberately NOT cgx.async.rounds: that counter (and its
+        # rounds_on_time companion) is leader-only, so the summed
+        # on-time rate in cgx_report/cgx_top is not deflated by the
+        # slice fan-out; intra_fetched already ledgers follower rounds
+        return st["anchor"].copy()
+
+
+# ---------------------------------------------------------------------------
+# Pytree front door (the make_train_step outer hook's flatten/unflatten).
+# ---------------------------------------------------------------------------
+
+
+def flatten_tree(tree) -> Tuple[np.ndarray, Callable[[np.ndarray], Any]]:
+    """(flat f32 host vector, unflatten) for a params pytree — the
+    plane's fused-buffer view. Unflatten restores leaf shapes/dtypes and
+    the original tree structure (values come back as numpy; the caller's
+    jit re-places them on device)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    arrs = [np.asarray(l, np.float32).reshape(-1) for l in leaves]
+    flat = (
+        np.concatenate(arrs) if arrs else np.zeros((0,), np.float32)
+    )
+    shapes = [np.shape(l) for l in leaves]
+    dtypes = [np.asarray(l).dtype for l in leaves]
+    sizes = [a.size for a in arrs]
+
+    def unflatten(v: np.ndarray):
+        out, off = [], 0
+        for shape, dtype, size in zip(shapes, dtypes, sizes):
+            out.append(
+                np.asarray(v[off:off + size], np.float32)
+                .reshape(shape).astype(dtype)
+            )
+            off += size
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    return flat, unflatten

@@ -18,7 +18,7 @@ from __future__ import annotations
 
 import functools
 import time
-from typing import Callable, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -352,8 +352,20 @@ def make_train_step(
     topk_ratio: Optional[float] = None,
     nonfinite_guard: Optional[str] = None,
     snapshot_every: Optional[int] = None,
+    outer: Optional[Any] = None,
 ):
     """Build a jitted compressed-DP train step.
+
+    ``outer`` (default None): an
+    :class:`~torch_cgx_tpu.parallel.async_plane.AsyncPlane` — the PR 13
+    asynchronous cross-slice hook. After the jitted call, the plane runs
+    host-side on the updated params: every ``CGX_ASYNC_H``-th step it
+    posts this slice's compressed parameter delta to the dedicated
+    sender thread (never blocking on DCN) and folds arrived peer deltas
+    into the outer anchor, which becomes the returned params. Pure
+    Python around the jit boundary — the staged program is UNCHANGED
+    (the jaxpr pin in tests/test_async_plane.py), and with ``CGX_ASYNC``
+    unset (or ``outer=None``) the hook is an identity.
 
     ``snapshot_every`` (default: ``CGX_SNAPSHOT_EVERY`` env, 0 = off):
     the recovery supervisor's rollback hook. Every N-th step the wrapper
@@ -815,7 +827,7 @@ def make_train_step(
         snapshot_every if snapshot_every is not None
         else cfg_mod.snapshot_every()
     )
-    snap_holder = {"snap": None}
+    snap_holder = {"snap": None, "outer": None}
 
     def _maybe_snapshot(step_idx, tree) -> None:
         if not snap_every:
@@ -825,6 +837,14 @@ def make_train_step(
             from .. import checkpoint as ckpt
 
             snap_holder["snap"] = ckpt.snapshot_in_memory(tree, idx)
+            # The async plane's outer state (anchor, EF, momentum,
+            # round, generation) is part of the rollback point: a
+            # replay against the crash-time anchor would compute wrong
+            # deltas and re-post advanced rounds (docs/ROBUSTNESS.md
+            # "Async recovery semantics").
+            snap_holder["outer"] = (
+                outer.export_state() if outer is not None else None
+            )
             metrics.add("cgx.recovery.snapshots")
 
     # Live health plane: step cadence measured host-side, dispatch to
@@ -854,19 +874,42 @@ def make_train_step(
             health_mod.note_step(dt)
         metrics.add("cgx.step.count")
 
+    def _apply_outer(step_idx, params):
+        """PR 13 outer hook: host-side local-SGD boundary on the updated
+        params. The flatten (a full device→host param copy) runs ONLY
+        when the plane would actually act this step
+        (``AsyncPlane.wants_params`` — knob off, disengaged, and
+        non-boundary steps all skip it; non-boundary drains happen
+        inside the gate and need no params)."""
+        if outer is None or not outer.wants_params(int(step_idx)):
+            return params
+        from . import async_plane as async_mod
+
+        flat, unflatten = async_mod.flatten_tree(params)
+        new_flat = outer.maybe_outer_step(int(step_idx), flat)
+        if new_flat is flat:
+            return params
+        return unflatten(new_flat)
+
     if error_feedback or powersgd_rank is not None or topk_ratio is not None:
 
         def step(params, opt_state, state, batch, step_idx):
             _note_step_cadence()
             _maybe_snapshot(step_idx, (params, opt_state, state))
-            return _build(batch)(params, opt_state, state, batch, step_idx)
+            new_p, new_opt, new_state, loss = _build(batch)(
+                params, opt_state, state, batch, step_idx
+            )
+            return _apply_outer(step_idx, new_p), new_opt, new_state, loss
 
     else:
 
         def step(params, opt_state, batch, step_idx):
             _note_step_cadence()
             _maybe_snapshot(step_idx, (params, opt_state))
-            return _build(batch)(params, opt_state, batch, step_idx)
+            new_p, new_opt, loss = _build(batch)(
+                params, opt_state, batch, step_idx
+            )
+            return _apply_outer(step_idx, new_p), new_opt, loss
 
     def last_snapshot():
         """The most recent in-memory snapshot (``checkpoint.
@@ -875,12 +918,17 @@ def make_train_step(
 
     def rollback():
         """(step_idx, input tree) restored from the last snapshot —
-        registry snapshot re-installed; None when no snapshot exists."""
+        registry snapshot re-installed, and the attached async plane's
+        outer state restored alongside (the replay must see the
+        snapshot-time anchor/EF/momentum, not the crash-time ones);
+        None when no snapshot exists."""
         snap = snap_holder["snap"]
         if snap is None:
             return None
         from .. import checkpoint as ckpt
 
+        if outer is not None:
+            outer.restore_state(snap_holder.get("outer"))
         metrics.add("cgx.recovery.rollbacks")
         return snap.step, ckpt.restore_in_memory(snap)
 

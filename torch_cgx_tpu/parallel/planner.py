@@ -80,6 +80,13 @@ CHUNK_CANDIDATES = (1, 2, 4, 8, 16)
 # set (the solver's range mirrors wire/controller.py's default).
 BITS_RANGE = (2, 8)
 
+# Async cross-slice plane (PR 13): candidate outer cadences the H solve
+# considers, and the modeled convergence cost of one extra inner step of
+# cross-slice drift (fraction of a step per unit H — the term that keeps
+# the solve from always picking the largest H).
+ASYNC_H_CANDIDATES = (2, 4, 8, 16, 32, 64)
+ASYNC_DRIFT_FRAC = 0.01
+
 
 # ---------------------------------------------------------------------------
 # The cost model.
@@ -107,6 +114,12 @@ class CostModel:
     overlap_frac: float = 0.0
     chunk_overhead_s: float = 100e-6
     compute_s: float = 0.0
+    # Cross-slice (DCN) effective link bandwidth — the slow tier the
+    # async plane (PR 13) exists to take off the critical path. Distinct
+    # from ``wire_gbps`` (the intra/bridge rate): the sync-vs-async route
+    # decision compares the SAME payload over the two tiers. Calibrated
+    # live from the sender thread's ``cgx.async.wire_gbps`` gauge.
+    dcn_gbps: float = 0.25
     source: str = "default"
 
     # -- calibration -------------------------------------------------------
@@ -243,6 +256,16 @@ class CostModel:
         if hist and hist.get("p50"):
             kw["compute_s"] = float(hist["p50"])
             fields.append("step_p50")
+        # Async-plane calibration: the sender thread gauges its measured
+        # DCN put throughput per shipped round (``cgx.async.wire_gbps``)
+        # — the live number the sync-vs-async route curves divide by.
+        try:
+            agbps = float(metrics.get("cgx.async.wire_gbps"))
+        except Exception:
+            agbps = 0.0
+        if agbps > 0:
+            kw["dcn_gbps"] = agbps
+            fields.append("async")
         if not kw:
             return base
         return dataclasses.replace(base, source="+".join(fields), **kw)
@@ -331,6 +354,54 @@ class CostModel:
         ov = self.overlap_frac if reverse_order else 0.0
         return comp + coll - ov * min(comp, coll)
 
+    def predict_outer(
+        self,
+        n: int,
+        n_slices: int,
+        bits: int,
+        bucket: int,
+        h: int,
+        *,
+        step_s: Optional[float] = None,
+    ) -> float:
+        """Amortized per-inner-step critical-path seconds of the ASYNC
+        cross-slice exchange at cadence ``h`` (the PR 13 outer loop):
+
+        * boundary codec work — quantize the ``n``-element delta once,
+          decode ``n_slices`` deltas at the fold — amortizes as ``1/h``;
+        * the DCN wire itself rides the sender thread OFF the critical
+          path; only the backlog past the cadence window leaks back:
+          ``max(0, t_wire - h*step) / h`` (a round must ship within the
+          ``h`` inner steps it has before the next one, or lag grows
+          until the staleness bound trips);
+        * staleness drift — each extra inner step between
+          reconciliations costs convergence; modeled as
+          ``ASYNC_DRIFT_FRAC`` of a step per unit H, the term that gives
+          the H solve its interior optimum (pure speed would always pick
+          the largest H and let quality pay).
+
+        ``step_s`` defaults to the calibrated ``compute_s`` (the
+        ``cgx.step.time_s`` p50); with neither known the cadence-window
+        term is skipped (codec + drift still rank H sensibly)."""
+        n = int(n)
+        h = max(1, int(h))
+        if n <= 0 or n_slices <= 1:
+            return 0.0
+        t_codec = (
+            4.0 * n / (self.quantize_gbps * 1e9)
+            + 4.0 * n * n_slices / (self.dequantize_gbps * 1e9)
+        )
+        t_wire = self.wire_bytes(n, bits, bucket) / (self.dcn_gbps * 1e9)
+        step = float(step_s) if step_s else self.compute_s
+        if step <= 0:
+            # no step-time evidence: assume a cadence where the default H
+            # just keeps the wire fed — the codec and drift terms still
+            # rank candidate Hs sensibly
+            step = t_wire / cfg_mod.DEFAULT_ASYNC_H
+        exposed = max(0.0, t_wire - h * step) / h
+        drift = ASYNC_DRIFT_FRAC * step * h
+        return t_codec / h + exposed + drift
+
 
 def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
     out: List[Tuple[float, float]] = []
@@ -396,10 +467,13 @@ def _model_from_file() -> Optional[CostModel]:
     if not path:
         return None
     try:
-        mtime = os.stat(path).st_mtime_ns
+        st = os.stat(path)
     except OSError:
         return None
-    key = (path, mtime)
+    # (mtime, size), not mtime alone: filesystem mtime granularity can be
+    # coarser than two consecutive writes, and a rewrite landing in the
+    # same tick must not serve the previous file's model.
+    key = (path, st.st_mtime_ns, st.st_size)
     hit = _MODEL_FILE_CACHE.get(key)
     if hit is not None:
         return hit
@@ -829,6 +903,86 @@ def bridge_chunks(
     metrics.add("cgx.plan.bridge_hints")
     metrics.set("cgx.plan.bridge_chunks", float(best_c))
     return best_c
+
+
+# ---------------------------------------------------------------------------
+# The async route (PR 13): sync two-level vs async-H cost curves.
+# ---------------------------------------------------------------------------
+
+
+def solve_async_h(
+    n: int,
+    n_slices: int,
+    bits: int,
+    bucket: int,
+    *,
+    model: Optional[CostModel] = None,
+    step_s: Optional[float] = None,
+) -> Tuple[int, float]:
+    """(best H, predicted per-inner-step seconds) over
+    ``ASYNC_H_CANDIDATES`` — argmin of :meth:`CostModel.predict_outer`.
+    Slower DCN pushes H up (the cadence-window term), the drift term
+    pulls it back down; ties prefer the SMALLER H (tighter coupling for
+    the same predicted time)."""
+    model = model or cost_model()
+    best_h, best_t = ASYNC_H_CANDIDATES[0], float("inf")
+    for h in ASYNC_H_CANDIDATES:
+        t = model.predict_outer(
+            n, n_slices, bits, bucket, h, step_s=step_s
+        )
+        if t < best_t - 1e-15:
+            best_h, best_t = h, t
+    return best_h, best_t
+
+
+def async_route(
+    n: int,
+    n_slices: int,
+    bits: int,
+    bucket: int,
+    *,
+    model: Optional[CostModel] = None,
+    step_s: Optional[float] = None,
+) -> Tuple[str, int]:
+    """The ``CGX_ASYNC=auto`` decision: ("async" | "sync", H).
+
+    Sync arm: the synchronous two-level cross exchange — the SAME
+    payload priced by :meth:`CostModel.predict_slice` with the wire rate
+    swapped to the calibrated DCN tier (``dcn_gbps``), paid EVERY inner
+    step. Async arm: the best-H outer loop
+    (:func:`solve_async_h`). The curves cross where DCN gets slow enough
+    that amortizing it over H steps (and taking it off the critical
+    path) beats compressing harder — exactly the regime the ROADMAP's
+    "many slices across DCs" tier lives in. Gauged
+    (``cgx.async.route_pred_ratio``) so drift between the two
+    predictions is visible in cgx_top/cgx_report."""
+    model = model or cost_model()
+    dcn_model = dataclasses.replace(model, wire_gbps=model.dcn_gbps)
+    t_sync = dcn_model.predict_slice(
+        n, max(2, n_slices), bits, bucket, chunks=1, route="bridge"
+    )
+    h_best, t_async = solve_async_h(
+        n, n_slices, bits, bucket, model=model, step_s=step_s
+    )
+    route = "async" if t_async < t_sync else "sync"
+    metrics.set("cgx.async.route_h", float(h_best))
+    if t_sync > 0:
+        metrics.set(
+            "cgx.async.route_pred_ratio", round(t_async / t_sync, 6)
+        )
+    from ..observability import flightrec
+
+    flightrec.record(
+        "async_route",
+        route=route,
+        h=h_best,
+        predicted_async_ms=round(t_async * 1e3, 6),
+        predicted_sync_ms=round(t_sync * 1e3, 6),
+        n=int(n),
+        n_slices=int(n_slices),
+        model=model.source,
+    )
+    return route, h_best
 
 
 # ---------------------------------------------------------------------------

@@ -118,7 +118,15 @@ def classify_hosts(hosts: Sequence) -> GroupTopology:
     """Bridge-side classification: a torch process group's per-rank host
     fingerprints (``ProcessGroupCGX._host_by_rank``) map to slice ids by
     first-seen order. Same taxonomy as the mesh classifier, so the bridge
-    and the JAX router agree on what "mixed" means."""
+    and the JAX router agree on what "mixed" means.
+
+    Must always be fed the CURRENT membership's host list — after a PR 5
+    eviction that is the survivor-filtered map at the bumped generation,
+    whose slice ids are re-derived from scratch by the first-seen walk
+    (non-contiguous pre-eviction ids collapse back to 0..n_slices-1).
+    Deriving from a cached pre-eviction classification is exactly the bug
+    :func:`slice_leaders` + ``invalidate_classification_cache`` close: a
+    stale map can name an evicted rank as a cross-slice leader."""
     seen: dict = {}
     ids = []
     for h in hosts:
@@ -126,6 +134,24 @@ def classify_hosts(hosts: Sequence) -> GroupTopology:
             seen[h] = len(seen)
         ids.append(seen[h])
     return classify_slice_ids(ids)
+
+
+def slice_leaders(hosts: Sequence) -> list:
+    """Group-local leader ranks, one per slice, derived from the CURRENT
+    per-rank host map: the lowest group-local rank of each distinct host,
+    ordered by first appearance (the slice-id order
+    :func:`classify_hosts` assigns). The canonical re-derivation for the
+    two-level cross stage and the async plane's membership — after an
+    eviction the caller passes the survivor-filtered map at the bumped
+    generation, so an evicted rank can never be named leader
+    (regression-pinned in tests/test_async_plane.py).
+    ``torch_backend.backend._slice_leaders`` keeps the sanctioned
+    dependency-light duplicate, pinned equal by the same test."""
+    seen: dict = {}
+    for i, h in enumerate(hosts):
+        if h not in seen:
+            seen[h] = i
+    return list(seen.values())
 
 
 # Classification of a fixed (mesh, axes) pair never changes, but the scan
@@ -136,6 +162,22 @@ def classify_hosts(hosts: Sequence) -> GroupTopology:
 # classifications across patches).
 _CLASSIFY_CACHE: dict = {}
 _CLASSIFY_CACHE_MAX = 64
+
+
+def invalidate_classification_cache(reason: str = "reconfigure") -> None:
+    """Drop every memoized group classification. Cascaded from
+    ``supervisor.invalidate_trace_caches``: the memo key is (mesh, axes,
+    classifier fn), none of which change when a PR 5 eviction shrinks the
+    world underneath an unchanged mesh object — a stale hit could then
+    route a group as MIXED against a slice map whose leader was just
+    evicted (the cached-classification bug this PR's regression test
+    pins). Route/cache_key callers re-scan on the next call."""
+    if _CLASSIFY_CACHE:
+        _CLASSIFY_CACHE.clear()
+        from ..utils.logging import get_logger, metrics
+
+        metrics.add("cgx.xla.topo_cache_invalidations")
+        get_logger().info("topology classification cache dropped (%s)", reason)
 
 
 def classify_mesh_axes(mesh, axes: Sequence[str]) -> GroupTopology:

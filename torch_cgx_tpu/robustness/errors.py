@@ -34,6 +34,35 @@ class BridgeTimeoutError(RuntimeError):
         self.suspects = tuple(suspects)
 
 
+class AsyncStalenessError(BridgeTimeoutError):
+    """The asynchronous cross-slice plane's bounded-staleness gate
+    tripped: a peer slice fell more than ``CGX_ASYNC_MAX_LAG`` outer
+    rounds behind this slice's outer round, and its deltas are no longer
+    arriving. Subclasses :class:`BridgeTimeoutError` so the recovery
+    supervisor's ladder (``RECOVERABLE``) treats it exactly like an
+    expired bridge wait — with ``suspects`` naming the lagging slice's
+    leader, the eviction vote has its evidence before any bridge timeout
+    could have fired (the async plane never blocks on DCN, so a bridge
+    timeout never WOULD fire).
+
+    ``lag`` carries the observed staleness in outer rounds; ``round`` the
+    emitting slice's outer round when the bound tripped.
+    """
+
+    def __init__(
+        self,
+        message: str,
+        *,
+        key: Optional[str] = None,
+        suspects: Sequence[int] = (),
+        lag: int = 0,
+        round: int = 0,
+    ):
+        super().__init__(message, key=key, suspects=suspects)
+        self.lag = int(lag)
+        self.round = int(round)
+
+
 class WireCorruptionError(RuntimeError):
     """A payload failed its wire checksum twice (one fresh re-read
     included): the bytes in the shared-memory arena do not match what the

@@ -39,7 +39,11 @@ Spec tokens: a bare float is a per-event probability; ``NNms``/``NNs`` a
 delay; ``step=N`` fires only on the mode's N-th event (0-based; for
 ``nan_grad`` the training step index); ``rank=N`` restricts to one rank
 (a bare integer on ``kill_rank``/``slow_rank`` is shorthand for
-``rank=N``).
+``rank=N``); ``edge=dcn`` scopes ``slow_rank`` to the cross-slice (DCN)
+exchange sites ONLY — the two-level reduction's cross stage and the
+async plane's sender thread — modeling a slow DCN *edge* instead of a
+rank slow at every collective (the ``bench.py --async-dcn`` fault: the
+synchronous two-level path stalls on it, the async plane does not).
 
 Determinism: probabilistic gates draw from a per-rank stream seeded by
 ``CGX_FAULTS_SEED`` (default 0), so a failing chaos run replays exactly.
@@ -89,11 +93,21 @@ class FaultSpec:
     step: Optional[int] = None
     rank: Optional[int] = None
     delay_ms: float = 0.0
+    edge: Optional[str] = None  # None = legacy sites; "dcn" = cross only
 
     def __post_init__(self):
         if self.mode not in MODES:
             raise ValueError(
                 f"CGX_FAULTS: unknown mode {self.mode!r} (known: {MODES})"
+            )
+        if self.edge is not None and self.edge != "dcn":
+            raise ValueError(
+                f"CGX_FAULTS: edge= must be 'dcn', got {self.edge!r}"
+            )
+        if self.edge is not None and self.mode != "slow_rank":
+            raise ValueError(
+                f"CGX_FAULTS: edge= only applies to slow_rank, not "
+                f"{self.mode!r}"
             )
         if self.prob is not None and not 0.0 < self.prob <= 1.0:
             raise ValueError(
@@ -131,6 +145,8 @@ def parse_faults(raw: str) -> List[FaultSpec]:
                 kw["step"] = int(tok[len("step="):])
             elif tok.startswith("rank="):
                 kw["rank"] = int(tok[len("rank="):])
+            elif tok.startswith("edge="):
+                kw["edge"] = tok[len("edge="):]
             elif mode in ("kill_rank", "slow_rank") and "." not in tok:
                 kw["rank"] = int(tok)  # kill_rank:2 == kill_rank:rank=2
             else:
@@ -199,7 +215,22 @@ class FaultInjector:
 
     def delay(self, mode: str = "delay_take") -> None:
         s = self._specs.get(mode)
+        if s is not None and s.edge is not None:
+            return  # edge-scoped spec: only delay_edge sites fire it
         if s is not None and s.delay_ms > 0 and self.fire(mode):
+            time.sleep(s.delay_ms / 1000.0)
+
+    def delay_edge(self, mode: str, edge: str) -> None:
+        """Edge-scoped delay site (the cross-slice exchange entries): a
+        spec carrying ``edge=<edge>`` fires here and ONLY here — the
+        legacy per-collective :meth:`delay` site skips edge-scoped specs,
+        so ``slow_rank:...@edge=dcn`` models a slow DCN link, not a rank
+        slow at every collective."""
+        s = self._specs.get(mode)
+        if (
+            s is not None and s.edge == edge and s.delay_ms > 0
+            and self.fire(mode)
+        ):
             time.sleep(s.delay_ms / 1000.0)
 
     def flap_delay(self, mode: str = "flap") -> Optional[float]:

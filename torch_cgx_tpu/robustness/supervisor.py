@@ -152,6 +152,20 @@ def invalidate_trace_caches() -> None:
     wire_edges = sys.modules.get("torch_cgx_tpu.wire.edges")
     if wire_edges is not None:
         wire_edges.reset_edge_state("recovery reconfigure")
+    # Topology classification memo: keyed on (mesh, axes, classifier fn),
+    # none of which move when an eviction shrinks the world under an
+    # unchanged mesh object — a stale hit can name an evicted rank as a
+    # cross-slice leader (the PR 13 regression class).
+    topo = sys.modules.get("torch_cgx_tpu.parallel.topology")
+    if topo is not None:
+        topo.invalidate_classification_cache("recovery reconfigure")
+    # Async cross-slice plane: per-peer round bookkeeping and the pending
+    # delta buffer describe the dead generation's membership — the plane
+    # re-derives slice leaders from the survivor host map at the bumped
+    # generation on its next outer boundary.
+    async_plane = sys.modules.get("torch_cgx_tpu.parallel.async_plane")
+    if async_plane is not None:
+        async_plane.reset_planes("recovery reconfigure")
     metrics.add("cgx.recovery.trace_cache_invalidations")
 
 
@@ -232,11 +246,14 @@ class RecoverySupervisor:
 
     def note_health_event(self, event) -> None:
         """Health-engine consumer (registered in ``__init__`` when the
-        engine is running): a sustained straggler score against a peer
-        becomes suspect evidence for the next rendezvous — recorded in
-        the black box the moment it arrives, which is typically long
-        before any bounded wait expires."""
-        if getattr(event, "kind", None) != "straggler":
+        engine is running): a sustained straggler score against a peer —
+        or an ``async_lag`` event naming a slice leader whose outer
+        rounds stopped arriving (PR 13) — becomes suspect evidence for
+        the next rendezvous, recorded in the black box the moment it
+        arrives, which is typically long before any bounded wait expires
+        (for async_lag, before any wait even EXISTS: the async plane
+        never blocks on DCN)."""
+        if getattr(event, "kind", None) not in ("straggler", "async_lag"):
             return
         suspect = getattr(event, "suspect", None)
         if suspect is None or suspect == self.global_rank:

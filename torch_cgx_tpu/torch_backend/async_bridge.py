@@ -1,0 +1,457 @@
+"""Decoupled DCN sender/receiver for the asynchronous cross-slice plane.
+
+The synchronous bridge puts every cross-slice byte on the train step's
+critical path: a collective's cross stage blocks in ``_take`` until the
+slowest DCN edge answers. This module is the transport half of the PR 13
+async plane (``parallel/async_plane.py``): one **dedicated sender
+thread** per group drains a post queue onto the shm/store bridge, so the
+train step *never* blocks on DCN — ``post()`` is an enqueue, ``poll()``
+is a counter read plus gets of payloads already published, and every
+wait inside the thread body is bounded (``tools/lint.py
+check_async_sender_blocking`` rejects unbounded ``.result()`` /
+``_wait_key`` waits in this file's sender bodies).
+
+Wire protocol (one stream per slice, generation-namespaced by the
+caller's ``ns`` function so pre-recovery rounds can never alias into a
+reconfigured group — the PR 5 key discipline):
+
+* ``cgxasync/s<slice>/n`` — a store counter, bumped AFTER the payload
+  key is set (publish-after-write: a reader that observes seq ``k`` can
+  get key ``k`` without waiting);
+* ``cgxasync/s<slice>/<seq>`` — one outer round's framed delta:
+  an 8-byte little-endian round index, then the codec wire bytes.
+
+The ``slow_rank:...@edge=dcn`` fault (robustness/faults.py) injects its
+delay inside the sender thread — the slow DCN edge slows *delivery*, not
+the train step, which is the whole measurement ``bench.py --async-dcn``
+commits.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import timeline
+from ..utils.logging import get_logger, metrics
+
+log = get_logger()
+
+_HDR = struct.Struct("<Q")  # outer round index
+
+# Sender-loop tick: the queue wait is sliced so a stop request is honored
+# within one tick even when no post ever arrives.
+_TICK_S = 0.2
+
+# A transient store error must not silently drop an outer round — every
+# peer's anchor would be missing that contribution forever (EF carries
+# quantization residual, not lost sends). Bounded retries with backoff;
+# a round that still fails is counted + flight-recorded as a failure.
+_SHIP_RETRIES = 3
+_SHIP_BACKOFF_S = 0.05
+
+
+def frame(round_idx: int, payload: bytes) -> bytes:
+    """One outer round's wire frame: round header + codec bytes."""
+    return _HDR.pack(int(round_idx)) + payload
+
+
+def unframe(buf: bytes) -> Tuple[int, bytes]:
+    (round_idx,) = _HDR.unpack_from(buf)
+    return int(round_idx), bytes(buf[_HDR.size:])
+
+
+class AsyncBridgeSender:
+    """Non-blocking outer-exchange transport over a c10d-style store.
+
+    ``store`` needs ``set``/``get``/``add`` (the same subset the bridge
+    collectives use); ``ns`` namespaces keys (pass the group's ``_ns``
+    so streams are generation-tagged); ``slice_idx`` is this slice's
+    position among ``n_slices`` slice streams; ``injector`` is the
+    optional fault injector whose ``slow_rank@edge=dcn`` delay fires in
+    the sender thread (off the train step's critical path — the point).
+
+    Lifecycle: the thread starts lazily on the first :meth:`post` and is
+    joined by :meth:`stop` (bounded). A send failure is logged and
+    counted (``cgx.async.send_errors``), never raised into the training
+    loop — staleness detection is the async plane's job, and a dead
+    store will surface there as peers' rounds ceasing to arrive.
+    """
+
+    def __init__(
+        self,
+        store,
+        slice_idx: int,
+        n_slices: int,
+        *,
+        ns: Optional[Callable[[str], str]] = None,
+        injector=None,
+        generation: int = 0,
+        readers_by_slice: Optional[Dict[int, int]] = None,
+    ):
+        if not 0 <= slice_idx < n_slices:
+            raise ValueError(
+                f"slice_idx {slice_idx} out of range for {n_slices} slices"
+            )
+        self._store = store
+        self.slice_idx = int(slice_idx)
+        self.n_slices = int(n_slices)
+        self.generation = int(generation)
+        self._ns = ns or (lambda k: k)
+        self._injector = injector
+        self._q: "_queue.Queue" = _queue.Queue()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._thread_lock = threading.Lock()
+        # per-peer consumed sequence numbers (poll bookkeeping)
+        self._seen: Dict[int, int] = {
+            p: 0 for p in range(n_slices) if p != slice_idx
+        }
+        # how many ranks consume each slice's stream (refcounted delete,
+        # the backend _take readers discipline); 1 = single consumer
+        self._readers_by_slice = dict(readers_by_slice or {})
+        self._store_can_delete: Optional[bool] = None
+
+    # -- keys --------------------------------------------------------------
+
+    def _counter_key(self, slice_idx: int) -> str:
+        return self._ns(f"cgxasync/s{slice_idx}/n")
+
+    def _payload_key(self, slice_idx: int, seq: int) -> str:
+        return self._ns(f"cgxasync/s{slice_idx}/{seq}")
+
+    # -- sender side -------------------------------------------------------
+
+    def post(self, round_idx: int, payload: bytes) -> None:
+        """Enqueue one outer round's framed delta for the sender thread.
+        Returns immediately — the train step never blocks on DCN."""
+        self._ensure_thread()
+        self._q.put((int(round_idx), bytes(payload)))
+        metrics.add("cgx.async.posted")
+
+    def pending(self) -> int:
+        """Posts enqueued but not yet shipped (sender-thread backlog —
+        a growing number means the DCN edge is slower than the outer
+        cadence; it shows up in ``cgx.async.backlog`` too)."""
+        return self._q.qsize()
+
+    def _ensure_thread(self) -> None:
+        with self._thread_lock:
+            if self._thread is None or not self._thread.is_alive():
+                self._stop.clear()
+                self._thread = threading.Thread(
+                    target=self._run, name="cgx-async-send", daemon=True
+                )
+                self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                round_idx, payload = self._q.get(timeout=_TICK_S)
+            except _queue.Empty:
+                continue
+            for attempt in range(_SHIP_RETRIES):
+                try:
+                    self._ship(round_idx, payload)
+                    break
+                except Exception as e:
+                    # A dropped round would desynchronize every peer's
+                    # anchor from this slice's forever — retry with
+                    # backoff; only after the last attempt is it a
+                    # counted, flight-recorded loss (a staleness event
+                    # on the peers, never a train-step failure here).
+                    metrics.add("cgx.async.send_errors")
+                    log.warning(
+                        "async sender: shipping round %d failed "
+                        "(attempt %d/%d): %s",
+                        round_idx, attempt + 1, _SHIP_RETRIES, e,
+                    )
+                    if attempt + 1 == _SHIP_RETRIES:
+                        metrics.add("cgx.async.rounds_lost")
+                        from ..observability import flightrec
+
+                        flightrec.record(
+                            "async_send_lost", round=round_idx,
+                            generation=self.generation, error=str(e)[:160],
+                        )
+                    elif self._stop.wait(_SHIP_BACKOFF_S * (1 << attempt)):
+                        break  # stopping: abandon the retry loop
+
+    def _ship(self, round_idx: int, payload: bytes) -> None:
+        if self._injector is not None:
+            # The injected slow DCN edge lives HERE — delivery slows,
+            # the train step does not (bench.py --async-dcn's contrast).
+            self._injector.delay_edge("slow_rank", "dcn")
+        buf = frame(round_idx, payload)
+        t0 = time.perf_counter()
+        seq = int(self._store.add(self._counter_key(self.slice_idx), 0)) + 1
+        self._store.set(self._payload_key(self.slice_idx, seq), buf)
+        # publish-after-write: the counter moves only once the payload
+        # key is readable, so poll() never waits on a half-posted round
+        self._store.add(self._counter_key(self.slice_idx), 1)
+        dt = time.perf_counter() - t0
+        metrics.add("cgx.async.rounds_shipped")
+        metrics.add("cgx.async.bytes_wire", float(len(buf)))
+        metrics.set("cgx.async.backlog", float(self._q.qsize()))
+        if dt > 0:
+            metrics.set(
+                "cgx.async.wire_gbps", round(len(buf) / dt / 1e9, 6)
+            )
+        timeline.record(
+            "async.post", timeline.CAT_WIRE, t0, dt,
+            bytes=len(buf), round=round_idx, generation=self.generation,
+        )
+
+    # -- receiver side -----------------------------------------------------
+
+    def poll(self) -> List[Tuple[int, int, bytes]]:
+        """Drain every peer slice's newly-published rounds WITHOUT
+        blocking on unpublished ones: ``(peer_slice, round, payload)``
+        tuples in (peer, seq) order. Each peer's counter is read with
+        ``add(0)``; only seqs at or below it are fetched — and those keys
+        exist by the publish-after-write ordering, so the gets return
+        promptly (and are store-timeout-bounded regardless)."""
+        out: List[Tuple[int, int, bytes]] = []
+        for peer in sorted(self._seen):
+            try:
+                n = int(self._store.add(self._counter_key(peer), 0))
+            except Exception as e:
+                metrics.add("cgx.async.poll_errors")
+                log.warning("async poll: counter read for slice %d "
+                            "failed: %s", peer, e)
+                continue
+            for seq in range(self._seen[peer] + 1, n + 1):
+                key = self._payload_key(peer, seq)
+                try:
+                    buf = bytes(self._store.get(key))
+                except Exception as e:
+                    metrics.add("cgx.async.poll_errors")
+                    log.warning(
+                        "async poll: get(%s) failed: %s", key, e
+                    )
+                    break
+                self._seen[peer] = seq
+                self._consume(key, self._readers_by_slice.get(peer, 1))
+                round_idx, payload = unframe(buf)
+                metrics.add("cgx.async.rounds_received")
+                out.append((peer, round_idx, payload))
+        return out
+
+    def _consume(self, key: str, readers: int) -> None:
+        """Refcounted consume-side GC (the backend ``_take`` discipline):
+        the last of ``readers`` consumers deletes the payload key and the
+        ack counter — earlier ones only ack, so same-slice peers reading
+        the same stream never race a delete."""
+        if readers <= 1:
+            self._delete_key(key)
+            return
+        try:
+            acks = int(self._store.add(key + "/ack", 1))
+        except Exception as e:
+            metrics.add("cgx.async.poll_errors")
+            log.debug("async ack(%r) failed: %s", key, e)
+            return
+        if acks >= readers:
+            self._delete_key(key)
+            self._delete_key(key + "/ack")
+
+    def _delete_key(self, key: str) -> None:
+        """Best-effort consume-side GC with a one-time capability probe
+        (the backend ``_delete_key`` contract: stores without delete keep
+        their keys — a bounded leak of one key per outer round)."""
+        if self._store_can_delete is False:
+            return
+        try:
+            self._store.delete_key(key)
+            self._store_can_delete = True
+        except (NotImplementedError, AttributeError):
+            self._store_can_delete = False
+        except Exception as e:
+            self._store_can_delete = False
+            log.debug("async store delete(%r) failed: %s", key, e)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def stop(self, timeout: float = 2.0) -> None:
+        """Stop the sender thread (bounded join; enqueued-but-unshipped
+        posts are dropped — by then the group is reconfiguring and the
+        stream's generation namespace is dead anyway)."""
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=timeout)
+        self._thread = None
+
+
+class IntraBroadcast:
+    """Intra-slice agreement channel for the outer fold.
+
+    Ranks of one slice must apply IDENTICAL outer updates or their
+    params diverge and the 'one writer per stream' invariant (a slice's
+    delta is the same on every member) breaks: peer rounds arrive at
+    each rank's poll at different instants, so independent folding is
+    not deterministic across slice members. The fix is the two-level
+    leader scheme applied to the outer loop — the LEADER computes the
+    fold and broadcasts the resulting anchor-update bytes intra-slice;
+    non-leaders apply exactly those bytes.
+
+    This wait is intra-slice (the FAST tier — the same fabric the sync
+    intra stage already blocks on every step), never DCN, so it does not
+    violate the plane's never-block-on-DCN contract; it is bounded by
+    ``timeout_s`` and raises ``BridgeTimeoutError`` on expiry (a leader
+    that died or raised mid-boundary surfaces to the recovery ladder on
+    every member). Publish-after-write ordering as everywhere else.
+    """
+
+    _POLL_S = 0.002
+
+    def __init__(
+        self,
+        store,
+        slice_idx: int,
+        *,
+        n_local: int,
+        ns: Optional[Callable[[str], str]] = None,
+        timeout_s: float = 60.0,
+        generation: int = 0,
+    ):
+        self._store = store
+        self.slice_idx = int(slice_idx)
+        self.n_local = int(n_local)
+        self.generation = int(generation)
+        self._ns = ns or (lambda k: k)
+        self._timeout_s = float(timeout_s)
+        self._store_can_delete: Optional[bool] = None
+
+    def _payload_key(self, round_idx: int) -> str:
+        return self._ns(f"cgxasyncb/s{self.slice_idx}/r{round_idx}")
+
+    def publish(self, round_idx: int, payload: bytes) -> None:
+        """Leader side: post round ``round_idx``'s fold result for the
+        slice's non-leaders (payload key first, per-round publish flag
+        after — a PER-ROUND flag, not a cumulative counter: outer rounds
+        survive a generation bump while the namespace resets, so a
+        cumulative count restarted at 0 under ``g<N>/`` could never
+        reach an absolute round index again and would livelock every
+        post-recovery fetch)."""
+        key = self._payload_key(round_idx)
+        self._store.set(key, bytes(payload))
+        self._store.add(key + "/pub", 1)
+        metrics.add("cgx.async.intra_published")
+
+    def fetch(self, round_idx: int) -> bytes:
+        """Non-leader side: the leader's round-``round_idx`` fold bytes.
+        Bounded intra-slice wait (poll the round's publish flag, then
+        get the key — which exists by publish-after-write); expiry
+        raises ``BridgeTimeoutError`` naming the wait, entering the
+        recovery ladder like any other expired bridge wait."""
+        from ..robustness.errors import BridgeTimeoutError
+
+        deadline = time.monotonic() + self._timeout_s
+        key = self._payload_key(round_idx)
+        while int(self._store.add(key + "/pub", 0)) < 1:
+            if time.monotonic() >= deadline:
+                raise BridgeTimeoutError(
+                    f"async intra broadcast: leader of slice "
+                    f"{self.slice_idx} never published outer round "
+                    f"{round_idx} within {self._timeout_s:g}s",
+                    key=key,
+                )
+            time.sleep(self._POLL_S)
+        buf = bytes(self._store.get(key))
+        metrics.add("cgx.async.intra_fetched")
+        # refcounted consume: the last non-leader deletes
+        if self.n_local <= 2:
+            self._delete(key)
+            self._delete(key + "/pub")
+        else:
+            try:
+                acks = int(self._store.add(key + "/ack", 1))
+            except Exception as e:
+                log.debug("async intra ack(%r) failed: %s", key, e)
+                return buf
+            if acks >= self.n_local - 1:
+                self._delete(key)
+                self._delete(key + "/ack")
+                self._delete(key + "/pub")
+        return buf
+
+    def _delete(self, key: str) -> None:
+        if self._store_can_delete is False:
+            return
+        try:
+            self._store.delete_key(key)
+            self._store_can_delete = True
+        except (NotImplementedError, AttributeError):
+            self._store_can_delete = False
+        except Exception as e:
+            self._store_can_delete = False
+            log.debug("async intra delete(%r) failed: %s", key, e)
+
+
+class LocalAsyncTransport:
+    """In-process stand-in for tests and the single-host chaos soak: the
+    same post/poll surface over a plain shared dict (thread-safe), with
+    an optional per-slice ``delay_s`` map modeling a slow DCN edge
+    (delivery delayed, post still instantaneous — the sender-thread
+    decoupling in miniature)."""
+
+    def __init__(self, n_slices: int, delay_s: Optional[Dict[int, float]] = None):
+        self.n_slices = int(n_slices)
+        self._lock = threading.Lock()
+        self._streams: Dict[int, List[Tuple[int, bytes, float]]] = {
+            s: [] for s in range(n_slices)
+        }
+        self._seen: Dict[Tuple[int, int], int] = {}
+        self._delay = dict(delay_s or {})
+
+    def bind(self, slice_idx: int) -> "LocalAsyncTransport._Endpoint":
+        return LocalAsyncTransport._Endpoint(self, slice_idx)
+
+    class _Endpoint:
+        def __init__(self, parent: "LocalAsyncTransport", slice_idx: int):
+            self._p = parent
+            self.slice_idx = int(slice_idx)
+
+        def post(self, round_idx: int, payload: bytes) -> None:
+            visible = time.monotonic() + self._p._delay.get(
+                self.slice_idx, 0.0
+            )
+            with self._p._lock:
+                self._p._streams[self.slice_idx].append(
+                    (int(round_idx), bytes(payload), visible)
+                )
+            metrics.add("cgx.async.posted")
+
+        def pending(self) -> int:
+            return 0
+
+        def poll(self) -> List[Tuple[int, int, bytes]]:
+            now = time.monotonic()
+            out: List[Tuple[int, int, bytes]] = []
+            with self._p._lock:
+                for peer in sorted(self._p._streams):
+                    if peer == self.slice_idx:
+                        continue
+                    stream = self._p._streams[peer]
+                    start = self._p._seen.get((self.slice_idx, peer), 0)
+                    taken = start
+                    for round_idx, payload, visible in stream[start:]:
+                        if visible > now:
+                            break  # delayed edge: later rounds still queued
+                        out.append((peer, round_idx, payload))
+                        taken += 1
+                    self._p._seen[(self.slice_idx, peer)] = taken
+            return out
+
+        def stop(self, timeout: float = 0.0) -> None:
+            del timeout
+
+    def set_delay(self, slice_idx: int, delay_s: float) -> None:
+        """Fault control for the chaos soak: future posts from
+        ``slice_idx`` become visible ``delay_s`` late."""
+        with self._lock:
+            self._delay[int(slice_idx)] = float(delay_s)

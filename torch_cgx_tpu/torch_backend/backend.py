@@ -496,6 +496,19 @@ def _host_topology(hosts: Sequence) -> str:
     return TOPO_MIXED
 
 
+def _slice_leaders(hosts: Sequence) -> List[int]:
+    """Group-local leader ranks, one per slice, first-seen host order —
+    the dependency-light duplicate of ``topology.slice_leaders`` (pinned
+    equal by tests/test_async_plane.py). Always fed the CURRENT host map
+    (after a reconfigure: the survivor-filtered one), so an evicted rank
+    can never be named leader."""
+    seen: dict = {}
+    for i, h in enumerate(hosts):
+        if h not in seen:
+            seen[h] = i
+    return list(seen.values())
+
+
 def _sra_fold_chunk(
     fused: np.ndarray,
     lo: int,
@@ -868,6 +881,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         self._host_by_rank: List[str] = []
         self._local_ranks: List[int] = [rank]
         self._all_local = False
+        # Async cross-slice plane (PR 13): the outer-exchange sender
+        # thread, created lazily by async_sender() and rebuilt per
+        # generation.
+        self._async_sender = None
         if size > 1:
             try:
                 self._init_shm()
@@ -1972,19 +1989,30 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 buf, segs, fused, dummy or intra_raw, add=True,
                 wire_dtype=wdt,
             )
-        hosts_seen = sorted(set(self._host_by_rank))
-        leaders = sorted(
-            min(
-                j for j in range(self._size) if self._host_by_rank[j] == h
-            )
-            for h in hosts_seen
-        )
-        if len(leaders) > 1:
+        leaders = _slice_leaders(self._host_by_rank)
+        if len(leaders) > 1 and not cfg.async_engaged():
+            if self._injector is not None:
+                # slow_rank@edge=dcn: the injected slow DCN link — on the
+                # SYNC path it sits right on the critical path (every
+                # rank stalls behind this leader's cross exchange); on
+                # the async path the same fault fires inside the sender
+                # thread instead (async_bridge._ship) and the step never
+                # feels it. That contrast is bench.py --async-dcn.
+                self._injector.delay_edge("slow_rank", "dcn")
             self._qreduce_flat(
                 fused, layers, f"{pfx}/hx", wdt, topo.cross_reduction,
                 ranks=leaders, local=False,
                 force_raw=not topo.cross_compress,
             )
+        elif len(leaders) > 1:
+            # CGX_ASYNC=on (group-global, env-only — every rank takes
+            # this branch together): the cross-slice stage leaves the
+            # critical path entirely. Slices reduce intra and diverge;
+            # the async plane reconciles them with compressed parameter
+            # deltas every CGX_ASYNC_H steps through the dedicated
+            # sender thread (outer_exchange_post/poll — PR 13). The
+            # train step never blocks on DCN.
+            metrics.add("cgx.async.cross_skipped")
         # Every leader requantizes + self-decodes (one fused pass), even one
         # with no local peers: non-leaders on OTHER hosts hold
         # decode(frame(stage-2)), so a leader keeping raw stage-2 values
@@ -2640,6 +2668,88 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def global_ranks(self) -> List[int]:
         return list(self._global_ranks)
 
+    # -- asynchronous cross-slice plane (PR 13) ---------------------------
+
+    @property
+    def host_map(self) -> List[str]:
+        """The per-rank host fingerprints of the CURRENT membership (the
+        survivor-filtered map after a reconfigure) — what the async
+        plane's ``Membership.from_hosts`` re-derives slice leaders
+        from."""
+        return list(self._host_by_rank)
+
+    def async_slice_info(self):
+        """(slice_idx, n_slices, leaders, leader_globals, generation)
+        for the async plane, derived from the CURRENT host map — never a
+        cached classification (the evicted-leader regression class)."""
+        hosts = self._host_by_rank or [""] * self._size
+        leaders = _slice_leaders(hosts)
+        # slice index = position of my host's leader (leaders are in
+        # first-seen host order, the slice-id order by construction)
+        my_slice = [hosts[r] for r in leaders].index(hosts[self._rank])
+        leader_globals = [self._global_ranks[r] for r in leaders]
+        return my_slice, len(leaders), leaders, leader_globals, self._generation
+
+    def async_sender(self):
+        """The group's outer-exchange transport — one dedicated sender
+        thread, created lazily and rebuilt whenever the generation moves
+        (a pre-recovery stream's keys describe a dead membership; the
+        new sender namespaces under ``g<N>/``)."""
+        from . import async_bridge
+
+        snd = self._async_sender
+        if snd is None or snd.generation != self._generation:
+            if snd is not None:
+                snd.stop()
+            my_slice, n_slices, _leaders, _lg, gen = self.async_slice_info()
+            # One consumer per peer slice: only LEADERS poll the DCN
+            # streams (non-leaders apply the leader's fold through the
+            # intra broadcast — parallel/async_plane.py's two-level
+            # outer scheme), so each slice's stream has n_slices - 1
+            # readers.
+            readers = {
+                s: max(1, n_slices - 1) for s in range(max(1, n_slices))
+            }
+            snd = async_bridge.AsyncBridgeSender(
+                self._store, my_slice, max(1, n_slices),
+                ns=self._ns, injector=self._injector, generation=gen,
+                readers_by_slice=readers,
+            )
+            self._async_sender = snd
+        return snd
+
+    def async_intra(self):
+        """The intra-slice agreement channel for the outer fold
+        (``async_bridge.IntraBroadcast``): the slice leader publishes
+        its boundary fold bytes, non-leaders apply exactly those — an
+        intra-slice (fast-tier) wait, bounded by the group timeout.
+        Rebuilt per generation like the sender. None when this rank has
+        no same-slice peers (one-process-per-host layouts): publishing
+        full-parameter updates no follower ever consumes — or deletes —
+        would leak one store key per outer round for the life of the
+        run."""
+        if len(self._local_ranks) <= 1:
+            return None
+        from . import async_bridge
+
+        my_slice, _n, _leaders, _lg, gen = self.async_slice_info()
+        return async_bridge.IntraBroadcast(
+            self._store, my_slice,
+            n_local=len(self._local_ranks),
+            ns=self._ns, timeout_s=self._timeout_s, generation=gen,
+        )
+
+    def outer_exchange_post(self, round_idx: int, payload: bytes) -> None:
+        """Non-blocking outer-exchange op: enqueue one outer round's
+        compressed delta for the sender thread. Never touches the worker
+        FIFO and never blocks — the PR 13 contract."""
+        self.async_sender().post(round_idx, payload)
+
+    def outer_exchange_poll(self):
+        """Non-blocking outer-exchange op: every peer slice's
+        newly-published (peer_slice, round, payload) rounds."""
+        return self.async_sender().poll()
+
     def degrade_to_store(self) -> None:
         """Recovery ladder rung 2: close the shm byte plane and carry all
         payloads over the store. Must be applied group-wide (the
@@ -2753,6 +2863,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._p2p_recv.clear()
             self._p2p_ann.clear()
             self._p2p_ann_used.clear()
+        # The outer-exchange sender describes the dead generation's
+        # membership/keys: stop it; async_sender() rebuilds at g<N>.
+        if self._async_sender is not None:
+            self._async_sender.stop()
+            self._async_sender = None
         if self._shm is not None:
             if len(self._local_ranks) > 1:
                 self._shm.bump_epoch(generation)
@@ -2795,6 +2910,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
     def shutdown(self) -> None:
         self._shutdown.set()
         self._p2p_pool.shutdown(wait=False)
+        if self._async_sender is not None:
+            self._async_sender.stop()
+            self._async_sender = None
         # Observability flush: black-box dump + final metrics export + the
         # leader-side cross-rank merge over the store. Gated on
         # CGX_METRICS_DIR and leashed like the announce GC below — the

@@ -34,6 +34,7 @@ from .edges import (
     EDGE_POWERSGD_FACTOR,
     EDGE_PP_ACT,
     EDGE_RING_KV,
+    EDGE_XSLICE_DELTA,
     EdgeConfig,
     clear_edges,
     resolve_edge,
@@ -55,6 +56,7 @@ __all__ = [
     "EDGE_POWERSGD_FACTOR",
     "EDGE_PP_ACT",
     "EDGE_RING_KV",
+    "EDGE_XSLICE_DELTA",
     "EdgeConfig",
     "clear_edges",
     "resolve_edge",

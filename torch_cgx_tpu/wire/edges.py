@@ -23,6 +23,10 @@ kind                 traffic
                      ``ppermute`` hops, Ulysses reshard ``all_to_all``
 ``pp_act``           pipeline activation/cotangent ``ppermute`` hops
 ``powersgd_factor``  PowerSGD P/Q factor reductions
+``xslice_delta``     asynchronous cross-slice parameter deltas: the
+                     local-SGD outer loop's DCN payload
+                     (``parallel/async_plane.py``), shipped by the
+                     dedicated sender thread with per-edge error feedback
 ===================  ====================================================
 
 Resolution order for a non-``dp_grad`` edge ``(kind, name)``:
@@ -53,6 +57,7 @@ EDGE_MOE_A2A = "moe_a2a"
 EDGE_RING_KV = "ring_kv"
 EDGE_PP_ACT = "pp_act"
 EDGE_POWERSGD_FACTOR = "powersgd_factor"
+EDGE_XSLICE_DELTA = "xslice_delta"
 
 EDGE_KINDS = (
     EDGE_DP_GRAD,
@@ -60,6 +65,7 @@ EDGE_KINDS = (
     EDGE_RING_KV,
     EDGE_PP_ACT,
     EDGE_POWERSGD_FACTOR,
+    EDGE_XSLICE_DELTA,
 )
 
 # Peer compressors the dispatcher can put behind an edge (max-min
