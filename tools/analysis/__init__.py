@@ -1,0 +1,121 @@
+"""Whole-program static analyzer for the torch_cgx_tpu package.
+
+Grown out of ``tools/lint.py``'s 11 per-file AST rules (ISSUE 14): the
+per-file rules now live in :mod:`.perfile` behind a ``RULES`` registry,
+and three cross-module passes see the entire package as one symbol
+graph (:mod:`.graph`):
+
+* :mod:`.knobs` — knob→cache-key completeness over the five
+  staged-program caches (``knob-key`` / ``stale-allowlist``);
+* :mod:`.caches` — the invalidation-cascade proof: every module-level
+  mutable registry/memo/LRU must be reachable from
+  ``supervisor.invalidate_trace_caches`` or ``config.reset_registries``
+  (``orphan-memo``);
+* :mod:`.locks` — lock-order cycles, blocking calls under a lock, and
+  cross-thread unlocked writes (``lock-order`` / ``lock-blocking`` /
+  ``thread-shared-write``).
+
+Run ``python -m tools.analysis`` (add ``--json`` for the machine
+surface ``tools/cgx_report.py`` embeds); ``python tools/lint.py`` stays
+the compatible legacy entry point. Rule catalogue, cache-surface table
+and the pragma grammar: docs/ANALYSIS.md.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence
+
+from . import caches, knobs, locks
+from .graph import Project, get_source
+from .report import Finding
+
+WHOLE_PROGRAM_PASSES = (
+    "knob-key", "stale-allowlist", "orphan-memo",
+    "lock-order", "lock-blocking", "thread-shared-write",
+    "pragma-format",
+)
+
+
+def check_pragma_format(proj: Project) -> List[Finding]:
+    """A line that mentions ``cgx-analysis`` but does not parse as
+    ``# cgx-analysis: allow(<rule>) — <reason>`` is a malformed
+    suppression: it LOOKS like an exemption while suppressing nothing."""
+    out: List[Finding] = []
+    for mod in proj.modules.values():
+        for line in mod.source.malformed_pragmas:
+            out.append(Finding(
+                path=str(mod.path), line=line, rule="pragma-format",
+                message=(
+                    "[pragma-format] malformed cgx-analysis pragma — the "
+                    "grammar is `# cgx-analysis: allow(<rule>) — "
+                    "<reason>` (reason mandatory; docs/ANALYSIS.md)"
+                ),
+            ))
+    return out
+
+
+def run_project(
+    pkg_root: Path,
+    pkg_name: Optional[str] = None,
+    passes: Optional[Sequence[str]] = None,
+) -> List[Finding]:
+    """The whole-program passes over one package root."""
+    proj = Project(Path(pkg_root), pkg_name)
+    findings: List[Finding] = []
+    for src in proj.broken:
+        # src.error is "<lineno>: <msg>" — split it so the rendered line
+        # keeps the `path:line: message` contract the legacy surface
+        # (and editors) parse.
+        lineno_s, _, msg = (src.error or "1: unparseable").partition(":")
+        try:
+            lineno = int(lineno_s)
+        except ValueError:
+            lineno, msg = 1, src.error
+        findings.append(Finding(
+            path=str(src.path), line=lineno, rule="syntax",
+            message=f"{msg.strip()} (file skipped by whole-program passes)",
+        ))
+    want = set(passes) if passes is not None else None
+
+    def on(*rules: str) -> bool:
+        return want is None or bool(want & set(rules))
+
+    if on("knob-key", "stale-allowlist"):
+        findings.extend(knobs.check(proj))
+    if on("orphan-memo"):
+        findings.extend(caches.check(proj))
+    if on("lock-order", "lock-blocking", "thread-shared-write"):
+        findings.extend(locks.check(proj))
+    if on("pragma-format"):
+        findings.extend(check_pragma_format(proj))
+    if want is not None:
+        findings = [f for f in findings if f.rule in want or f.rule == "syntax"]
+    return findings
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parent.parent.parent
+
+
+def run_repo(passes: Optional[Sequence[str]] = None) -> List[Finding]:
+    """The whole-program passes over the repo's library package."""
+    return run_project(repo_root() / "torch_cgx_tpu", passes=passes)
+
+
+def analyzer_status() -> Dict:
+    """Machine-readable analyzer summary (``cgx_report`` embeds this)."""
+    import time
+
+    from .report import summary_dict
+
+    t0 = time.monotonic()
+    findings = run_repo()
+    return summary_dict(
+        findings,
+        files_checked=sum(
+            1 for _ in (repo_root() / "torch_cgx_tpu").rglob("*.py")
+        ),
+        passes=list(WHOLE_PROGRAM_PASSES),
+        elapsed_s=time.monotonic() - t0,
+    )

@@ -1,0 +1,85 @@
+"""CLI for the whole-program analyzer.
+
+    python -m tools.analysis                 # whole-program passes, repo pkg
+    python -m tools.analysis --json          # machine-readable (cgx_report)
+    python -m tools.analysis --only knob-key # run a subset of passes
+    python -m tools.analysis --pkg PATH      # analyze another package root
+
+Exit 0 = clean, 1 = findings (the lint.py convention). The per-file
+rules keep their legacy entry point (``python tools/lint.py``), which
+also runs these passes when invoked with no explicit paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+from typing import List, Optional
+
+from . import WHOLE_PROGRAM_PASSES, repo_root, run_project
+from .report import render_json, render_text
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument(
+        "--pkg", default=None,
+        help="package root to analyze (default: the repo's torch_cgx_tpu)",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable findings (cgx_report embeds this)")
+    ap.add_argument(
+        "--only", action="append", default=None, metavar="PASS",
+        help=f"run only these passes (of: {', '.join(WHOLE_PROGRAM_PASSES)})",
+    )
+    ap.add_argument(
+        "--skip", action="append", default=None, metavar="PASS",
+        help="skip these passes",
+    )
+    args = ap.parse_args(argv)
+
+    known = set(WHOLE_PROGRAM_PASSES)
+    for sel in (args.only or []) + (args.skip or []):
+        if sel not in known:
+            ap.error(
+                f"unknown pass {sel!r}; known: {', '.join(WHOLE_PROGRAM_PASSES)}"
+            )
+    passes = list(WHOLE_PROGRAM_PASSES)
+    if args.only:
+        passes = [p for p in passes if p in args.only]
+    if args.skip:
+        passes = [p for p in passes if p not in args.skip]
+
+    pkg = Path(args.pkg) if args.pkg else repo_root() / "torch_cgx_tpu"
+    t0 = time.monotonic()
+    findings = run_project(pkg, passes=passes)
+    elapsed = time.monotonic() - t0
+    n_files = sum(
+        1 for p in pkg.rglob("*.py") if "__pycache__" not in p.parts
+    )
+    if args.json:
+        print(render_json(findings, files_checked=n_files, passes=passes,
+                          elapsed_s=elapsed))
+        return 1 if findings else 0
+    if findings:
+        print(render_text(findings))
+        print(
+            f"analysis: {len(findings)} finding(s) across "
+            f"{n_files} files ({elapsed:.1f}s)",
+            file=sys.stderr,
+        )
+        return 1
+    print(
+        f"analysis: {n_files} files clean "
+        f"({len(passes)} whole-program passes, {elapsed:.1f}s)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,230 @@
+"""Invalidation-cascade pass (rule id: ``orphan-memo``).
+
+Mechanizes the repo's most-repeated bug class: module-level mutable
+state (a registry, memo, LRU, or ``functools.lru_cache``) that the
+recovery supervisor's ``invalidate_trace_caches`` / the config plane's
+``reset_registries`` can NOT reach. Every recovery reconfiguration must
+cycle every derived cache — PR 6's stale qerr cadence, PR 10's stale
+controller cadence and PR 13's stale slice-leader memo were each exactly
+an unreached memo, found by a failing chaos run instead of a tool.
+
+Discovery: a module-level container (dict/list/set/OrderedDict/WeakSet/
+defaultdict/… literal or constructor) that some function *grows*
+(subscript store, ``.add``/``.append``/``.setdefault``/…) — a
+module-level lookup table that is never written after import is a
+constant, not a registry, and is skipped. ``functools.lru_cache``-
+decorated functions count as registries too (their ``.cache_clear``).
+
+Proof: the registry is *reached* iff some function reachable from an
+invalidation root performs a reset-shaped mutation on it (``.clear()``,
+``.pop``/``.popitem``, ``.update``, ``del``, whole-name reassignment,
+``.cache_clear()``) — directly or through a module alias / the
+``sys.modules`` lazy-cascade idiom. Functions registered through a
+``register_reset_hook(fn)``-style call are roots as well (the wire
+plane's hook indirection is statically opaque otherwise).
+
+Deliberate exceptions carry ``# cgx-analysis: allow(orphan-memo) — why``
+on (or above) the registry's definition line.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .graph import (
+    GROW_METHODS,
+    RESET_METHODS,
+    FuncKey,
+    ModuleInfo,
+    Project,
+    _walk_function_body,
+)
+from .report import Finding
+
+RULE = "orphan-memo"
+
+# (module-suffix, function) pairs whose reachable closure must cover
+# every live registry. Matched against the end of the dotted module
+# name so the package prefix stays configurable for fixtures.
+DEFAULT_ROOTS: Tuple[Tuple[str, str], ...] = (
+    ("robustness.supervisor", "invalidate_trace_caches"),
+    ("config", "reset_registries"),
+)
+
+# Call names that register an opaque reset callable; their first
+# argument joins the root set.
+HOOK_REGISTRARS = {"register_reset_hook"}
+
+GlobalKey = Tuple[str, str]  # (module, global name)
+
+
+def _mutations_of(
+    proj: Project, mod: ModuleInfo, fi
+) -> Dict[GlobalKey, Set[str]]:
+    """Module-level names this function mutates -> {"grow","reset"} kinds.
+    Resolves both own-module globals and cross-module ``alias.NAME``
+    access (including the sys.modules idiom)."""
+    out: Dict[GlobalKey, Set[str]] = {}
+    sysmods = proj._sys_modules_vars(mod, fi.node)
+    # Names this function declares `global`: only those bare-name
+    # rebinds touch module state — a same-named local would otherwise
+    # falsely "prove" the cascade reaches the registry (the unsound
+    # direction; caught by review).
+    declared_global: Set[str] = set()
+    for n in _walk_function_body(fi.node):
+        if isinstance(n, ast.Global):
+            declared_global.update(n.names)
+
+    def global_of(
+        expr: ast.AST, need_global_decl: bool = False
+    ) -> Optional[GlobalKey]:
+        if isinstance(expr, ast.Name):
+            if need_global_decl and expr.id not in declared_global:
+                return None
+            if expr.id in mod.mutables:
+                return (mod.name, expr.id)
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(
+            expr.value, ast.Name
+        ):
+            base = expr.value.id
+            tmod = sysmods.get(base) or proj.resolve_module_alias(mod, base)
+            if tmod and expr.attr in proj.modules[tmod].mutables:
+                return (tmod, expr.attr)
+        return None
+
+    def note(key: Optional[GlobalKey], kind: str) -> None:
+        if key is not None:
+            out.setdefault(key, set()).add(kind)
+
+    for node in _walk_function_body(fi.node):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            meth = node.func.attr
+            if meth in GROW_METHODS | RESET_METHODS:
+                key = global_of(node.func.value)
+                if meth in RESET_METHODS:
+                    # .update(...) both grows and resets; classify by
+                    # whether it zeroes (keyword-only constants) — too
+                    # fine; count it as both and let reach win.
+                    note(key, "reset")
+                if meth in GROW_METHODS:
+                    note(key, "grow")
+            elif meth == "cache_clear":
+                key = global_of(node.func.value)
+                note(key, "reset")
+        elif isinstance(node, ast.Subscript):
+            if isinstance(node.ctx, ast.Store):
+                note(global_of(node.value), "grow")
+            elif isinstance(node.ctx, ast.Del):
+                note(global_of(node.value), "reset")
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    # whole reassignment — module state only under an
+                    # explicit `global` declaration
+                    note(global_of(t, need_global_decl=True), "reset")
+        elif isinstance(node, ast.AugAssign):
+            if isinstance(node.target, ast.Subscript):
+                note(global_of(node.target.value), "grow")
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    note(global_of(t.value), "reset")
+    return out
+
+
+def _resolve_roots(
+    proj: Project, roots: Sequence[Tuple[str, str]]
+) -> List[FuncKey]:
+    out: List[FuncKey] = []
+    for suffix, fn in roots:
+        for mname, mod in proj.modules.items():
+            if mname == suffix or mname.endswith("." + suffix):
+                qual = mod.func_by_name.get(fn)
+                if qual:
+                    out.append((mname, qual))
+    return out
+
+
+def _hook_roots(proj: Project) -> List[FuncKey]:
+    """Functions passed to a reset-hook registrar anywhere in the
+    package — at module import time OR inside a function. The package's
+    own registration idiom is module-level
+    (``edges.register_reset_hook(_reset_all)`` in ``wire/controller.py``
+    runs at import), so the scan walks the whole module tree; resolving
+    a call that also sits inside a function twice is harmless (the root
+    set is a union)."""
+    import types
+
+    out: List[FuncKey] = []
+    for mname, mod in proj.modules.items():
+        pseudo = types.SimpleNamespace(cls=None, qual="<module>")
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            if callee in HOOK_REGISTRARS and node.args:
+                ref = proj._resolve_ref(mod, pseudo, node.args[0], {})
+                if ref:
+                    out.append(ref)
+    return out
+
+
+def check(
+    proj: Project,
+    roots: Optional[Sequence[Tuple[str, str]]] = None,
+) -> List[Finding]:
+    if roots is None:
+        roots = DEFAULT_ROOTS
+    root_keys = _resolve_roots(proj, roots) + _hook_roots(proj)
+    reachable = proj.reachable_from(root_keys)
+
+    # All mutations, per function.
+    grown: Set[GlobalKey] = set()
+    reset_by: Dict[GlobalKey, Set[FuncKey]] = {}
+    for mname, mod in proj.modules.items():
+        for qual, fi in mod.funcs.items():
+            for key, kinds in _mutations_of(proj, mod, fi).items():
+                if "grow" in kinds:
+                    grown.add(key)
+                if "reset" in kinds:
+                    reset_by.setdefault(key, set()).add((mname, qual))
+
+    findings: List[Finding] = []
+    for mname, mod in sorted(proj.modules.items()):
+        for name, mg in sorted(mod.mutables.items()):
+            key = (mname, name)
+            if mg.kind == "container" and key not in grown:
+                continue  # constant lookup table, not a registry
+            reached = any(f in reachable for f in reset_by.get(key, ()))
+            if reached:
+                continue
+            pragma = proj.suppressed(mod.path, mg.lineno, RULE)
+            if pragma:
+                continue
+            rootnames = ", ".join(
+                f"{m.rsplit('.', 1)[-1]}.{q}" for m, q in root_keys[:2]
+            ) or "the invalidation roots"
+            findings.append(Finding(
+                path=str(mod.path),
+                line=mg.lineno,
+                rule=RULE,
+                message=(
+                    f"[orphan-memo] module-level mutable state "
+                    f"{name!r} is grown at runtime but no reset of it is "
+                    f"reachable from {rootnames} — after a recovery "
+                    "reconfiguration it would keep serving the dead "
+                    "generation's entries (the PR 6/10/13 bug class); "
+                    "wire it into the invalidation cascade or annotate "
+                    "`# cgx-analysis: allow(orphan-memo) — <why>`"
+                ),
+            ))
+    return findings

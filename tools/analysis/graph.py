@@ -1,0 +1,554 @@
+"""Whole-program symbol graph over a Python package (stdlib-only).
+
+The foundation the three cross-module passes (knobs/caches/locks) share:
+
+* a **parse cache** — one ``ast.parse`` per file per mtime, shared by
+  every rule including the legacy per-file set (``tools/lint.py`` used
+  to re-parse the STAGED_PURE manifest and the timeline BRIDGE_OPS list
+  once per checked file);
+* per-module **symbol tables** — functions (nested ones included, under
+  dotted qualnames), classes, import aliases, module-level string
+  constants, and module-level mutable containers;
+* a **reference graph** between functions: resolved calls, bare-name
+  references (``body = _step``, ``target=self._loop``,
+  ``register_reset_hook(fn)``), ``self.method`` dispatch, and the
+  repo's lazy ``sys.modules[...]`` / ``sys.modules.get(...)``
+  indirection (the recovery supervisor's import-cycle-free cascade);
+* **pragma** parsing — ``# cgx-analysis: allow(<rule>) — <reason>``
+  suppressions whose format the analyzer itself enforces.
+
+Deliberately conservative, like the per-file linter: resolution that
+cannot be decided statically is dropped (an unresolved call creates no
+edge), so reachability-style passes over-report rather than silently
+under-report, and taint-style passes compute over the edges that ARE
+certain.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Pragmas.
+# ---------------------------------------------------------------------------
+
+# `# cgx-analysis: allow(<rule>) — <reason>`; the em-dash may be written
+# as `--` in ascii-only files. The reason is mandatory — an unexplained
+# suppression is itself a finding (pragma-format).
+PRAGMA_RE = re.compile(
+    r"#\s*cgx-analysis:\s*allow\(([a-z0-9_-]+)\)\s*(?:—|--)\s*(\S.*)$"
+)
+PRAGMA_MARKER = "cgx-analysis"
+
+
+@dataclasses.dataclass(frozen=True)
+class Pragma:
+    rule: str
+    reason: str
+    line: int
+
+
+# ---------------------------------------------------------------------------
+# Parse cache.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: Path
+    text: str
+    tree: Optional[ast.Module]  # None on syntax error
+    error: Optional[str]  # "lineno: msg" when tree is None
+    pragmas: Dict[int, List[Pragma]]  # line -> pragmas on that line
+    malformed_pragmas: List[int]  # lines with a cgx-analysis marker that
+    # does not parse as a pragma
+
+
+_PARSE_CACHE: Dict[str, Tuple[Tuple[int, int], SourceFile]] = {}
+
+
+def _scan_pragmas(text: str) -> Tuple[Dict[int, List[Pragma]], List[int]]:
+    pragmas: Dict[int, List[Pragma]] = {}
+    malformed: List[int] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        if PRAGMA_MARKER not in line:
+            continue
+        m = PRAGMA_RE.search(line)
+        if m:
+            pragmas.setdefault(i, []).append(
+                Pragma(rule=m.group(1), reason=m.group(2).strip(), line=i)
+            )
+        else:
+            malformed.append(i)
+    return pragmas, malformed
+
+
+def get_source(path: Path) -> SourceFile:
+    """The parsed file, cached per (mtime_ns, size). A missing or
+    syntactically-broken file comes back with ``tree=None`` and the error
+    recorded — callers keep checking every OTHER file."""
+    path = Path(path)
+    key = str(path)
+    try:
+        st = path.stat()
+        stamp = (st.st_mtime_ns, st.st_size)
+    except OSError:
+        stamp = (-1, -1)
+    hit = _PARSE_CACHE.get(key)
+    if hit is not None and hit[0] == stamp:
+        return hit[1]
+    try:
+        text = path.read_text()
+    except OSError as e:
+        sf = SourceFile(path, "", None, f"1: unreadable: {e}", {}, [])
+        _PARSE_CACHE[key] = (stamp, sf)
+        return sf
+    pragmas, malformed = _scan_pragmas(text)
+    try:
+        tree = ast.parse(text, filename=str(path))
+        err = None
+    except SyntaxError as e:
+        tree, err = None, f"{e.lineno}: syntax error: {e.msg}"
+    sf = SourceFile(path, text, tree, err, pragmas, malformed)
+    _PARSE_CACHE[key] = (stamp, sf)
+    return sf
+
+
+def clear_parse_cache() -> None:
+    _PARSE_CACHE.clear()
+
+
+# ---------------------------------------------------------------------------
+# Per-module model.
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CALLS = {
+    "dict", "list", "set", "OrderedDict", "defaultdict", "deque",
+    "Counter", "WeakSet", "WeakValueDictionary", "WeakKeyDictionary",
+}
+# Mutations that GROW state (identify a live registry) vs mutations that
+# RESET it (prove invalidation reach). ``update`` counts on both sides:
+# zeroing via ``.update(hits=0)`` is the stats-reset idiom, and growing
+# via ``.update(other)`` the merge idiom.
+GROW_METHODS = {"add", "append", "setdefault", "extend", "insert", "update"}
+RESET_METHODS = {"clear", "pop", "popitem", "update", "cache_clear"}
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    qual: str  # dotted qualname within the module ("Cls.meth", "outer.inner")
+    name: str  # bare name
+    node: ast.AST
+    cls: Optional[str]  # enclosing class name, if a method
+    lineno: int
+
+
+@dataclasses.dataclass
+class MutableGlobal:
+    name: str
+    lineno: int
+    kind: str  # "container" | "lru_cache"
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str  # dotted module name
+    path: Path
+    source: SourceFile
+    funcs: Dict[str, FuncInfo] = dataclasses.field(default_factory=dict)
+    func_by_name: Dict[str, str] = dataclasses.field(default_factory=dict)
+    classes: Dict[str, List[str]] = dataclasses.field(default_factory=dict)
+    # alias -> dotted module name (covers module- and function-level
+    # imports; later imports win, which matches runtime for the repo's
+    # one-alias-one-module convention)
+    import_aliases: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # alias -> (module, symbol) for `from m import f [as g]`
+    symbol_imports: Dict[str, Tuple[str, str]] = dataclasses.field(
+        default_factory=dict
+    )
+    constants: Dict[str, str] = dataclasses.field(default_factory=dict)
+    mutables: Dict[str, MutableGlobal] = dataclasses.field(
+        default_factory=dict
+    )
+
+    @property
+    def tree(self) -> Optional[ast.Module]:
+        return self.source.tree
+
+
+def _module_name_for(path: Path, pkg_root: Path, pkg_name: str) -> str:
+    rel = path.relative_to(pkg_root).with_suffix("")
+    parts = [pkg_name] + list(rel.parts)
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _resolve_relative(base_module: str, level: int, target: Optional[str],
+                      is_pkg_init: bool) -> Optional[str]:
+    """Dotted absolute module for a `from ...X import Y` statement found
+    inside ``base_module``."""
+    if level == 0:
+        return target
+    parts = base_module.split(".")
+    # Inside a package __init__, level 1 refers to the package itself.
+    anchor = parts if is_pkg_init else parts[:-1]
+    drop = level - 1
+    if drop > len(anchor):
+        return None
+    anchor = anchor[: len(anchor) - drop] if drop else anchor
+    if not anchor:
+        return None
+    return ".".join(anchor + ([target] if target else []))
+
+
+def _collect_imports(mod: ModuleInfo, is_pkg_init: bool) -> None:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                alias = a.asname or a.name.split(".")[0]
+                mod.import_aliases[alias] = (
+                    a.name if a.asname else a.name.split(".")[0]
+                )
+        elif isinstance(node, ast.ImportFrom):
+            src = _resolve_relative(
+                mod.name, node.level, node.module, is_pkg_init
+            )
+            if src is None:
+                continue
+            for a in node.names:
+                if a.name == "*":
+                    continue
+                alias = a.asname or a.name
+                # `from pkg import submodule` vs `from mod import func`
+                # is undecidable without the target on disk; record BOTH
+                # and let the project resolve (module alias wins if the
+                # dotted name is a known module).
+                mod.import_aliases.setdefault(alias, f"{src}.{a.name}")
+                mod.symbol_imports[alias] = (src, a.name)
+
+
+def _collect_functions(mod: ModuleInfo) -> None:
+    def visit(node: ast.AST, prefix: str, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}{child.name}"
+                info = FuncInfo(
+                    qual=qual, name=child.name, node=child, cls=cls,
+                    lineno=child.lineno,
+                )
+                mod.funcs[qual] = info
+                mod.func_by_name[child.name] = qual
+                if cls is not None:
+                    mod.classes.setdefault(cls, []).append(qual)
+                visit(child, f"{qual}.", cls)
+            elif isinstance(child, ast.ClassDef):
+                mod.classes.setdefault(child.name, [])
+                visit(child, f"{prefix}{child.name}.", child.name)
+            else:
+                visit(child, prefix, cls)
+
+    visit(mod.tree, "", None)
+
+
+def _collect_module_scope(mod: ModuleInfo) -> None:
+    for node in mod.tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = (
+                node.targets if isinstance(node, ast.Assign)
+                else [node.target]
+            )
+            value = node.value
+            if value is None:
+                continue
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                if isinstance(value, ast.Constant) and isinstance(
+                    value.value, str
+                ):
+                    mod.constants[t.id] = value.value
+                elif isinstance(value, (ast.Dict, ast.List, ast.Set)):
+                    mod.mutables[t.id] = MutableGlobal(
+                        t.id, node.lineno, "container"
+                    )
+                elif isinstance(value, ast.Call):
+                    fn = value.func
+                    callee = (
+                        fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else ""
+                    )
+                    if callee in _MUTABLE_CALLS:
+                        mod.mutables[t.id] = MutableGlobal(
+                            t.id, node.lineno, "container"
+                        )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                target = dec.func if isinstance(dec, ast.Call) else dec
+                name = (
+                    target.attr if isinstance(target, ast.Attribute)
+                    else target.id if isinstance(target, ast.Name) else ""
+                )
+                if name in ("lru_cache", "cache"):
+                    mod.mutables[node.name] = MutableGlobal(
+                        node.name, node.lineno, "lru_cache"
+                    )
+
+
+# ---------------------------------------------------------------------------
+# The project: all modules + the cross-module reference graph.
+# ---------------------------------------------------------------------------
+
+FuncKey = Tuple[str, str]  # (module name, function qualname)
+
+
+def _walk_function_body(fn_node: ast.AST):
+    """Yield nodes of a function body WITHOUT descending into nested
+    function/class definitions (those are separate FuncInfos; a bare-name
+    reference to them creates the edge)."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+class Project:
+    """The whole-package symbol graph."""
+
+    def __init__(self, pkg_root: Path, pkg_name: Optional[str] = None):
+        self.pkg_root = Path(pkg_root)
+        self.pkg_name = pkg_name or self.pkg_root.name
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.broken: List[SourceFile] = []  # syntax errors, reported once
+        self._load()
+        self._refs: Optional[Dict[FuncKey, Set[FuncKey]]] = None
+
+    # -- loading ----------------------------------------------------------
+
+    def _load(self) -> None:
+        for path in sorted(self.pkg_root.rglob("*.py")):
+            if "__pycache__" in path.parts:
+                continue
+            src = get_source(path)
+            name = _module_name_for(path, self.pkg_root, self.pkg_name)
+            if src.tree is None:
+                self.broken.append(src)
+                continue
+            mod = ModuleInfo(name=name, path=path, source=src)
+            _collect_imports(mod, is_pkg_init=path.name == "__init__.py")
+            _collect_functions(mod)
+            _collect_module_scope(mod)
+            self.modules[name] = mod
+
+    # -- alias/module resolution ------------------------------------------
+
+    def resolve_module_alias(self, mod: ModuleInfo, alias: str) -> Optional[str]:
+        """The project module an alias refers to, if any."""
+        target = mod.import_aliases.get(alias)
+        if target in self.modules:
+            return target
+        sym = mod.symbol_imports.get(alias)
+        if sym:
+            dotted = f"{sym[0]}.{sym[1]}"
+            if dotted in self.modules:
+                return dotted
+        return None
+
+    def _sys_modules_vars(self, mod: ModuleInfo, fn_node: ast.AST) -> Dict[str, str]:
+        """Local vars bound from ``sys.modules[...]`` / ``.get(...)`` with a
+        literal module-name key — the supervisor's lazy-cascade idiom."""
+        out: Dict[str, str] = {}
+
+        def modname_of(expr: ast.AST) -> Optional[str]:
+            # sys.modules["m"]  |  sys.modules.get("m")
+            if isinstance(expr, ast.Subscript):
+                base = expr.value
+                key = expr.slice
+                if (
+                    isinstance(base, ast.Attribute)
+                    and base.attr == "modules"
+                    and isinstance(base.value, ast.Name)
+                    and self._is_sys_alias(mod, base.value.id)
+                    and isinstance(key, ast.Constant)
+                    and isinstance(key.value, str)
+                ):
+                    return key.value
+            if isinstance(expr, ast.Call):
+                fn = expr.func
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "get"
+                    and isinstance(fn.value, ast.Attribute)
+                    and fn.value.attr == "modules"
+                    and isinstance(fn.value.value, ast.Name)
+                    and self._is_sys_alias(mod, fn.value.value.id)
+                    and expr.args
+                    and isinstance(expr.args[0], ast.Constant)
+                    and isinstance(expr.args[0].value, str)
+                ):
+                    return expr.args[0].value
+            return None
+
+        for node in _walk_function_body(fn_node):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                t = node.targets[0]
+                if isinstance(t, ast.Name):
+                    m = modname_of(node.value)
+                    if m and m in self.modules:
+                        out[t.id] = m
+        return out
+
+    def _is_sys_alias(self, mod: ModuleInfo, name: str) -> bool:
+        return name == "sys" or mod.import_aliases.get(name) == "sys"
+
+    # -- the reference graph ----------------------------------------------
+
+    def refs(self) -> Dict[FuncKey, Set[FuncKey]]:
+        """function -> set of functions it references (calls, bare-name
+        mentions, self-dispatch, sys.modules indirection). Computed once."""
+        if self._refs is not None:
+            return self._refs
+        graph: Dict[FuncKey, Set[FuncKey]] = {}
+        for mname, mod in self.modules.items():
+            for qual, fi in mod.funcs.items():
+                graph[(mname, qual)] = self._refs_of(mod, fi)
+        self._refs = graph
+        return graph
+
+    def _resolve_ref(
+        self, mod: ModuleInfo, fi: FuncInfo, expr: ast.AST,
+        sysmods: Dict[str, str],
+    ) -> Optional[FuncKey]:
+        if isinstance(expr, ast.Name):
+            name = expr.id
+            # enclosing-scope nested function ("outer.inner" for a bare
+            # `inner` mention inside outer's other nested fn) — the
+            # bare-name table already maps last-defined wins, which is
+            # what the repo's closure factories need.
+            target = mod.func_by_name.get(name)
+            if target is not None:
+                return (mod.name, target)
+            sym = mod.symbol_imports.get(name)
+            if sym and sym[0] in self.modules:
+                smod = self.modules[sym[0]]
+                if sym[1] in smod.func_by_name:
+                    return (sym[0], smod.func_by_name[sym[1]])
+            return None
+        if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+            base, attr = expr.value.id, expr.attr
+            if base == "self" and fi.cls is not None:
+                qual = f"{fi.cls}.{attr}"
+                if qual in mod.funcs:
+                    return (mod.name, qual)
+                return None
+            tmod = sysmods.get(base) or self.resolve_module_alias(mod, base)
+            if tmod:
+                t = self.modules[tmod]
+                if attr in t.func_by_name:
+                    return (tmod, t.func_by_name[attr])
+            return None
+        # sys.modules["m"].f(...) inline
+        if (
+            isinstance(expr, ast.Attribute)
+            and isinstance(expr.value, ast.Subscript)
+        ):
+            sub = expr.value
+            if (
+                isinstance(sub.value, ast.Attribute)
+                and sub.value.attr == "modules"
+                and isinstance(sub.value.value, ast.Name)
+                and self._is_sys_alias(mod, sub.value.value.id)
+                and isinstance(sub.slice, ast.Constant)
+                and isinstance(sub.slice.value, str)
+                and sub.slice.value in self.modules
+            ):
+                t = self.modules[sub.slice.value]
+                if expr.attr in t.func_by_name:
+                    return (sub.slice.value, t.func_by_name[expr.attr])
+        return None
+
+    def _refs_of(self, mod: ModuleInfo, fi: FuncInfo) -> Set[FuncKey]:
+        sysmods = self._sys_modules_vars(mod, fi.node)
+        out: Set[FuncKey] = set()
+        for node in _walk_function_body(fi.node):
+            if isinstance(node, ast.Call):
+                ref = self._resolve_ref(mod, fi, node.func, sysmods)
+                if ref:
+                    out.add(ref)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load):
+                ref = self._resolve_ref(mod, fi, node, sysmods)
+                if ref:
+                    out.add(ref)
+            elif isinstance(node, ast.Attribute) and isinstance(
+                node.ctx, ast.Load
+            ):
+                ref = self._resolve_ref(mod, fi, node, sysmods)
+                if ref:
+                    out.add(ref)
+        # Nested function definitions belong to their parent's execution
+        # only when referenced; but a DECORATED nested def executes at
+        # parent call time — keep it simple: parent references every
+        # direct child (closure factories immediately use their children
+        # in this codebase, and over-approximating reachability is the
+        # safe direction for the cascade pass).
+        for qual in mod.funcs:
+            if qual.startswith(fi.qual + ".") and "." not in qual[len(fi.qual) + 1:]:
+                out.add((mod.name, qual))
+        return out
+
+    def reachable_from(self, roots: Sequence[FuncKey]) -> Set[FuncKey]:
+        graph = self.refs()
+        seen: Set[FuncKey] = set()
+        stack = [r for r in roots if r in graph]
+        while stack:
+            cur = stack.pop()
+            if cur in seen:
+                continue
+            seen.add(cur)
+            stack.extend(graph.get(cur, ()) - seen)
+        return seen
+
+    # -- pragma helpers ----------------------------------------------------
+
+    def suppressed(self, path: Path, line: int, rule: str) -> Optional[Pragma]:
+        """The pragma covering (path, line) for ``rule``: same line or the
+        line directly above."""
+        src = get_source(path)
+        for ln in (line, line - 1):
+            for p in src.pragmas.get(ln, ()):
+                if p.rule == rule:
+                    return p
+        return None
+
+    def used_pragmas(self) -> List[Tuple[Path, Pragma]]:
+        out = []
+        for mod in self.modules.values():
+            for plist in mod.source.pragmas.values():
+                for p in plist:
+                    out.append((mod.path, p))
+        return out
+
+    # -- lookup helpers ----------------------------------------------------
+
+    def func(self, module: str, name: str) -> Optional[FuncKey]:
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.funcs:
+            return (module, name)
+        qual = mod.func_by_name.get(name)
+        return (module, qual) if qual else None
+
+    def module_path(self, module: str) -> Optional[Path]:
+        mod = self.modules.get(module)
+        return mod.path if mod else None

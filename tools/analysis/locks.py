@@ -1,0 +1,455 @@
+"""Lock-discipline pass (rule ids: ``lock-order``, ``lock-blocking``,
+``thread-shared-write``).
+
+Three checks over the threading sites in the scoped modules
+(``torch_backend/``, ``observability/``, ``parallel/async_plane.py`` by
+default — where the bridge worker loop, the health/exporter threads and
+the async sender live):
+
+* **lock-order** — build the lock-acquisition-order graph (edge A→B
+  when B is acquired while A is held, directly or through a called
+  function's transitive acquire set) and flag cycles: two threads
+  taking the same pair in opposite orders is a deadlock that no test
+  reliably reproduces.
+* **lock-blocking** — flag blocking calls inside ``with <lock>``
+  bodies: ``sleep``, unbounded ``.result()``/``.join()``, the bridge's
+  ``*wait_key*`` waits without a timeout, ``open()`` and socket
+  primitives. A lock held across a wait turns a slow peer into a
+  stalled process; the hardened data plane's contract is that waits are
+  bounded AND unlocked.
+* **thread-shared-write** — attributes written from a
+  ``threading.Thread`` target's call tree and read from other methods
+  with no common lock on at least one side of some write/read pair:
+  the torn-read/-write class the GIL hides until a reordering bites.
+
+Lock identity is (module, owner, attr): module-level ``_LOCK``-style
+globals and ``self._lock``-style instance locks created in any method
+of a class. Deliberate exceptions (a flush lock that exists precisely
+to serialize file appends) carry
+``# cgx-analysis: allow(lock-blocking) — reason`` on the call line.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .graph import FuncKey, ModuleInfo, Project, _walk_function_body
+from .report import Finding
+
+DEFAULT_SCOPES = ("torch_backend", "observability", "parallel/async_plane.py")
+
+_LOCK_CTORS = {"Lock", "RLock"}
+_SOCKET_BLOCKING = {"recv", "recvfrom", "accept", "connect", "sendall"}
+
+LockId = Tuple[str, str, str]  # (module, owner ("" = module scope), attr)
+
+
+def _in_scope(path: Path, scopes: Sequence[str]) -> bool:
+    s = str(path)
+    return any(scope.rstrip("/") in s for scope in scopes)
+
+
+def _is_lock_ctor(expr: ast.AST) -> bool:
+    if not isinstance(expr, ast.Call):
+        return False
+    fn = expr.func
+    name = (
+        fn.attr if isinstance(fn, ast.Attribute)
+        else fn.id if isinstance(fn, ast.Name) else ""
+    )
+    return name in _LOCK_CTORS
+
+
+def _collect_locks(mod: ModuleInfo) -> Set[LockId]:
+    locks: Set[LockId] = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign) and _is_lock_ctor(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    locks.add((mod.name, "", t.id))
+    for qual, fi in mod.funcs.items():
+        if fi.cls is None:
+            continue
+        for n in _walk_function_body(fi.node):
+            if isinstance(n, ast.Assign) and _is_lock_ctor(n.value):
+                for t in n.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        locks.add((mod.name, fi.cls, t.attr))
+    return locks
+
+
+def _lock_of_expr(
+    proj: Project, mod: ModuleInfo, fi, expr: ast.AST,
+    known: Set[LockId],
+) -> Optional[LockId]:
+    if isinstance(expr, ast.Name):
+        lid = (mod.name, "", expr.id)
+        return lid if lid in known else None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        base = expr.value.id
+        if base == "self" and fi.cls is not None:
+            lid = (mod.name, fi.cls, expr.attr)
+            return lid if lid in known else None
+        tmod = proj.resolve_module_alias(mod, base)
+        if tmod:
+            lid = (tmod, "", expr.attr)
+            return lid if lid in known else None
+    return None
+
+
+@dataclasses.dataclass
+class _FnLocks:
+    """Per-function lock facts."""
+
+    acquires: Set[LockId] = dataclasses.field(default_factory=set)
+    # (outer, inner, line) nesting observed lexically
+    nestings: List[Tuple[LockId, LockId, int]] = dataclasses.field(
+        default_factory=list
+    )
+    # (lockset, call-node) for blocking-call checking
+    guarded_calls: List[Tuple[Tuple[LockId, ...], ast.Call]] = (
+        dataclasses.field(default_factory=list)
+    )
+    # (lockset, line, target FuncKey) calls made while holding locks
+    guarded_refs: List[Tuple[Tuple[LockId, ...], int, FuncKey]] = (
+        dataclasses.field(default_factory=list)
+    )
+    # attribute accesses: attr -> [(kind, lockset, line)]
+    self_attrs: Dict[str, List[Tuple[str, Tuple[LockId, ...], int]]] = (
+        dataclasses.field(default_factory=dict)
+    )
+
+
+def _scan_function(
+    proj: Project, mod: ModuleInfo, fi, known: Set[LockId]
+) -> _FnLocks:
+    facts = _FnLocks()
+    sysmods = proj._sys_modules_vars(mod, fi.node)
+
+    def visit(node: ast.AST, held: Tuple[LockId, ...]) -> None:
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+        ):
+            return  # nested defs don't run under this lock
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_held = held
+            for item in node.items:
+                lid = _lock_of_expr(proj, mod, fi, item.context_expr, known)
+                if lid is not None:
+                    facts.acquires.add(lid)
+                    for outer in new_held:
+                        if outer != lid:
+                            facts.nestings.append(
+                                (outer, lid, node.lineno)
+                            )
+                    new_held = new_held + (lid,)
+                visit(item.context_expr, held)
+            for stmt in node.body:
+                visit(stmt, new_held)
+            return
+        if isinstance(node, ast.Call):
+            if held:
+                facts.guarded_calls.append((held, node))
+            ref = proj._resolve_ref(mod, fi, node.func, sysmods)
+            if ref and held:
+                facts.guarded_refs.append((held, node.lineno, ref))
+        if isinstance(node, ast.Attribute) and isinstance(
+            node.value, ast.Name
+        ) and node.value.id == "self":
+            kind = (
+                "write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                else "read"
+            )
+            facts.self_attrs.setdefault(node.attr, []).append(
+                (kind, held, node.lineno)
+            )
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for child in ast.iter_child_nodes(fi.node):
+        visit(child, ())
+    return facts
+
+
+# ---------------------------------------------------------------------------
+# The pass.
+# ---------------------------------------------------------------------------
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    fn = call.func
+    name = (
+        fn.attr if isinstance(fn, ast.Attribute)
+        else fn.id if isinstance(fn, ast.Name) else ""
+    )
+    has_timeout = any(
+        kw.arg and "timeout" in kw.arg.lower() for kw in call.keywords
+    )
+    if name == "sleep":
+        return "'sleep()' parks the thread while peers contend the lock"
+    if name == "result" and isinstance(fn, ast.Attribute):
+        if not has_timeout and not call.args:
+            return (
+                "unbounded '.result()' can wait forever on a dead peer "
+                "while the lock is held"
+            )
+    if name == "join" and isinstance(fn, ast.Attribute):
+        if not has_timeout and not call.args:
+            return (
+                "unbounded '.join()' under a lock deadlocks if the "
+                "joined thread needs the same lock"
+            )
+    if "wait_key" in name and not has_timeout:
+        return (
+            f"blocking '{name}' without a timeout is a bridge header "
+            "wait; holding a lock across it stalls every other user"
+        )
+    if name == "open" and isinstance(fn, ast.Name):
+        return "file I/O ('open') under a lock ties the lock to disk latency"
+    if name in _SOCKET_BLOCKING and isinstance(fn, ast.Attribute):
+        return f"socket '.{name}()' under a lock ties the lock to the network"
+    return None
+
+
+def _thread_targets(
+    proj: Project, mod: ModuleInfo, fi
+) -> List[FuncKey]:
+    """Functions handed to ``threading.Thread(target=...)`` inside fi."""
+    out: List[FuncKey] = []
+    sysmods = proj._sys_modules_vars(mod, fi.node)
+    for node in _walk_function_body(fi.node):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if name != "Thread":
+            continue
+        for kw in node.keywords:
+            if kw.arg == "target":
+                ref = proj._resolve_ref(mod, fi, kw.value, sysmods)
+                if ref:
+                    out.append(ref)
+    return out
+
+
+def check(
+    proj: Project, scopes: Optional[Sequence[str]] = None
+) -> List[Finding]:
+    if scopes is None:
+        scopes = DEFAULT_SCOPES
+    mods = [
+        m for m in proj.modules.values() if _in_scope(m.path, scopes)
+    ]
+    known: Set[LockId] = set()
+    for mod in mods:
+        known |= _collect_locks(mod)
+
+    facts: Dict[FuncKey, _FnLocks] = {}
+    for mod in mods:
+        for qual, fi in mod.funcs.items():
+            facts[(mod.name, qual)] = _scan_function(proj, mod, fi, known)
+
+    findings: List[Finding] = []
+
+    # --- transitive acquire sets (one level of closure over refs) -------
+    refs = proj.refs()
+    trans_acquires: Dict[FuncKey, Set[LockId]] = {}
+
+    def acquires_of(key: FuncKey, stack: Set[FuncKey]) -> Set[LockId]:
+        if key in trans_acquires:
+            return trans_acquires[key]
+        if key in stack:
+            return facts[key].acquires if key in facts else set()
+        stack.add(key)
+        out: Set[LockId] = set(
+            facts[key].acquires if key in facts else ()
+        )
+        for t in refs.get(key, ()):
+            if t in facts:
+                out |= acquires_of(t, stack)
+        stack.discard(key)
+        trans_acquires[key] = out
+        return out
+
+    # --- edges: direct nestings + held-across-call acquisitions ---------
+    edges: Dict[Tuple[LockId, LockId], Tuple[Path, int]] = {}
+    for mod in mods:
+        for qual, fi in mod.funcs.items():
+            f = facts[(mod.name, qual)]
+            for outer, inner, line in f.nestings:
+                edges.setdefault((outer, inner), (mod.path, line))
+            for held, line, target in f.guarded_refs:
+                for inner in acquires_of(target, set()):
+                    for outer in held:
+                        if outer != inner:
+                            edges.setdefault(
+                                (outer, inner), (mod.path, line)
+                            )
+
+    # --- cycle detection -------------------------------------------------
+    adj: Dict[LockId, Set[LockId]] = {}
+    for (a, b) in edges:
+        adj.setdefault(a, set()).add(b)
+
+    def find_cycle() -> Optional[List[LockId]]:
+        WHITE, GRAY, BLACK = 0, 1, 2
+        color: Dict[LockId, int] = {}
+        parent: Dict[LockId, LockId] = {}
+
+        def dfs(u: LockId) -> Optional[List[LockId]]:
+            color[u] = GRAY
+            for v in sorted(adj.get(u, ())):
+                c = color.get(v, WHITE)
+                if c == GRAY:
+                    cyc = [v, u]
+                    cur = u
+                    while cur != v:
+                        cur = parent[cur]
+                        cyc.append(cur)
+                    return cyc
+                if c == WHITE:
+                    parent[v] = u
+                    got = dfs(v)
+                    if got:
+                        return got
+            color[u] = BLACK
+            return None
+
+        for u in sorted(adj):
+            if color.get(u, WHITE) == WHITE:
+                got = dfs(u)
+                if got:
+                    return got
+        return None
+
+    cycle = find_cycle()
+    if cycle:
+        # Report once, at the edge that closes the cycle.
+        a, b = cycle[1], cycle[0]
+        path, line = edges.get((a, b)) or next(iter(edges.values()))
+        names = " -> ".join(
+            f"{m.rsplit('.', 1)[-1]}.{owner + '.' if owner else ''}{attr}"
+            for (m, owner, attr) in reversed(cycle)
+        )
+        if not proj.suppressed(path, line, "lock-order"):
+            findings.append(Finding(
+                path=str(path), line=line, rule="lock-order",
+                message=(
+                    f"[lock-order] lock-acquisition cycle: {names} — two "
+                    "threads taking this pair in opposite orders "
+                    "deadlock; pick one global order (acquire the outer "
+                    "lock first everywhere) or collapse to one lock"
+                ),
+            ))
+
+    # --- blocking calls under a lock ------------------------------------
+    for mod in mods:
+        for qual, fi in mod.funcs.items():
+            f = facts[(mod.name, qual)]
+            for held, call in f.guarded_calls:
+                reason = _blocking_reason(call)
+                if reason is None:
+                    continue
+                if proj.suppressed(mod.path, call.lineno, "lock-blocking"):
+                    continue
+                locknames = ", ".join(
+                    f"{owner + '.' if owner else ''}{attr}"
+                    for (_m, owner, attr) in held
+                )
+                findings.append(Finding(
+                    path=str(mod.path), line=call.lineno,
+                    rule="lock-blocking",
+                    message=(
+                        f"[lock-blocking] blocking call inside `with "
+                        f"{locknames}` body of {fi.qual!r}: {reason}; "
+                        "move the wait outside the critical section or "
+                        "annotate `# cgx-analysis: allow(lock-blocking) "
+                        "— <why>`"
+                    ),
+                ))
+
+    # --- cross-thread unlocked writes ------------------------------------
+    for mod in mods:
+        # thread-side function set per module: targets + transitive refs
+        # restricted to this module (the worker's helpers live beside it)
+        targets: List[FuncKey] = []
+        for qual, fi in mod.funcs.items():
+            targets.extend(_thread_targets(proj, mod, fi))
+        if not targets:
+            continue
+        thread_side: Set[FuncKey] = set()
+        stack = list(targets)
+        while stack:
+            cur = stack.pop()
+            if cur in thread_side or cur[0] != mod.name:
+                continue
+            thread_side.add(cur)
+            stack.extend(refs.get(cur, ()))
+        # writes from the thread side, reads from elsewhere
+        writes: Dict[Tuple[str, str], List[Tuple[Tuple[LockId, ...], int, str]]] = {}
+        for key in thread_side:
+            f = facts.get(key)
+            if f is None:
+                continue
+            fi = proj.modules[key[0]].funcs[key[1]]
+            if fi.name == "__init__":
+                continue
+            for attr, accesses in f.self_attrs.items():
+                for kind, held, line in accesses:
+                    if kind == "write":
+                        writes.setdefault((fi.cls or "", attr), []).append(
+                            (held, line, key[1])
+                        )
+        if not writes:
+            continue
+        for qual, fi in mod.funcs.items():
+            key = (mod.name, qual)
+            if key in thread_side or fi.name == "__init__":
+                continue
+            f = facts[key]
+            for attr, accesses in f.self_attrs.items():
+                wlist = writes.get((fi.cls or "", attr))
+                if not wlist:
+                    continue
+                for kind, held, line in accesses:
+                    if kind != "read":
+                        continue
+                    # a common lock on every (write, this read) pair?
+                    unlocked = [
+                        (wheld, wline, wfn)
+                        for (wheld, wline, wfn) in wlist
+                        if not (set(wheld) & set(held))
+                    ]
+                    if not unlocked:
+                        continue
+                    if proj.suppressed(
+                        mod.path, line, "thread-shared-write"
+                    ):
+                        continue
+                    wheld, wline, wfn = unlocked[0]
+                    findings.append(Finding(
+                        path=str(mod.path), line=line,
+                        rule="thread-shared-write",
+                        message=(
+                            f"[thread-shared-write] 'self.{attr}' is "
+                            f"written from thread-target call tree "
+                            f"({wfn}:{wline}) and read in {fi.qual!r} "
+                            "with no common lock on the pair — torn/"
+                            "stale reads the GIL only hides; guard both "
+                            "sides with one lock or annotate "
+                            "`# cgx-analysis: allow(thread-shared-"
+                            "write) — <why>`"
+                        ),
+                    ))
+                    break  # one finding per (reader fn, attr)
+    return findings

@@ -1,0 +1,66 @@
+"""Finding model + rendering for the whole-program analyzer.
+
+Every rule (per-file and whole-program) produces :class:`Finding` rows.
+The legacy ``tools/lint.py`` text surface — ``path:line: message`` lines
+on stdout, a one-line tally on stderr, exit 1 iff any finding — is
+preserved exactly by :func:`render_text`; ``--json`` mode serializes the
+same rows for ``cgx_report`` embedding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Iterable, List
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One analyzer finding, anchored to a file:line."""
+
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        # The legacy lint format: the rule id lives inside the message
+        # prose (per-file rules) or as a `[rule]` prefix (whole-program
+        # passes) — the `path:line: message` shape is what test_lint.py
+        # and editors key on.
+        return f"{self.path}:{self.line}: {self.message}"
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def render_text(findings: Iterable[Finding]) -> str:
+    return "\n".join(f.render() for f in findings)
+
+
+def render_json(findings: Iterable[Finding], *, files_checked: int = 0,
+                passes: Iterable[str] = (), elapsed_s: float = 0.0) -> str:
+    rows = list(findings)
+    return json.dumps(
+        summary_dict(rows, files_checked=files_checked,
+                     passes=list(passes), elapsed_s=elapsed_s),
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def summary_dict(findings: List[Finding], *, files_checked: int,
+                 passes: List[str], elapsed_s: float) -> dict:
+    """The ``--json`` payload (also consumed by tools/cgx_report.py)."""
+    by_rule: dict = {}
+    for f in findings:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    return {
+        "clean": not findings,
+        "count": len(findings),
+        "by_rule": by_rule,
+        "findings": [f.to_dict() for f in findings],
+        "files_checked": files_checked,
+        "passes": sorted(passes),
+        "elapsed_s": round(elapsed_s, 3),
+    }

@@ -20,6 +20,7 @@ import glob
 import json
 import os
 import sys
+from pathlib import Path
 from collections import Counter, defaultdict
 from typing import Dict, List, Optional, Tuple
 
@@ -560,6 +561,21 @@ def render(summary: dict) -> str:
             f"reporting={c.get('ranks_reporting')} "
             f"missing={c.get('missing_ranks')}"
         )
+    if summary.get("analysis"):
+        a = summary["analysis"]
+        parts.append("\n== static analysis (tools/analysis) ==")
+        if a.get("error"):
+            parts.append(f"  unavailable: {a['error']}")
+        else:
+            state = (
+                "clean" if a.get("clean") else f"{a.get('count')} finding(s)"
+            )
+            parts.append(
+                f"  {state} across {a.get('files_checked')} files "
+                f"({a.get('elapsed_s')}s)"
+            )
+            for rule, n in sorted((a.get("by_rule") or {}).items()):
+                parts.append(f"  {rule}: {n}")
     if len(parts) == 1:
         parts.append("(no events recorded — was CGX_METRICS_DIR set?)")
     return "\n".join(parts)
@@ -572,6 +588,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         help="metrics dir (default: $CGX_METRICS_DIR)",
     )
     ap.add_argument("--json", action="store_true", help="print JSON summary")
+    ap.add_argument(
+        "--analysis", action="store_true",
+        help="embed the whole-program analyzer's status (ISSUE 14: the "
+             "same payload as `python -m tools.analysis --json`)",
+    )
     args = ap.parse_args(argv)
     if not args.directory:
         print("cgx_report: no directory given and CGX_METRICS_DIR unset",
@@ -582,6 +603,15 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     summary = summarize(load_dir(args.directory))
+    if args.analysis:
+        try:
+            sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+            from tools import analysis as _analysis
+
+            summary["analysis"] = _analysis.analyzer_status()
+        except Exception as e:  # report must render even if lint can't run
+            summary["analysis"] = {"error": str(e), "clean": False,
+                                   "count": -1}
     if args.json:
         print(json.dumps(summary, indent=2, default=str))
     else:

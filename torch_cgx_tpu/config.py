@@ -98,6 +98,8 @@ PLANNER_MODEL = "CGX_PLANNER_MODEL"  # calibrated CostModel json (group-wide)
 # Codec roofline round 2 (ops/codec_pallas.py + ops/autotune.py +
 # ops/fused_producer.py — PR 11):
 PALLAS_DB = "CGX_PALLAS_DB"  # auto | on | off — double-buffered DMA kernels
+PALLAS_PACK = "CGX_PALLAS_PACK"  # sum | butterfly — bit-plane pack lowering
+PALLAS_TILE_CHUNKS = "CGX_PALLAS_TILE_CHUNKS"  # explicit tile override
 SRA_ACCUM = "CGX_SRA_ACCUM"  # exact | int8 — epilogue accumulation domain
 AUTOTUNE = "CGX_AUTOTUNE"  # auto | on | off — per-chip tile autotuner
 AUTOTUNE_DIR = "CGX_AUTOTUNE_DIR"  # on-disk autotune cache location
@@ -930,6 +932,46 @@ def async_outer_momentum() -> float:
             f"{ASYNC_OUTER_MOMENTUM} must be in [0, 1), got {v}"
         )
     return v
+
+
+def trace_knob_fingerprint() -> Tuple:
+    """Every env knob a staged train-step program bakes in at TRACE time,
+    in one hashable tuple — the env component of ``make_train_step``'s
+    build-cache key (ISSUE 14's knob→cache-key completeness pass found
+    the build cache keyed registry/route/schedule/wire/producer eras but
+    not the env-derived codec and guard knobs: a
+    ``CGX_COMPRESSION_QUANTIZATION_BITS`` or ``CGX_QERR_STATS`` flip
+    between calls with an unchanged registry version would serve a stale
+    trace). Re-read per build like every other config read — cheap host
+    Python, and an env flip can then never hit a stale program.
+
+    The raw ``get_optional_str_env`` reads at the tail mirror knobs whose
+    validating parsers live beside their kernels (``codec_pallas.
+    _encode_strategy``/``_pack_strategy``/``_forced_tile_chunks``) — the
+    fingerprint keys the raw value and leaves validation to the one
+    owner, so the two can never drift."""
+    return (
+        default_compression_config(),
+        minimal_size(),
+        fusion_threshold_elems(1),
+        standalone_layer_elems(),
+        topology_from_env(),
+        codec_impl(),
+        sra_epilogue(),
+        sra_epilogue_min_elems(),
+        sra_accum(),
+        pallas_db(),
+        autotune_mode(),
+        dummy_compression(),
+        force_codec(),
+        fake_ratio(),
+        qerr_stats(),
+        runtime_metrics(),
+        nonfinite_guard(),
+        _env.get_optional_str_env(CODEC_ENCODE),
+        _env.get_optional_str_env(PALLAS_PACK),
+        _env.get_optional_str_env(PALLAS_TILE_CHUNKS),
+    )
 
 
 NONFINITE_POLICIES = ("off", "skip", "exact")

@@ -213,6 +213,7 @@ class Timeline:
             with self._flush_lock:
                 first = path not in self._owned_paths
                 self._owned_paths.add(path)
+                # cgx-analysis: allow(lock-blocking) — the flush lock exists precisely to serialize this append (truncate-vs-append races); event writers never take it
                 with open(path, "w" if first else "a") as f:
                     if first:
                         f.write(json.dumps(self._meta()) + "\n")

@@ -177,6 +177,23 @@ def begin_step() -> None:
     _STASH.clear()
 
 
+def invalidate(reason: str = "reconfigure") -> None:
+    """Recovery invalidation entry point
+    (``supervisor.invalidate_trace_caches``): the configured mesh/axis
+    belong to the dead generation and any stashed entry holds tracers of
+    a retired trace — deactivate, open a fresh epoch and drop the stash,
+    so post-recovery builds reconfigure from the survivor mesh instead
+    of staging payloads against the evicted world. ISSUE 14's
+    invalidation-cascade pass caught this module as the orphan memo the
+    supervisor's ladder never reached."""
+    deconfigure()
+    begin_step()  # fresh epoch: pre-recovery entries can never claim
+    metrics.add("cgx.codec.producer_invalidations")
+    from ..utils.logging import get_logger
+
+    get_logger().info("producer-fuse state invalidated (%s)", reason)
+
+
 def stash_size() -> int:
     return len(_STASH)
 

@@ -285,6 +285,16 @@ def invalidate_layout_cache(reason: str = "reconfigure") -> None:
     # layouts, so they cycle with them (the planner's single
     # invalidation path — docs/PERF_NOTES.md "Whole-step mega-schedule").
     planner_mod.invalidate_plan_cache(reason)
+    # Staged programs compiled for the dead world's meshes: each cache
+    # entry pins a compiled executable, so they drop with the layouts
+    # they were traced from. Lazy via sys.modules — a process using the
+    # tree-allreduce layer without the eager staged plane must not
+    # import it here.
+    import sys as _sys
+
+    xla_mod = _sys.modules.get("torch_cgx_tpu.parallel.xla_allreduce")
+    if xla_mod is not None:
+        xla_mod.invalidate_program_cache(reason)
     from ..utils.logging import get_logger
 
     get_logger().info("allreduce layout cache invalidated (%s)", reason)

@@ -71,6 +71,7 @@ log = get_logger()
 # Live planes, reset by supervisor.invalidate_trace_caches: per-peer round
 # bookkeeping and pending deltas describe the dead generation's
 # membership (the controller-cadence reset class).
+# cgx-analysis: allow(orphan-memo) — weak liveness set: dead planes self-evict; reset_planes() resets every member's state
 _PLANES: "weakref.WeakSet" = weakref.WeakSet()
 _PLANES_LOCK = threading.Lock()
 

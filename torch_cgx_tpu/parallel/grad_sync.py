@@ -54,6 +54,7 @@ class ErrorFeedbackState(NamedTuple):
     e: optax.Updates
 
 
+# cgx-analysis: allow(orphan-memo) — warn-once observability set; staleness only suppresses a duplicate placement warning
 _PLACEMENT_WARNED: set = set()
 
 # Per-compressor warning text: each points at ITS OWN safe wiring — the
@@ -716,6 +717,14 @@ def make_train_step(
             ),
         )
         producer_key = _fp.cache_key_component()
+        # Env component: every CGX_* knob the traced step bakes in
+        # (codec lowering/encode, compression defaults, fusion split,
+        # qerr/runtime-metrics staging, the nonfinite guard) — a flip of
+        # any of them between calls must retrace, never serve a program
+        # from another env era. The registry version above only covers
+        # REGISTERED config; this covers the env tier (the analyzer's
+        # knob→cache-key pass pins the set — tools/analysis/knobs.py).
+        env_key = cfg_mod.trace_knob_fingerprint()
         cache_key = (
             treedef,
             tuple(getattr(l, "ndim", 0) for l in leaves),
@@ -725,6 +734,7 @@ def make_train_step(
             wire_key,
             producer_key,
             planner_key,
+            env_key,
         )
         # Evict traces from older registry versions — each holds a full
         # compiled executable and can never be hit again.

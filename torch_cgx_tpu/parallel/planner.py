@@ -459,6 +459,7 @@ _PLAN_VERSION = 0  # bumped when an adopted re-plan changes decisions
 # CGX_PLANNER_MODEL file cache: (path, mtime_ns) -> CostModel. Re-read
 # only when the file changes; a bad/missing file falls back to default
 # (never crashes a decision site).
+# cgx-analysis: allow(orphan-memo) — keyed by (path, mtime_ns, size): a changed file can never serve a stale model, and recovery moves no file
 _MODEL_FILE_CACHE: Dict[Tuple[str, int], CostModel] = {}
 
 

@@ -203,6 +203,20 @@ def program_cache_clear() -> None:
     _PROGRAM_STATS.update(hits=0, misses=0)
 
 
+def invalidate_program_cache(reason: str = "reconfigure") -> None:
+    """World-shrink invalidation entry point, cascaded from
+    ``allreduce.invalidate_layout_cache`` (and therefore
+    ``supervisor.invalidate_trace_caches``). Entries keyed on the dead
+    world's registry version can never hit again — but each holds a
+    fully COMPILED executable, the most expensive artifact any of the
+    staged caches pins, so they are dropped outright instead of aging
+    out of the LRU while holding device programs live (ISSUE 14's
+    invalidation-cascade pass caught this cache missing from the
+    ladder its layout/schedule/plan siblings already ride)."""
+    program_cache_clear()
+    metrics.add("cgx.xla.program_cache_invalidations")
+
+
 def _mesh_fingerprint(mesh) -> tuple:
     devs = np.asarray(mesh.devices)
     # Grid shape is part of the identity: transposed meshes over the same
@@ -217,10 +231,16 @@ def _mesh_fingerprint(mesh) -> tuple:
 
 def _trace_env_fingerprint() -> tuple:
     """Every env knob the staged body bakes in at TRACE time (codec
-    lowering, encode strategy, epilogue selection, debug modes): a flip of
+    lowering, encode strategy, epilogue selection, accumulation domain,
+    kernel tiling/packing, autotune engagement, debug modes): a flip of
     any of these between eager calls must compile a fresh program, never
-    serve a stale one — the same discipline as allreduce's layout LRU."""
+    serve a stale one — the same discipline as allreduce's layout LRU.
+    The PR 11 kernel knobs (``CGX_PALLAS_DB``/``CGX_SRA_ACCUM``/
+    ``CGX_AUTOTUNE``/``CGX_PALLAS_PACK``/``CGX_PALLAS_TILE_CHUNKS``)
+    joined with ISSUE 14's knob→cache-key pass, which caught them
+    lowering into the program body without re-keying it."""
     from ..ops import codec_pallas
+    from ..utils import env as _env
 
     return (
         cfg_mod.codec_impl(),
@@ -230,6 +250,11 @@ def _trace_env_fingerprint() -> tuple:
         cfg_mod.dummy_compression(),
         cfg_mod.force_codec(),
         cfg_mod.minimal_size(),
+        cfg_mod.sra_accum(),
+        cfg_mod.pallas_db(),
+        cfg_mod.autotune_mode(),
+        _env.get_optional_str_env(cfg_mod.PALLAS_PACK),
+        _env.get_optional_str_env(cfg_mod.PALLAS_TILE_CHUNKS),
     )
 
 

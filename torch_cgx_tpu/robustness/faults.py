@@ -256,6 +256,7 @@ class FaultInjector:
             os._exit(KILL_EXIT_CODE)
 
 
+# cgx-analysis: allow(orphan-memo) — injectors are keyed by the (spec, seed, rank) env contract, generation-independent by design: a recovery must not re-randomize the fault schedule under the chaos suite
 _cache: Dict[Tuple[str, int, Optional[int]], FaultInjector] = {}
 _cache_lock = threading.Lock()
 

@@ -138,6 +138,14 @@ def invalidate_trace_caches() -> None:
         sys.modules["torch_cgx_tpu.ops.autotune"].invalidate(
             "recovery reconfigure"
         )
+    # Producer-fuse context: the configured mesh/axis name the dead
+    # generation and stashed pre-quantized payloads hold retired traces'
+    # tracers — deactivate and re-epoch so the first post-recovery build
+    # reconfigures from the survivor mesh (the ISSUE 14 cascade pass
+    # found this module unreachable from the ladder).
+    fp = sys.modules.get("torch_cgx_tpu.ops.fused_producer")
+    if fp is not None:
+        fp.invalidate("recovery reconfigure")
     # The health engine's per-peer wait state is a pre-recovery stream
     # too: an evicted peer whose wait EWMA froze at the timeout value
     # would otherwise re-emit a phantom straggler event every cooldown

@@ -355,6 +355,7 @@ _PLAN_CHUNK_CANDIDATES = (1, 2, 4, 8, 16)
 _PLAN_DEFAULT_RATES = (8.0, 16.0, 1.0, 100e-6)  # q GB/s, d GB/s, wire GB/s, overhead s
 
 # CGX_PLANNER_MODEL mirror cache: (path, mtime_ns) -> rate tuple.
+# cgx-analysis: allow(orphan-memo) — (path, mtime_ns)-keyed mirror of the planner's file cache: self-invalidating on any rewrite, generation-independent
 _PLAN_MODEL_CACHE: dict = {}
 
 
@@ -872,7 +873,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # Generation-namespaced: a pre-recovery abort must not poison the
         # reconfigured group.
         self._abort_key = self._ns("cgxctl/abort")
-        self._aborted = False
+        # An Event, not a bare bool: set from the worker/observer threads'
+        # failure paths and read from user threads parked in _wait_key —
+        # the one cross-thread flag here that must publish without a lock
+        # (ISSUE 14's thread-shared-write pass).
+        self._aborted = threading.Event()
         self._store_can_check: Optional[bool] = None
         # Same-host SHM data plane + host topology map (the reference's
         # shm_communicator/mpi_context roles — see shm.py). Rendezvous over
@@ -1041,7 +1046,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     # expire, which is exactly what the recovery retry
                     # rung (not eviction) must absorb.
                     self._injector.delay("slow_rank")
-                if self._aborted:
+                if self._aborted.is_set():
                     self._raise_abort()
                 fn()
             except Exception as e:
@@ -1117,7 +1122,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             return None
 
     def _raise_abort(self) -> None:
-        self._aborted = True
+        self._aborted.set()
         try:
             msg = bytes(self._store.get(self._abort_key)).decode()
         except Exception:
@@ -1133,7 +1138,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         every blocking wait polls the abort key alongside its payload key:
         a rank that failed mid-collective unblocks its peers in ~200 ms
         instead of leaving them parked until the store timeout."""
-        if self._aborted:
+        if self._aborted.is_set():
             self._raise_abort()
         # Park in the store's own blocking wait in 200 ms slices: TCPStore
         # waiters get push-notified (sub-ms arrival latency, ~5 RPCs/s per
@@ -1177,7 +1182,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     _time.sleep(0.05)
                 else:
                     fast_fails = 0  # a full slice elapsed: normal timeout
-            if self._aborted or self._check_store([self._abort_key]):
+            if self._aborted.is_set() or self._check_store([self._abort_key]):
                 self._raise_abort()
             if self._shutdown.is_set():
                 raise RuntimeError("cgx: process group is shut down")
@@ -1241,7 +1246,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._store.set(self._abort_key, msg.encode())
         except Exception as e:
             log.warning("abort: poison key write failed: %s", e)
-        self._aborted = True
+        self._aborted.set()
         err = RuntimeError(f"cgx: process group aborted ({msg})")
         while True:
             try:
@@ -2852,7 +2857,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._local_ranks = [self._rank]
         self._generation = generation
         self._abort_key = self._ns("cgxctl/abort")
-        self._aborted = False
+        self._aborted.clear()
         self._seq = 0
         # The p2p sequence maps are keyed by group-local rank ids (which
         # the shrink just re-indexed) and count messages of the dead

@@ -42,6 +42,7 @@ _QERR_PREFIX = "cgx.qerr."
 # cadence counter surviving a recovery reconfiguration would fire the
 # next re-solve on the dead generation's phase — the PR 6 qerr-cadence
 # bug, closed-loop edition.
+# cgx-analysis: allow(orphan-memo) — weak liveness set: dead controllers self-evict, and each member's cadence/state resets through the edge-registry reset hook registered at construction
 _LIVE: "weakref.WeakSet" = weakref.WeakSet()
 
 

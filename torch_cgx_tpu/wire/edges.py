@@ -138,6 +138,7 @@ _resolve_cache: Dict[Tuple, Optional[EdgeConfig]] = {}
 # user-registered EF zeroers) register a callable; reset_edge_state() runs
 # them all — the post-recovery analogue of allreduce.reset_qerr_sampling
 # (a stale edge cadence after a reconfigure mirrors the PR 6 qerr bug).
+# cgx-analysis: allow(orphan-memo) — registration CONFIG, not derived state: the hooks themselves are what reset_edge_state runs; clearing the list would disconnect owners from the cascade
 _reset_hooks: List[Callable[[], None]] = []
 
 
