@@ -1716,6 +1716,222 @@ def _gate_and_log(results: list) -> int:
     return rc
 
 
+# ---------------------------------------------------------------------------
+# Serving plane (ISSUE 15): quantized vs raw-f16 KV shipping under a
+# bandwidth-modeled prefill→decode wire, measured as continuous-batching
+# tokens/s and TTFT. The child is CPU-pinned (the decode program runs on
+# the test backend — rows key into the `@cpu` trajectories); the wire
+# model is the sender thread's byte-proportional throttle, so wire-byte
+# savings translate to admission latency exactly as on a real
+# bandwidth-bound interconnect (the --async-dcn injected-delay
+# methodology, applied to serving).
+# ---------------------------------------------------------------------------
+
+
+def _serve_child(
+    bits: int, requests: int, prompt: int, gen: int, batch: int,
+    throttle_mbps: float,
+) -> None:
+    """Child: one serving run at CGX_KV_BITS=`bits`; one JSON line."""
+    import threading
+    import zlib
+
+    from torch_cgx_tpu.models.gpt2 import GPT2, GPT2Config
+    from torch_cgx_tpu.serving.prefill import PrefillWorker
+    from torch_cgx_tpu.serving.scheduler import (
+        ContinuousBatchScheduler, GPT2Server, Request, ServeConfig,
+    )
+    from torch_cgx_tpu.serving.transport import KvPageReceiver
+    from torch_cgx_tpu.utils.logging import metrics
+
+    class _DictStore:
+        """Minimal c10d-Store look-alike (the test-suite FakeStore)."""
+
+        def __init__(self):
+            import threading as _t
+
+            self._d, self._l = {}, _t.Lock()
+
+        def set(self, k, v):
+            with self._l:
+                self._d[k] = bytes(v)
+
+        def get(self, k):
+            with self._l:
+                if k not in self._d:
+                    raise KeyError(k)
+                return self._d[k]
+
+        def add(self, k, v):
+            with self._l:
+                cur = int(self._d.get(k, b"0")) + int(v)
+                self._d[k] = str(cur).encode()
+                return cur
+
+        def delete_key(self, k):
+            with self._l:
+                self._d.pop(k, None)
+
+    from torch_cgx_tpu import config as cfg_mod
+
+    # The serving stack resolves the width from CGX_KV_BITS; the argv
+    # copy exists only for the process list — they must agree or the
+    # row would label a width it never measured.
+    assert bits == cfg_mod.kv_bits(), (bits, cfg_mod.kv_bits())
+    cfg = GPT2Config.tiny()
+    model = GPT2(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32), train=False
+    )
+    page_tokens = 16
+    sv = ServeConfig(
+        page_tokens=page_tokens, max_batch=batch,
+        max_pages=max(64, requests * ((prompt + gen) // page_tokens + 2)),
+        max_seq=prompt + gen + page_tokens, ship_depth=4,
+    )
+    server = GPT2Server(cfg, params, sv)
+    store = _DictStore()
+    recv = KvPageReceiver(store)
+    sched = ContinuousBatchScheduler(server, receiver=recv)
+    worker = PrefillWorker(
+        server, store, throttle_gbps=throttle_mbps / 1e3
+    )
+    rng = np.random.default_rng(0)
+    prompts = [
+        [int(t) for t in rng.integers(0, cfg.vocab_size, prompt)]
+        for _ in range(requests)
+    ]
+    # Warm-up: compile prefill/decode/commit programs outside the timed
+    # window (a cold jit would otherwise stall the first streams into
+    # the failover rung and measure the compiler, not the wire).
+    warm = Request(id="warm", tokens=list(prompts[0]),
+                   max_new_tokens=page_tokens + 2)
+    sched.submit(warm)
+    assert sched.run(deadline_s=600), "serve bench warm-up wedged"
+    metrics.reset()
+    reqs = [
+        Request(id=f"r{i}", tokens=list(p), max_new_tokens=gen)
+        for i, p in enumerate(prompts)
+    ]
+    t0 = time.perf_counter()
+    for r in reqs:
+        sched.submit(r, remote=True)
+    t = threading.Thread(
+        target=lambda: [worker.serve(r.id, r.tokens) for r in reqs]
+    )
+    t.start()
+    ok = sched.run(deadline_s=600)
+    wall = time.perf_counter() - t0
+    t.join(timeout=30)
+    worker.stop()
+    assert ok, "serve bench run left outstanding requests"
+    failovers = metrics.get("cgx.serve.prefill_failovers")
+    assert failovers == 0, (
+        f"serve bench: {failovers} prefill failover(s) fired — the "
+        "measurement would mix local-prefill admissions into the wire "
+        "contrast; raise CGX_SERVE_PREFILL_TIMEOUT_MS"
+    )
+    tokens = sum(len(r.output) for r in reqs)
+    ttft = metrics.histogram_stats("cgx.serve.ttft_ms") or {}
+    crc = zlib.crc32(
+        b"".join(
+            np.asarray(r.output, np.int32).tobytes() for r in reqs
+        )
+    )
+    print(json.dumps({
+        "tok_s": tokens / wall,
+        "wall_s": wall,
+        "tokens": tokens,
+        "ttft_p50_ms": ttft.get("p50", 0.0),
+        "ttft_mean_ms": ttft.get("mean", 0.0),
+        "tokens_crc": crc,
+        "kv_bytes_wire": metrics.get("cgx.serve.kv_bytes_wire"),
+        "backend": jax.default_backend(),
+        "chip": jax.devices()[0].device_kind,
+    }))
+
+
+def bench_serve(
+    requests: int = 10, prompt: int = 96, gen: int = 24, batch: int = 8,
+    bits: int = 8, throttle_mbps: float = 0.5,
+) -> list:
+    """Quantized-vs-raw KV shipping records (the ISSUE 15 acceptance
+    rows): the same request stream served twice under a
+    ``throttle_mbps``-modeled prefill→decode wire — once with raw-f16 KV
+    pages (``CGX_KV_BITS=0``, the baseline) and once quantized at
+    ``bits``. ``vs_baseline`` on the tokens/s row is quantized/f16
+    (acceptance floor 1.3x at 8 bits); the TTFT row gates through the
+    inverse-latency trajectory. Greedy outputs must be token-identical
+    between the arms (crc over every generated token) — the wire saves
+    bytes, never answers."""
+    me = str(Path(__file__).resolve())
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for k in ("CGX_KV_BITS", "CGX_KV_PAGE_TOKENS", "CGX_WIRE"):
+        env.pop(k, None)
+    env["CGX_SERVE_PREFILL_TIMEOUT_MS"] = "60000"
+
+    def run(kv_bits: int) -> dict:
+        child_env = dict(env, CGX_KV_BITS=str(kv_bits))
+        return _run_json_child(
+            [sys.executable, me, "--serve-child", str(kv_bits),
+             str(requests), str(prompt), str(gen), str(batch),
+             str(throttle_mbps)], child_env,
+        )
+
+    f16 = run(0)
+    quant = run(bits)
+    if quant["tokens_crc"] != f16["tokens_crc"]:
+        raise AssertionError(
+            f"serve bench: greedy outputs differ between {bits}-bit and "
+            f"f16 KV (crc {quant['tokens_crc']:#x} vs "
+            f"{f16['tokens_crc']:#x}) — the quantized-KV bit envelope "
+            "flipped an argmax on the bench model"
+        )
+    shared_detail = {
+        "requests": requests,
+        "prompt_tokens": prompt,
+        "gen_tokens": gen,
+        "max_batch": batch,
+        "kv_bits": bits,
+        "wire_model_MBps": throttle_mbps,
+        "t_f16_wall_s": round(f16["wall_s"], 3),
+        "t_quant_wall_s": round(quant["wall_s"], 3),
+        "kv_bytes_wire_f16": f16["kv_bytes_wire"],
+        "kv_bytes_wire_quant": quant["kv_bytes_wire"],
+        "greedy_token_identity": True,
+        "transport": "store counter streams (publish-after-write), "
+                     "sender throttled to the modeled wire rate",
+        "backend": f16["backend"],
+        "chip": f16["chip"],
+    }
+    tag = f"{bits}bit_p{prompt}_g{gen}_b{batch}"
+    return [
+        {
+            "metric": f"serve_tokens_per_s_{tag}",
+            "value": round(quant["tok_s"], 3),
+            "unit": "tok/s",
+            "vs_baseline": round(quant["tok_s"] / f16["tok_s"], 3),
+            "backend": f16["backend"],
+            "chip": f16["chip"],
+            "detail": dict(shared_detail,
+                           tok_s_f16=round(f16["tok_s"], 3)),
+        },
+        {
+            "metric": f"serve_ttft_ms_{tag}",
+            "value": round(quant["ttft_p50_ms"], 3),
+            "unit": "ms",
+            "ttft_ms": round(quant["ttft_p50_ms"], 3),
+            "vs_baseline": round(
+                f16["ttft_p50_ms"] / quant["ttft_p50_ms"], 3
+            ) if quant["ttft_p50_ms"] else 0.0,
+            "backend": f16["backend"],
+            "chip": f16["chip"],
+            "detail": dict(shared_detail,
+                           ttft_p50_ms_f16=round(f16["ttft_p50_ms"], 3)),
+        },
+    ]
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if argv and argv[0] == "--xla-allreduce-staged-child":
@@ -1732,6 +1948,38 @@ def main() -> None:
     if argv and argv[0] == "--wire-child":
         _wire_child(int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]))
         return
+    if argv and argv[0] == "--serve-child":
+        _serve_child(
+            int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]),
+            int(argv[5]), float(argv[6]),
+        )
+        return
+    if argv and argv[0] == "--serve":
+        # Serving-plane record (tools/hw_session.sh queues this): both
+        # children are CPU-pinned single-process runs — never touches
+        # the device transport.
+        _preflight_lint()
+        kw = {}
+        for flag, name, cast in (
+            ("--requests", "requests", int), ("--prompt", "prompt", int),
+            ("--gen", "gen", int), ("--batch", "batch", int),
+            ("--bits", "bits", int),
+            ("--throttle-mbps", "throttle_mbps", float),
+        ):
+            if flag in argv:
+                idx = argv.index(flag) + 1
+                val = argv[idx] if idx < len(argv) else ""
+                try:
+                    kw[name] = cast(val)
+                except ValueError:
+                    sys.exit(
+                        f"bench: {flag} requires a {cast.__name__} "
+                        f"value, got {val!r}"
+                    )
+        results = bench_serve(**kw)
+        rc = _gate_and_log(results)
+        print(json.dumps(results))
+        sys.exit(rc)
     if argv and argv[0] == "--async-dcn-child":
         _async_dcn_child(
             int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]),

@@ -180,3 +180,35 @@ def test_gpt2_long_context_sp():
     )
     assert out["mesh"]["sp"] == 8
     assert out["final_loss"] < out["first_loss"]
+
+
+@pytest.mark.slow
+def test_serve_gpt2_example():
+    """Continuous-batching serving as the user runs it: disaggregated
+    prefill thread shipping quantized KV pages, decode admitting as
+    streams land; every request completes with tokens/s + TTFT
+    reported."""
+    out = _run(
+        ["examples/serve_gpt2.py", "--cpu", "--requests", "4",
+         "--prompt", "24", "--gen", "8", "--json"],
+        timeout=500,
+    )
+    assert out["requests"] == 4
+    assert out["tokens"] == 4 * 8
+    assert out["prefill_failovers"] == 0
+    assert out["tokens_per_s"] > 0
+
+
+@pytest.mark.slow
+def test_serve_gpt2_example_prefill_death():
+    """The failover demo: the prefill worker dies after one request and
+    decode degrades to local prefill for the rest — same token count,
+    failovers counted, no wedge."""
+    out = _run(
+        ["examples/serve_gpt2.py", "--cpu", "--requests", "3",
+         "--prompt", "24", "--gen", "6", "--kill-prefill", "1",
+         "--json"],
+        timeout=500,
+    )
+    assert out["tokens"] == 3 * 6
+    assert out["prefill_failovers"] == 2

@@ -898,3 +898,80 @@ def test_linter_accepts_async_metric_namespace(tmp_path):
     proc = _run_lint(bad)
     assert proc.returncode == 1
     assert "asynch" in proc.stdout
+
+
+def test_linter_flags_unbounded_result_in_serving_plane(tmp_path):
+    # Serving-plane blocking gate (ISSUE 15 satellite): the
+    # continuous-batching decode loop must never park — an unconditional
+    # .result() anywhere under torch_cgx_tpu/serving/ is a lint failure.
+    sdir = tmp_path / "torch_cgx_tpu" / "serving"
+    sdir.mkdir(parents=True)
+    bad = sdir / "scheduler.py"
+    bad.write_text(
+        "def _drain(fut):\n"
+        "    return fut.result()\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "decode loop must never block" in proc.stdout
+
+
+def test_linter_flags_wait_key_and_bare_join_in_serving_plane(tmp_path):
+    sdir = tmp_path / "torch_cgx_tpu" / "serving"
+    sdir.mkdir(parents=True)
+    bad = sdir / "transport.py"
+    bad.write_text(
+        "def fetch(group, key, thread):\n"
+        "    group._wait_key(key)\n"
+        "    thread.join()\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "wait_key" in proc.stdout
+    assert "unbounded '.join()'" in proc.stdout
+
+
+def test_linter_serve_gate_allows_bounded_and_out_of_scope(tmp_path):
+    # Bounded waits pass inside serving/; the same code outside the
+    # serving plane is out of scope; string joins (an argument) pass.
+    sdir = tmp_path / "torch_cgx_tpu" / "serving"
+    sdir.mkdir(parents=True)
+    ok = sdir / "scheduler.py"
+    ok.write_text(
+        "def drain(fut, thread, parts):\n"
+        "    v = fut.result(timeout=2.0)\n"
+        "    thread.join(timeout=2.0)\n"
+        "    return ','.join(parts), v\n"
+    )
+    other = tmp_path / "torch_cgx_tpu" / "elsewhere.py"
+    other.write_text(
+        "def f(fut):\n"
+        "    return fut.result()\n"
+    )
+    proc = _run_lint(ok, other)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_serve_metric_namespace(tmp_path):
+    # `cgx.serve.*` is a documented sub-namespace (the ISSUE 15 family);
+    # a typo'd family still fails.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.serve.requests_admitted')\n"
+        "    metrics.observe('cgx.serve.ttft_ms', 12.0)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.sreve.requests_admitted')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "sreve" in proc.stdout

@@ -89,7 +89,8 @@ class CacheSurface:
 
 
 def default_surfaces(pkg: str) -> Tuple[CacheSurface, ...]:
-    """The five staged-program caches of torch_cgx_tpu (ISSUE 14)."""
+    """The six staged-program caches of torch_cgx_tpu (ISSUE 14; the
+    serving decode-program LRU joined with ISSUE 15)."""
     return (
         CacheSurface("layout-lru", f"{pkg}.parallel.allreduce",
                      "_LAYOUT_CACHE", "_tree_layout"),
@@ -102,6 +103,8 @@ def default_surfaces(pkg: str) -> Tuple[CacheSurface, ...]:
                      reader="_cache_get"),
         CacheSurface("train-step-build", f"{pkg}.parallel.grad_sync",
                      "built", "_build"),
+        CacheSurface("serve-program-lru", f"{pkg}.serving.scheduler",
+                     "_PROGRAM_CACHE", "_decode_program"),
     )
 
 

@@ -408,9 +408,13 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # "async" is the asynchronous cross-slice plane (PR 13): outer-round
     # counters, the sender-thread wire gauge, lag gauges and the
     # planner's route prediction — docs/OBSERVABILITY.md.
+    # "serve" is the serving data plane (PR 15): request/token/page
+    # counters, the tokens_per_s gauge and ttft_ms histogram (the SLO
+    # controller's inputs), transport stream counters, prefill-failover
+    # and pool-pressure incidents — docs/OBSERVABILITY.md.
     "async", "codec", "collective", "faults", "flightrec", "health",
     "heartbeat", "plan", "qerr", "recovery", "ring", "runtime", "sched",
-    "shm", "sra", "step", "trace", "wire", "xla",
+    "serve", "shm", "sra", "step", "trace", "wire", "xla",
 })
 
 
@@ -862,6 +866,11 @@ _REGISTRY_OWNER_SUFFIXES = (
     ("parallel", "adaptive.py"),     # legacy offline bit solver
     ("wire", "controller.py"),       # legacy closed-loop bit writes
     ("wire", "edges.py"),            # edge-registry home
+    ("serving", "slo.py"),           # SLO-scoped kv_page bit writes: the
+    #                                  serving objective of the same
+    #                                  closed loop (label-prefix-scoped,
+    #                                  so it can never touch a training
+    #                                  edge's allocation)
     ("robustness", "supervisor.py"),  # recovery invalidation ladder
     ("config.py",),                  # registry definitions themselves
     ("checkpoint.py",),              # snapshot restore re-registers
@@ -983,6 +992,89 @@ def check_async_sender_blocking(path: Path, tree: ast.Module) -> List[str]:
     return findings
 
 
+# Serving-plane blocking gate (PR 15, the check_async_sender_blocking
+# family): the continuous-batching decode loop must NEVER park — an
+# unbounded wait anywhere in torch_cgx_tpu/serving/ puts a dead prefill
+# worker (or a slow store) on the critical path of every admitted lane,
+# which is exactly the wedge the publish-after-write streams + bounded
+# failover exist to prevent (docs/SERVING.md "Never block").
+_SERVE_PLANE_DIR = "serving"
+
+
+def _is_serve_plane_file(path: Path) -> bool:
+    parts = tuple(path.parts)
+    if _LIB_DIR not in parts:
+        return False
+    rel = parts[parts.index(_LIB_DIR) + 1:]
+    return len(rel) >= 2 and rel[0] == _SERVE_PLANE_DIR
+
+
+def check_serve_scheduler_blocking(path: Path, tree: ast.Module) -> List[str]:
+    """No unbounded waits in the serving plane's bodies:
+
+    * an UNCONDITIONAL ``.result()`` (no ``timeout=``) parks the decode
+      loop behind a payload a dead prefill worker will never deliver;
+    * any call whose name contains ``wait_key`` without a timeout-ish
+      keyword is the bridge's blocking header wait — the serving plane
+      only touches already-published bytes (publish-after-write
+      counters), it never waits for a header;
+    * a bare ``.join()`` (no args, no ``timeout=``) parks forever on a
+      thread that may never exit (sender threads are joined bounded in
+      ``stop()``; string ``sep.join(parts)`` calls carry an argument
+      and pass).
+
+    Scope: every file under ``torch_cgx_tpu/serving/``."""
+    if not _is_serve_plane_file(path):
+        return []
+    findings: List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        for n in ast.walk(node):
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            name = (
+                fn.attr if isinstance(fn, ast.Attribute)
+                else fn.id if isinstance(fn, ast.Name) else ""
+            )
+            bounded = any(
+                kw.arg and "timeout" in kw.arg.lower() for kw in n.keywords
+            )
+            if name == "result" and isinstance(fn, ast.Attribute):
+                if not bounded and not n.args:
+                    findings.append(
+                        f"{path}:{n.lineno}: unconditional '.result()' in "
+                        f"serving-plane body {node.name!r} — the decode "
+                        "loop must never block; bound it with timeout= "
+                        "(tools/analysis/perfile.py "
+                        "check_serve_scheduler_blocking; docs/SERVING.md)"
+                    )
+            elif "wait_key" in name and not bounded:
+                findings.append(
+                    f"{path}:{n.lineno}: blocking '{name}' without a "
+                    f"timeout in serving-plane body {node.name!r} — the "
+                    "serving plane only touches already-published bytes "
+                    "(publish-after-write counters) "
+                    "(tools/analysis/perfile.py "
+                    "check_serve_scheduler_blocking)"
+                )
+            elif (
+                name == "join"
+                and isinstance(fn, ast.Attribute)
+                and not n.args
+                and not bounded
+            ):
+                findings.append(
+                    f"{path}:{n.lineno}: unbounded '.join()' in "
+                    f"serving-plane body {node.name!r} — a thread that "
+                    "never exits would park the serving loop forever; "
+                    "pass timeout= (tools/analysis/perfile.py "
+                    "check_serve_scheduler_blocking)"
+                )
+    return findings
+
+
 def _timeline_bridge_ops(timeline_path: Path):
     """The ``BRIDGE_OPS`` name list declared in observability/timeline.py
     (parsed through the shared parse cache, never imported — lint must
@@ -1074,6 +1166,7 @@ RULES: "OrderedDict[str, RuleFn]" = OrderedDict([
     ("wire-routing", check_wire_edge_routing),
     ("registry-ownership", check_planner_registry_ownership),
     ("async-blocking", check_async_sender_blocking),
+    ("serve-blocking", check_serve_scheduler_blocking),
 ])
 
 

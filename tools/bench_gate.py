@@ -258,12 +258,41 @@ def check_pred_slack(
     return out
 
 
+# Serving latency floor (ISSUE 15): serve bench records carry the
+# measured time-to-first-token next to the tokens/s throughput. Lower is
+# better for a latency, so the gated trajectory value is its INVERSE
+# (1000/ms — "admissions per second"), making the standard
+# higher-is-better threshold machinery apply unchanged: a TTFT
+# regression shows as the inverse dropping. ``@cpu`` separation applies
+# exactly as for throughput (the decode program runs on the test
+# backend).
+_TTFT_SUFFIX = ":ttft_inv"
+
+
+def normalize_serve_ttft(rec: dict) -> Optional[Tuple[str, float]]:
+    """(``<metric>:ttft_inv`` key, 1000/ttft_ms) for records carrying a
+    top-level ``ttft_ms``, or None."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return None
+    metric = rec.get("metric")
+    v = rec.get("ttft_ms")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return None
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        return None
+    key = f"{metric}{_TTFT_SUFFIX}"
+    if is_placeholder(rec):
+        key += _PLACEHOLDER_SUFFIX
+    return key, 1000.0 / float(v)
+
+
 def normalize_all(rec: dict) -> List[Tuple[str, float]]:
     """Every gated (key, higher-is-better value) pair one record yields:
-    its throughput trajectory and, when present, its overlap-fraction
-    and prediction-ratio trajectories."""
+    its throughput trajectory and, when present, its overlap-fraction,
+    prediction-ratio and TTFT-inverse trajectories."""
     out = []
-    for fn in (normalize, normalize_overlap, normalize_pred):
+    for fn in (normalize, normalize_overlap, normalize_pred,
+               normalize_serve_ttft):
         norm = fn(rec)
         if norm is not None:
             out.append(norm)
@@ -290,7 +319,7 @@ def _normalize_bare(rec: dict) -> Optional[Tuple[str, float]]:
     if not isinstance(v, (int, float)) or v <= 0:
         return None
     unit = str(rec.get("unit", ""))
-    if "GB/s" not in unit:
+    if "GB/s" not in unit and "tok/s" not in unit:
         return None  # only throughput metrics are gated (direction known)
     return str(metric), float(v)
 

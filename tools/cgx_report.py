@@ -171,6 +171,11 @@ def summarize(data: dict) -> dict:
     plan_gauges_by_rank: Dict[int, Dict[str, float]] = defaultdict(dict)
     # Async-plane gauges are levels too (worst lag, wire rate, route H).
     async_gauges: Dict[str, float] = {}
+    # Serving-plane gauges (tokens/s, SLO bit budget, occupancy) are
+    # levels as well; TTFT arrives as a histogram per rank (worst rank's
+    # quantiles are the SLO-relevant view).
+    serve_gauges: Dict[str, float] = {}
+    serve_ttft: Dict[str, float] = {}
     for rank, lines in data["metrics"].items():
         if not lines:
             continue
@@ -183,10 +188,18 @@ def summarize(data: dict) -> dict:
                 g[k] = max(g.get(k, 0.0), v)
             elif isinstance(v, (int, float)) and k.startswith("cgx.async."):
                 async_gauges[k] = max(async_gauges.get(k, 0.0), v)
+            elif isinstance(v, (int, float)) and k.startswith("cgx.serve."):
+                serve_gauges[k] = max(serve_gauges.get(k, 0.0), v)
         p50 = ((lines[-1].get("histograms") or {}).get("cgx.step.time_s")
                or {}).get("p50")
         if isinstance(p50, (int, float)):
             step_p50 = max(step_p50 or 0.0, p50)
+        ttft = (lines[-1].get("histograms") or {}).get("cgx.serve.ttft_ms")
+        if isinstance(ttft, dict):
+            for stat in ("p50", "p90", "p99", "count"):
+                v = ttft.get(stat)
+                if isinstance(v, (int, float)):
+                    serve_ttft[stat] = max(serve_ttft.get(stat, 0.0), v)
     totals: Counter = Counter()
     for per_rank in rank_counters.values():
         for k, v in per_rank.items():
@@ -208,6 +221,16 @@ def summarize(data: dict) -> dict:
         "cgx.async.route_",
     )
     for k in [k for k in totals if k.startswith(_ASYNC_GAUGE_PREFIXES)]:
+        del totals[k]
+    # Serving-plane gauges scrub the same way (tokens/s, pool_free and
+    # the SLO bit budget are levels — the serve section reports them
+    # max-folded from the exporter lines).
+    _SERVE_GAUGE_PREFIXES = (
+        "cgx.serve.tokens_per_s", "cgx.serve.batch_occupancy",
+        "cgx.serve.pool_free", "cgx.serve.slo_bits_budget",
+        "cgx.serve.send_backlog",
+    )
+    for k in [k for k in totals if k.startswith(_SERVE_GAUGE_PREFIXES)]:
         del totals[k]
     summary["counters"] = dict(totals)
     summary["faults"] = {
@@ -372,6 +395,38 @@ def summarize(data: dict) -> dict:
             ),
             "counters": async_counters,
         }
+    # Serving plane (PR 15): request/token throughput, TTFT quantiles
+    # (worst rank), KV-page traffic and the SLO controller's budget.
+    serve_counters = {
+        k: v for k, v in totals.items() if k.startswith("cgx.serve.")
+    }
+    if serve_counters or serve_gauges or serve_ttft:
+        kv_raw = totals.get("cgx.wire.bytes_raw.kv_page", 0.0)
+        kv_wire = totals.get("cgx.wire.bytes_wire.kv_page", 0.0)
+        summary["serve"] = {
+            "requests": int(
+                serve_counters.get("cgx.serve.requests_completed", 0)
+            ),
+            "tokens": int(
+                serve_counters.get("cgx.serve.tokens_generated", 0)
+            ),
+            "tokens_per_s": (
+                serve_gauges.get("cgx.serve.tokens_per_s") or None
+            ),
+            "ttft_ms": {k: round(v, 3) for k, v in serve_ttft.items()}
+            or None,
+            "kv_wire_ratio": (
+                round(kv_raw / kv_wire, 3) if kv_wire else None
+            ),
+            "prefill_failovers": int(
+                serve_counters.get("cgx.serve.prefill_failovers", 0)
+            ),
+            "slo_bits_budget": (
+                int(serve_gauges["cgx.serve.slo_bits_budget"])
+                if serve_gauges.get("cgx.serve.slo_bits_budget") else None
+            ),
+            "counters": serve_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -526,6 +581,36 @@ def render(summary: dict) -> str:
         if a.get("route_h"):
             parts.append(f"  planner route H: {a['route_h']}")
         for k, v in sorted(a.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("serve"):
+        s = summary["serve"]
+        parts.append("\n== serve (paged quantized KV serving plane) ==")
+        parts.append(
+            f"  requests completed: {s['requests']}  tokens: {s['tokens']}"
+        )
+        if s.get("tokens_per_s"):
+            parts.append(f"  tokens/s (EWMA): {s['tokens_per_s']:.2f}")
+        if s.get("ttft_ms"):
+            t = s["ttft_ms"]
+            parts.append(
+                "  ttft ms (worst rank): "
+                f"p50={t.get('p50', 0):.1f} p90={t.get('p90', 0):.1f} "
+                f"p99={t.get('p99', 0):.1f} n={int(t.get('count', 0))}"
+            )
+        if s.get("kv_wire_ratio"):
+            parts.append(
+                f"  kv page wire ratio: {s['kv_wire_ratio']:.2f}x"
+            )
+        if s.get("slo_bits_budget"):
+            parts.append(
+                f"  SLO controller bit budget: {s['slo_bits_budget']}"
+            )
+        if s.get("prefill_failovers"):
+            parts.append(
+                f"  prefill failovers: {s['prefill_failovers']} "
+                "(streams degraded to local prefill)"
+            )
+        for k, v in sorted(s.get("counters", {}).items()):
             parts.append(f"  {k}: {v:g}")
     if summary.get("codec"):
         c = summary["codec"]

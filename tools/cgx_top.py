@@ -215,6 +215,7 @@ def _wire_ratio(m: Dict[str, float]) -> str:
 _EDGE_ABBREV = {
     "moe_a2a": "moe", "ring_kv": "kv", "pp_act": "pp",
     "powersgd_factor": "psgd", "dp_grad": "dp", "xslice_delta": "xd",
+    "kv_page": "kvp",
 }
 
 
@@ -311,6 +312,24 @@ def _async_rate(m: Dict[str, float]) -> str:
     return f"{m.get('cgx.async.rounds_on_time', 0.0) / total * 100:.0f}%"
 
 
+def _serve_tps(m: Dict[str, float]) -> str:
+    """Serving throughput (``cgx.serve.tokens_per_s`` gauge — EWMA over
+    decode steps); ``-`` until the serving plane has generated."""
+    v = m.get("cgx.serve.tokens_per_s", 0.0)
+    if not v:
+        return "-"
+    return f"{v:.1f}"
+
+
+def _serve_ttft(m: Dict[str, float]) -> str:
+    """Time-to-first-token p50 in ms (``cgx.serve.ttft_ms`` histogram) —
+    the serving SLO controller's latency signal."""
+    v = m.get("cgx.serve.ttft_ms.p50")
+    if not isinstance(v, (int, float)) or not v:
+        return "-"
+    return f"{v:.0f}"
+
+
 def _straggler(status: Optional[dict]) -> str:
     scores = (status or {}).get("straggler_scores") or {}
     if not scores:
@@ -337,7 +356,8 @@ def render(directory: str, state: dict) -> str:
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
                "edges", "overlap", "sched$", "plan$", "pred", "atune$",
-               "roofl", "lag", "async$", "straggler", "gen", "last_fault")
+               "roofl", "lag", "async$", "tok/s", "ttft",
+               "straggler", "gen", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -357,6 +377,8 @@ def render(directory: str, state: dict) -> str:
             _roofline(m),
             _async_lag(m),
             _async_rate(m),
+            _serve_tps(m),
+            _serve_ttft(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
             _last_fault(d["last_fault"]),

@@ -131,6 +131,11 @@ session() {
   # process groups — never touches the device transport; resumable like
   # every other step (its marker skips it on re-runs).
   run_cpu 900 "async dcn plane" env JAX_PLATFORMS=cpu python bench.py --async-dcn --mb 8 --ws 4
+  # Serving plane (ISSUE 15): quantized-vs-raw-f16 KV shipping under a
+  # bandwidth-modeled prefill→decode wire — tokens/s + TTFT trajectories.
+  # Both children are CPU-pinned single-process runs; never touches the
+  # device transport, resumable like every other step.
+  run_cpu 900 "serve kv plane" env JAX_PLATFORMS=cpu python bench.py --serve
   # Unified wire plane (ISSUE 10): per-edge compressed-vs-raw records.
   # The child probes for real chips itself and falls back to a forced CPU
   # multi-device platform, so this step never wedges the device transport.

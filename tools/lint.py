@@ -54,6 +54,7 @@ check_schedule_stage_blocking = perfile.check_schedule_stage_blocking
 check_wire_edge_routing = perfile.check_wire_edge_routing
 check_planner_registry_ownership = perfile.check_planner_registry_ownership
 check_async_sender_blocking = perfile.check_async_sender_blocking
+check_serve_scheduler_blocking = perfile.check_serve_scheduler_blocking
 RULES = perfile.RULES
 
 

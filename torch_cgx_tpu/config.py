@@ -113,6 +113,17 @@ ASYNC_MAX_LAG = "CGX_ASYNC_MAX_LAG"  # bounded staleness, in outer rounds
 ASYNC_OUTER = "CGX_ASYNC_OUTER"  # outer optimizer: sgd | nesterov
 ASYNC_OUTER_LR = "CGX_ASYNC_OUTER_LR"  # outer learning rate
 ASYNC_OUTER_MOMENTUM = "CGX_ASYNC_OUTER_MOMENTUM"  # nesterov momentum
+# Serving data plane (torch_cgx_tpu/serving/ — PR 15): paged quantized
+# KV-cache wire for disaggregated prefill/decode with continuous batching.
+KV_BITS = "CGX_KV_BITS"  # kv_page wire width (0 = raw f16 shipping)
+KV_PAGE_TOKENS = "CGX_KV_PAGE_TOKENS"  # tokens per KV page (0 = planner)
+KV_SHIP_DEPTH = "CGX_KV_SHIP_DEPTH"  # prefill pages in flight (0 = planner)
+SERVE_MAX_BATCH = "CGX_SERVE_MAX_BATCH"  # decode lanes (continuous batching)
+SERVE_MAX_PAGES = "CGX_SERVE_MAX_PAGES"  # KV block-pool capacity, in pages
+SERVE_MAX_SEQ = "CGX_SERVE_MAX_SEQ"  # per-sequence KV capacity, in tokens
+SERVE_PREFILL_TIMEOUT_MS = "CGX_SERVE_PREFILL_TIMEOUT_MS"  # failover bound
+SERVE_TTFT_SLO_MS = "CGX_SERVE_TTFT_SLO_MS"  # SLO controller: TTFT target
+SERVE_TPS_SLO = "CGX_SERVE_TPS_SLO"  # SLO controller: tokens/s target
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -934,6 +945,117 @@ def async_outer_momentum() -> float:
     return v
 
 
+# ---------------------------------------------------------------------------
+# Serving data plane (PR 15 — docs/SERVING.md). All reads are re-read per
+# call like every other config accessor; the trace-affecting subset rides
+# ``trace_knob_fingerprint`` (and therefore every staged-program cache key,
+# the serving decode-program cache included) so a knob flip can never serve
+# a stale compiled decode step.
+# ---------------------------------------------------------------------------
+
+DEFAULT_KV_BITS = 8
+DEFAULT_KV_PAGE_TOKENS = 16
+DEFAULT_SERVE_MAX_BATCH = 8
+DEFAULT_SERVE_MAX_PAGES = 256
+DEFAULT_SERVE_MAX_SEQ = 256
+
+
+def kv_bits() -> int:
+    """CGX_KV_BITS: env-default max-min quantization width of the
+    ``kv_page`` wire edge — the KV-cache pages a prefill worker ships to
+    decode workers and the committed pages the decode scheduler's paged
+    attention reads. 0 = raw half-precision shipping (the f16 baseline
+    the serving bench contrasts against); 1..8 = quantize at that width.
+    A registered ``kv_page`` edge config (or the serving SLO controller's
+    writes) overrides this per layer — see ``serving/kv_cache.py``
+    ``resolve_kv_config``. Default 8: measured token-identical greedy
+    decode on the test model (tests/test_serving.py bit-envelope
+    suite)."""
+    v = _env.get_int_env_or_default(KV_BITS, DEFAULT_KV_BITS)
+    if v and not 1 <= v <= MAX_BITS:
+        raise ValueError(
+            f"{KV_BITS} must be 0 (raw f16) or 1..{MAX_BITS}, got {v}"
+        )
+    return v
+
+
+def kv_page_tokens() -> int:
+    """CGX_KV_PAGE_TOKENS: tokens per fixed-size KV page — the paged
+    allocator's block granularity and the transport's shipping unit.
+    0 (default) = let the planner pick from its serve cost curves
+    (``planner.solve_serve_plan``; ``DEFAULT_KV_PAGE_TOKENS`` when the
+    planner is off). Larger pages amortize per-page meta and store keys;
+    smaller pages waste less pool on ragged sequence tails."""
+    v = _env.get_int_env_or_default(KV_PAGE_TOKENS, 0)
+    return max(v, 0)
+
+
+def kv_ship_depth() -> int:
+    """CGX_KV_SHIP_DEPTH: how many prefill pages the transport sender
+    keeps in flight per stream before yielding the thread — the
+    pipelining depth of the prefill→decode hop. 0 (default) = planner
+    decides (``planner.solve_serve_plan``)."""
+    v = _env.get_int_env_or_default(KV_SHIP_DEPTH, 0)
+    return max(v, 0)
+
+
+def serve_max_batch() -> int:
+    """CGX_SERVE_MAX_BATCH: decode lanes of the continuous-batching
+    scheduler — the static batch dimension of the compiled decode step
+    (lanes admit/evict per step; inactive lanes are masked)."""
+    v = _env.get_int_env_or_default(SERVE_MAX_BATCH, DEFAULT_SERVE_MAX_BATCH)
+    return max(v, 1)
+
+
+def serve_max_pages() -> int:
+    """CGX_SERVE_MAX_PAGES: KV block-pool capacity in pages — the static
+    pool dimension of the compiled decode step. Admission blocks (and
+    ``cgx.serve.pool_exhausted`` counts) when the refcounted free list
+    runs dry."""
+    v = _env.get_int_env_or_default(SERVE_MAX_PAGES, DEFAULT_SERVE_MAX_PAGES)
+    return max(v, 1)
+
+
+def serve_max_seq() -> int:
+    """CGX_SERVE_MAX_SEQ: per-sequence KV capacity in tokens (prompt +
+    generated) — bounds the per-lane page-table width of the compiled
+    decode step."""
+    v = _env.get_int_env_or_default(SERVE_MAX_SEQ, DEFAULT_SERVE_MAX_SEQ)
+    return max(v, 1)
+
+
+def serve_prefill_timeout_ms() -> float:
+    """CGX_SERVE_PREFILL_TIMEOUT_MS: staleness bound on a prefill page
+    stream — a partially-delivered stream that stops advancing for this
+    long is declared dead and the scheduler FAILS OVER to a local
+    prefill (``cgx.serve.prefill_failovers``) instead of wedging the
+    admission queue; the recovery-ladder entry for the serving plane
+    (docs/SERVING.md "Prefill failover"). Host-side only — never baked
+    into a compiled program."""
+    v = _env.get_float_env_or_default(SERVE_PREFILL_TIMEOUT_MS, 2000.0)
+    return v if v > 0 else 2000.0
+
+
+def serve_ttft_slo_ms() -> Optional[float]:
+    """CGX_SERVE_TTFT_SLO_MS: time-to-first-token SLO the serving SLO
+    controller (``serving/slo.py``) re-solves KV bit-width against — a
+    ``cgx.serve.ttft_ms`` p90 above this target pushes the kv_page bit
+    budget DOWN (fewer wire bytes, faster admission). Unset/0 = no TTFT
+    objective. Host-side controller input, never traced."""
+    v = _env.get_float_env_or_default(SERVE_TTFT_SLO_MS, 0.0)
+    return v if v > 0 else None
+
+
+def serve_tps_slo() -> Optional[float]:
+    """CGX_SERVE_TPS_SLO: aggregate tokens-per-second SLO for the SLO
+    controller — a ``cgx.serve.tokens_per_s`` gauge below this target
+    pushes the kv_page bit budget down; comfortably above it (and under
+    the TTFT target) the budget recovers toward ``CGX_KV_BITS`` for
+    quality. Unset/0 = no throughput objective."""
+    v = _env.get_float_env_or_default(SERVE_TPS_SLO, 0.0)
+    return v if v > 0 else None
+
+
 def trace_knob_fingerprint() -> Tuple:
     """Every env knob a staged train-step program bakes in at TRACE time,
     in one hashable tuple — the env component of ``make_train_step``'s
@@ -971,6 +1093,17 @@ def trace_knob_fingerprint() -> Tuple:
         _env.get_optional_str_env(CODEC_ENCODE),
         _env.get_optional_str_env(PALLAS_PACK),
         _env.get_optional_str_env(PALLAS_TILE_CHUNKS),
+        # Serving plane (PR 15): the trace-affecting CGX_KV_*/CGX_SERVE_*
+        # subset — each is a static shape or codec width of the compiled
+        # decode-step program (serving/scheduler.py keys its program
+        # cache on this same fingerprint, the ISSUE 15 knob→key
+        # completeness requirement). Host-side serving knobs (failover
+        # timeout, SLO targets, ship depth) stay out: they never lower.
+        kv_bits(),
+        kv_page_tokens(),
+        serve_max_batch(),
+        serve_max_pages(),
+        serve_max_seq(),
     )
 
 

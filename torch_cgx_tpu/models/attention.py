@@ -41,6 +41,27 @@ def dense_attention(q, k, v, *, causal: bool = True, mask=None):
     return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
 
 
+def decode_attention(q, k, v, *, kv_mask):
+    """Single-position decode attention over a cached key/value window.
+
+    ``q``: (B, H, 1, D) — the lane's current token. ``k``/``v``:
+    (B, H, T, D) — the gathered KV window (committed paged tokens +
+    the raw tail, garbage beyond each lane's live length). ``kv_mask``:
+    bool (B, T), True = a live cached position. Causality is implied:
+    every live cached position precedes (or is) the query token, so the
+    mask IS the causal mask — no (S, S) tril materializes, which is the
+    point of decoding against a cache. f32 softmax like
+    :func:`dense_attention`.
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / np.float32(np.sqrt(d))
+    scores = jnp.where(kv_mask[:, None, None, :], scores, np.float32(-1e30))
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
 class MultiHeadAttention(nn.Module):
     """qkv projection -> heads -> ``attn_fn`` -> merge -> output projection.
 

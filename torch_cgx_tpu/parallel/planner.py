@@ -87,6 +87,14 @@ BITS_RANGE = (2, 8)
 ASYNC_H_CANDIDATES = (2, 4, 8, 16, 32, 64)
 ASYNC_DRIFT_FRAC = 0.01
 
+# Serving plane (PR 15): candidate page sizes / shipping depths the
+# serve solve considers (``CGX_KV_PAGE_TOKENS=0`` / ``CGX_KV_SHIP_DEPTH=0``
+# let the planner pick). Page meta overhead pulls page size UP; pool
+# fragmentation on ragged sequence tails pulls it down — modeled as half
+# a page of wasted pool per sequence.
+SERVE_PAGE_CANDIDATES = (8, 16, 32, 64)
+SERVE_DEPTH_CANDIDATES = (1, 2, 4, 8)
+
 
 # ---------------------------------------------------------------------------
 # The cost model.
@@ -401,6 +409,54 @@ class CostModel:
         exposed = max(0.0, t_wire - h * step) / h
         drift = ASYNC_DRIFT_FRAC * step * h
         return t_codec / h + exposed + drift
+
+
+    def predict_serve(
+        self,
+        prompt_tokens: int,
+        kv_token_bytes: int,
+        n_layers: int,
+        bits: int,
+        bucket: int,
+        page_tokens: int,
+        depth: int,
+    ) -> Tuple[float, float]:
+        """(predicted TTFT seconds, predicted per-page wire seconds) of
+        the disaggregated prefill→decode hop (PR 15):
+
+        * each page's payload is ``page_tokens * kv_token_bytes /
+          n_layers / 2`` f32 values per (layer, K|V) — ``2 * n_layers``
+          frames per page — priced by the codec's own wire-layout
+          formula at ``bits`` (raw f16 when uncompressed);
+        * pages pipeline at shipping depth ``depth``: quantize overlaps
+          the wire like a chunked collective (``predict_slice``'s
+          exposure model), each frame paying the fixed per-message
+          ``chunk_overhead_s``;
+        * TTFT is the full prompt's page stream through that pipe —
+          admission waits for the LAST page, so the stream is the
+          latency term the SLO controller's bit budget moves.
+        """
+        page_tokens = max(1, int(page_tokens))
+        depth = max(1, int(depth))
+        n_pages = max(1, -(-int(prompt_tokens) // page_tokens))
+        per_payload = page_tokens * kv_token_bytes / (2 * n_layers) / 4
+        frames = 2 * n_layers * n_pages
+        if 1 <= bits <= cfg_mod.MAX_BITS:
+            frame_b = self.wire_bytes(int(per_payload), bits, max(1, bucket))
+            t_codec = 4.0 * per_payload / (self.quantize_gbps * 1e9)
+        else:
+            frame_b = 2.0 * per_payload  # raw f16 shipping
+            t_codec = 0.0
+        t_wire_frame = frame_b / (self.wire_gbps * 1e9)
+        bottleneck = max(t_codec, t_wire_frame)
+        exposed = (t_codec + t_wire_frame - bottleneck) / depth
+        per_frame = bottleneck + exposed + self.chunk_overhead_s
+        # Half a page of pool waste per sequence, priced as the time to
+        # ship those bytes — the fragmentation term that keeps the solve
+        # from always picking the largest page.
+        waste = 0.5 * page_tokens / max(1, prompt_tokens)
+        ttft = frames * per_frame * (1.0 + waste)
+        return ttft, per_frame
 
 
 def _merge_intervals(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
@@ -984,6 +1040,71 @@ def async_route(
         model=model.source,
     )
     return route, h_best
+
+
+# ---------------------------------------------------------------------------
+# The serve plan (PR 15): page size + shipping depth from the cost model.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServePlan:
+    """The serving plane's planner decision."""
+
+    page_tokens: int
+    ship_depth: int
+    predicted_ttft_s: float
+    predicted_page_s: float
+
+
+def solve_serve_plan(
+    prompt_tokens: int,
+    kv_token_bytes: int,
+    n_layers: int,
+    bits: int,
+    bucket: int,
+    *,
+    model: Optional[CostModel] = None,
+) -> ServePlan:
+    """argmin of :meth:`CostModel.predict_serve` over the candidate
+    (page size, shipping depth) grid — the ``CGX_KV_PAGE_TOKENS=0`` /
+    ``CGX_KV_SHIP_DEPTH=0`` decision (``serving/scheduler.py
+    ServeConfig.from_env``). Ties prefer the smaller page and the
+    shallower depth (less pool fragmentation / fewer in-flight frames
+    for the same predicted TTFT). Host-side trace-time Python — nothing
+    here stages into a program beyond the shapes the decision sets (and
+    those shapes re-key the decode-program cache through the serving
+    knob fingerprint)."""
+    model = model or cost_model()
+    best: Optional[Tuple[float, int, int, float]] = None
+    for pt in SERVE_PAGE_CANDIDATES:
+        for depth in SERVE_DEPTH_CANDIDATES:
+            ttft, per_frame = model.predict_serve(
+                prompt_tokens, kv_token_bytes, n_layers, bits, bucket,
+                pt, depth,
+            )
+            if best is None or ttft < best[0] - 1e-15:
+                best = (ttft, pt, depth, per_frame)
+    assert best is not None
+    ttft, pt, depth, per_frame = best
+    metrics.set("cgx.plan.serve_page_tokens", float(pt))
+    metrics.set("cgx.plan.serve_ship_depth", float(depth))
+    metrics.set("cgx.plan.serve_pred_ttft_s", float(ttft))
+    from ..observability import flightrec
+
+    flightrec.record(
+        "serve_plan",
+        page_tokens=pt,
+        ship_depth=depth,
+        predicted_ttft_ms=round(ttft * 1e3, 3),
+        bits=int(bits),
+        prompt_tokens=int(prompt_tokens),
+        model=model.source,
+    )
+    return ServePlan(
+        page_tokens=pt, ship_depth=depth,
+        predicted_ttft_s=ttft, predicted_page_s=per_frame,
+    )
 
 
 # ---------------------------------------------------------------------------

@@ -160,6 +160,19 @@ def invalidate_trace_caches() -> None:
     wire_edges = sys.modules.get("torch_cgx_tpu.wire.edges")
     if wire_edges is not None:
         wire_edges.reset_edge_state("recovery reconfigure")
+    # Serving plane (PR 15): the decode-program LRU bakes page-pool
+    # geometry and per-layer kv_page wire specs, and every live
+    # PagedKvCache's page tables map sequences onto pool rows — both are
+    # dead-generation state after a reconfigure. The generation bump the
+    # page-table invalidation performs is what forces the scheduler to
+    # drop its lanes and re-prefill (a stale page mapping must never be
+    # gathered into a post-recovery decode step).
+    serving_sched = sys.modules.get("torch_cgx_tpu.serving.scheduler")
+    if serving_sched is not None:
+        serving_sched.invalidate_decode_cache("recovery reconfigure")
+    serving_kv = sys.modules.get("torch_cgx_tpu.serving.kv_cache")
+    if serving_kv is not None:
+        serving_kv.invalidate_page_tables("recovery reconfigure")
     # Topology classification memo: keyed on (mesh, axes, classifier fn),
     # none of which move when an eviction shrinks the world under an
     # unchanged mesh object — a stale hit can name an evicted rank as a

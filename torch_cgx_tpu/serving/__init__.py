@@ -1,0 +1,32 @@
+"""Serving data plane: paged quantized KV-cache wire for disaggregated
+prefill/decode with continuous batching (PR 15 — docs/SERVING.md).
+
+The training fabric's whole value proposition — fewer bytes per
+collective through bucketwise max-min quantization over a hardened
+two-level transport — applied to the latency-critical KV hop of
+inference:
+
+* :mod:`.kv_cache` — fixed-size page pool, per-sequence page tables,
+  refcounted free lists; pages quantized under the ``kv_page`` wire
+  edge kind.
+* :mod:`.transport` — disaggregated prefill→decode shipping of
+  quantized pages over the shm/store bridge with publish-after-write
+  counter streams (decode never blocks on prefill).
+* :mod:`.scheduler` — continuous-batching decode: admit/evict per step,
+  paged gather with the dequantize fused into the KV read, bounded
+  prefill-failover instead of wedging.
+* :mod:`.slo` — the WireController's serving objective: re-solve KV
+  bit-width per layer against TTFT / tokens-per-second SLOs from the
+  live metric stream.
+"""
+
+from .kv_cache import PagedKvCache, resolve_kv_config  # noqa: F401
+from .scheduler import (  # noqa: F401
+    ContinuousBatchScheduler,
+    GPT2Server,
+    Request,
+    ServeConfig,
+    invalidate_decode_cache,
+)
+from .slo import ServeSloController  # noqa: F401
+from .transport import KvPageReceiver, KvPageSender  # noqa: F401

@@ -1,0 +1,226 @@
+"""Paged KV-cache allocator: block pool, page tables, refcounted frees.
+
+The allocator is HOST-side bookkeeping over a device-resident pool
+(``ops/paged_kv.py`` owns the pool arrays and their codec): pages are
+fixed-size blocks identified by integer ids, a sequence's cache is an
+ordered page-id list (its page table), and a page is returned to the
+free list only when its refcount drains — shared-prefix sequences
+(``fork``) retain the same physical pages, the standard paged-attention
+economy (vLLM's PagedAttention, applied here to *quantized* pages so the
+pool and the prefill→decode wire share one byte layout).
+
+Wire treatment resolves through the unified wire plane's edge registry
+under the ``kv_page`` kind: a registered ``(kv_page, pattern)`` config —
+the serving SLO controller's write target — wins per layer; otherwise
+``CGX_KV_BITS`` is the env default (0 = raw f16 pages, the shipping
+baseline). ``CGX_WIRE=off`` forces every page raw, the same one-knob
+bisection story as every other edge kind.
+
+Recovery cascade (ISSUE 15 satellite): live caches register in a module
+WeakSet; ``supervisor.invalidate_trace_caches`` reaches
+:func:`invalidate_page_tables`, which bumps every live cache's
+generation and drops its page tables — a post-eviction scheduler can
+never serve a stale page mapping (the analyzer's cache-reachability
+pass proves the cascade edge).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+from ..utils.logging import get_logger, metrics
+from ..wire import edges
+
+log = get_logger()
+
+# Live caches, for the recovery cascade. Dead caches self-evict; each
+# member's page tables/generation reset through invalidate_page_tables.
+# cgx-analysis: allow(orphan-memo) — weak liveness set: the cascade resets every MEMBER's derived state (invalidate_page_tables below, reached from supervisor.invalidate_trace_caches); clearing the set itself would only disconnect live caches from future cascades
+_LIVE: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def resolve_kv_config(layer_name: str) -> Optional[CompressionConfig]:
+    """The wire treatment of this layer's KV pages, or None (raw f16).
+
+    Resolution order: ``CGX_WIRE=off`` -> raw (the bisection knob);
+    a registered ``kv_page`` edge config matching ``layer_name`` (the
+    SLO controller's write surface) -> its quantize cc; else the
+    ``CGX_KV_BITS`` env default (0 -> raw). Quantize-only, like the
+    all_to_all edges: low-rank/sparse peer compressors have no
+    cross-step structure to exploit in a one-shot page."""
+    if cfg_mod.wire_mode() == "off":
+        return None
+    ec = edges.resolve_edge(edges.EDGE_KV_PAGE, layer_name)
+    if ec is not None:
+        if ec.compressor != edges.COMPRESSOR_QUANTIZE:
+            raise ValueError(
+                f"edge ('kv_page', {layer_name!r}): compressor "
+                f"{ec.compressor!r} is unsupported; KV pages quantize only"
+            )
+        return ec.cc if ec.cc.enabled else None
+    bits = cfg_mod.kv_bits()
+    if not bits:
+        return None
+    return CompressionConfig(bits=bits, bucket_size=0).merged_with_default(
+        cfg_mod.default_compression_config()
+    )
+
+
+@dataclasses.dataclass
+class _SeqEntry:
+    pages: List[int]
+    tokens: int  # committed tokens (pages * page_tokens of the owner)
+
+
+class PagedKvCache:
+    """Page-id allocator + per-sequence page tables (thread-safe).
+
+    ``max_pages`` bounds the pool; ``page_tokens`` is the block
+    granularity. The pool ARRAYS live with the scheduler
+    (``ops/paged_kv.py`` pools) — this class owns which rows mean what.
+    """
+
+    def __init__(self, max_pages: int, page_tokens: int):
+        if max_pages < 1 or page_tokens < 1:
+            raise ValueError(
+                f"max_pages/page_tokens must be >= 1, got "
+                f"{max_pages}/{page_tokens}"
+            )
+        self.max_pages = int(max_pages)
+        self.page_tokens = int(page_tokens)
+        self.generation = 0
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(max_pages - 1, -1, -1))
+        self._refs: Dict[int, int] = {}
+        self._seqs: Dict[str, _SeqEntry] = {}
+        _LIVE.add(self)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def live_pages(self) -> int:
+        with self._lock:
+            return len(self._refs)
+
+    def refcount(self, page_id: int) -> int:
+        with self._lock:
+            return self._refs.get(int(page_id), 0)
+
+    def pages_of(self, seq_id: str) -> List[int]:
+        with self._lock:
+            e = self._seqs.get(seq_id)
+            return list(e.pages) if e is not None else []
+
+    def committed_tokens(self, seq_id: str) -> int:
+        with self._lock:
+            e = self._seqs.get(seq_id)
+            return e.tokens if e is not None else 0
+
+    def has_seq(self, seq_id: str) -> bool:
+        with self._lock:
+            return seq_id in self._seqs
+
+    # -- allocation --------------------------------------------------------
+
+    def alloc(self, seq_id: str) -> Optional[int]:
+        """Append one fresh page to ``seq_id``'s table (creating the
+        sequence on first use). None when the pool is exhausted — the
+        scheduler's admission backpressure, never an exception on the
+        decode path (``cgx.serve.pool_exhausted`` counts it)."""
+        with self._lock:
+            if not self._free:
+                metrics.add("cgx.serve.pool_exhausted")
+                return None
+            pid = self._free.pop()
+            self._refs[pid] = 1
+            e = self._seqs.setdefault(seq_id, _SeqEntry(pages=[], tokens=0))
+            e.pages.append(pid)
+            e.tokens += self.page_tokens
+            metrics.add("cgx.serve.pages_allocated")
+            metrics.set("cgx.serve.pool_free", float(len(self._free)))
+            return pid
+
+    def fork(self, src_seq: str, dst_seq: str) -> List[int]:
+        """Share ``src_seq``'s committed pages into a new sequence
+        (prefix reuse): every shared page's refcount bumps; the fork
+        COPIES the table, so the two sequences diverge from here (a
+        page appended to one never appears in the other)."""
+        with self._lock:
+            src = self._seqs.get(src_seq)
+            if src is None:
+                raise KeyError(f"unknown source sequence {src_seq!r}")
+            if dst_seq in self._seqs:
+                raise ValueError(f"sequence {dst_seq!r} already exists")
+            for pid in src.pages:
+                self._refs[pid] += 1
+            self._seqs[dst_seq] = _SeqEntry(
+                pages=list(src.pages), tokens=src.tokens
+            )
+            metrics.add("cgx.serve.seq_forks")
+            return list(src.pages)
+
+    def free_seq(self, seq_id: str) -> int:
+        """Release every page of ``seq_id`` (refcounted: shared pages
+        return to the free list only when the last holder drops).
+        Unknown sequences are a no-op (eviction paths race completion).
+        Returns the number of pages actually returned to the pool."""
+        with self._lock:
+            e = self._seqs.pop(seq_id, None)
+            if e is None:
+                return 0
+            freed = 0
+            for pid in e.pages:
+                n = self._refs.get(pid)
+                if n is None:
+                    raise RuntimeError(
+                        f"page {pid} of {seq_id!r} has no refcount — "
+                        "double free (allocator corruption)"
+                    )
+                if n <= 1:
+                    del self._refs[pid]
+                    self._free.append(pid)
+                    freed += 1
+                else:
+                    self._refs[pid] = n - 1
+            metrics.add("cgx.serve.pages_freed", float(freed))
+            metrics.set("cgx.serve.pool_free", float(len(self._free)))
+            return freed
+
+    # -- recovery ----------------------------------------------------------
+
+    def invalidate(self, reason: str = "invalidate") -> None:
+        """Drop every page table and refcount; bump the generation. The
+        post-recovery contract: page ids handed out before the bump name
+        pool rows whose contents a reconfigured group may have replaced,
+        so every mapping must re-derive (admitted sequences re-prefill —
+        the scheduler treats a generation bump as a full eviction)."""
+        with self._lock:
+            dropped = len(self._seqs)
+            self._seqs.clear()
+            self._refs.clear()
+            self._free = list(range(self.max_pages - 1, -1, -1))
+            self.generation += 1
+            metrics.add("cgx.serve.cache_invalidations")
+            metrics.set("cgx.serve.pool_free", float(self.max_pages))
+        log.info(
+            "serving kv-cache invalidated (%s): %d sequence(s) dropped, "
+            "generation -> %d", reason, dropped, self.generation,
+        )
+
+
+def invalidate_page_tables(reason: str = "reconfigure") -> None:
+    """Recovery-cascade entry point (``supervisor.invalidate_trace_caches``):
+    every live cache's page tables drop and its generation bumps, so no
+    scheduler can serve a pre-recovery page mapping."""
+    for cache in list(_LIVE):
+        cache.invalidate(reason)

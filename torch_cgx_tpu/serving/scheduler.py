@@ -1,0 +1,1199 @@
+"""Continuous-batching decode scheduler over the paged quantized KV pool.
+
+The decode worker runs ONE compiled step program: for every lane of a
+fixed ``CGX_SERVE_MAX_BATCH``-wide batch, gather the lane's committed KV
+pages (``ops/paged_kv.gather_dequant_pages`` — the dequantize staged
+immediately at the attention read, Pallas codec on TPU dispatch), attend
+the lane's current token against pages + the raw f32 tail block, and
+emit the greedy next token. Admission and eviction happen per step
+around that program (continuous batching): completed lanes free their
+pages back to the refcounted pool and a waiting request takes the lane
+on the next step — the batch never drains to refill.
+
+Requests arrive with their KV either computed here (local prefill — the
+colocated mode, also the FAILOVER path) or shipped by a disaggregated
+prefill worker over the :mod:`.transport` counter streams; decode polls
+those streams without ever blocking, and a stream that stalls past
+``CGX_SERVE_PREFILL_TIMEOUT_MS`` fails over to local prefill instead of
+wedging admission (``cgx.serve.prefill_failovers`` — the serving plane's
+recovery-ladder rung; docs/SERVING.md).
+
+The compiled decode/commit/prefill programs live in a module-level LRU
+(``_PROGRAM_CACHE``) keyed by :func:`_program_key` — model geometry,
+serve geometry, the per-layer resolved ``kv_page`` wire configs
+(registry-versioned) and ``config.trace_knob_fingerprint()``, so a knob
+flip or an SLO-controller re-solve can never hit a stale staged decode
+step (the ISSUE 14/15 knob→cache-key completeness contract; the cache is
+a declared analyzer surface). ``supervisor.invalidate_trace_caches``
+cascades into :func:`invalidate_decode_cache` and the page-table
+invalidation (``kv_cache.invalidate_page_tables``); the scheduler
+detects a bumped cache generation at the next step and re-derives every
+lane (running requests re-prefill — a stale page mapping is never
+served).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import OrderedDict
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import config as cfg_mod
+from ..models.attention import decode_attention, dense_attention
+from ..models.gpt2 import GPT2Config
+from ..ops import codec_host
+from ..ops import paged_kv
+from ..utils.logging import get_logger, metrics
+from ..wire import dispatch as wire_dispatch
+from . import kv_cache as kv_mod
+from . import transport as tp
+
+log = get_logger()
+
+_TPS_EWMA = 0.2  # tokens/s gauge smoothing
+
+
+# ---------------------------------------------------------------------------
+# Config + request surface.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Serving geometry (static shapes of the compiled decode step)."""
+
+    page_tokens: int
+    max_batch: int
+    max_pages: int
+    max_seq: int
+    ship_depth: int
+    eos_token: Optional[int] = None
+
+    def __post_init__(self):
+        if self.max_seq < self.page_tokens:
+            raise ValueError(
+                f"max_seq {self.max_seq} < page_tokens {self.page_tokens}"
+            )
+
+    @property
+    def pages_per_seq(self) -> int:
+        return -(-self.max_seq // self.page_tokens)
+
+    @classmethod
+    def from_env(cls, model_cfg: Optional[GPT2Config] = None,
+                 eos_token: Optional[int] = None) -> "ServeConfig":
+        """Knobs with the planner filling the zeros: ``CGX_KV_PAGE_TOKENS``
+        / ``CGX_KV_SHIP_DEPTH`` unset lets ``planner.solve_serve_plan``
+        pick page size and shipping depth from the serve cost curves
+        (model geometry needed for the per-token KV bytes; without a
+        model config the static defaults apply)."""
+        pt = cfg_mod.kv_page_tokens()
+        depth = cfg_mod.kv_ship_depth()
+        if (not pt or not depth) and model_cfg is not None:
+            from ..parallel import planner
+
+            kv_per_token = 2 * model_cfg.n_layer * model_cfg.d_model * 4
+            plan = planner.solve_serve_plan(
+                prompt_tokens=min(cfg_mod.serve_max_seq(), 128),
+                kv_token_bytes=kv_per_token,
+                n_layers=model_cfg.n_layer,
+                bits=cfg_mod.kv_bits(),
+                bucket=cfg_mod.default_compression_config().bucket_size,
+            )
+            pt = pt or plan.page_tokens
+            depth = depth or plan.ship_depth
+        return cls(
+            page_tokens=pt or cfg_mod.DEFAULT_KV_PAGE_TOKENS,
+            max_batch=cfg_mod.serve_max_batch(),
+            max_pages=cfg_mod.serve_max_pages(),
+            max_seq=cfg_mod.serve_max_seq(),
+            ship_depth=depth or tp.DEFAULT_SHIP_DEPTH,
+            eos_token=eos_token,
+        )
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request."""
+
+    id: str
+    tokens: List[int]  # prompt
+    max_new_tokens: int = 16
+    # -- filled by the scheduler --
+    output: List[int] = dataclasses.field(default_factory=list)
+    submitted_at: float = 0.0
+    first_token_at: Optional[float] = None
+    done: bool = False
+
+
+# ---------------------------------------------------------------------------
+# The GPT-2 adapter: explicit-parameter forward passes over the module's
+# own parameter tree (models/gpt2.py) — decode against the paged cache
+# needs per-layer K/V in and out, which the flax module doesn't expose.
+# ---------------------------------------------------------------------------
+
+
+def _ln(x, scale, bias, eps=1e-6):
+    """flax.linen.LayerNorm numerics (f32 stats, rsqrt, mean2 variance)."""
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    mean2 = (xf * xf).mean(-1, keepdims=True)
+    var = jnp.maximum(0.0, mean2 - mean * mean)
+    y = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return y * scale.astype(jnp.float32) + bias.astype(jnp.float32)
+
+
+def _dense(x, w, b, dtype):
+    y = x.astype(dtype) @ w.astype(dtype)
+    return y + b.astype(dtype) if b is not None else y
+
+
+class GPT2Server:
+    """Model adapter: prefill/decode forwards + serving geometry for one
+    (GPT2Config, params) pair. Dense-MLP decoder models only (the
+    serving plane's flagship path; MoE decode needs its own dispatch)."""
+
+    def __init__(self, model_cfg: GPT2Config, params,
+                 serve: Optional[ServeConfig] = None):
+        if model_cfg.n_experts:
+            raise ValueError("GPT2Server serves dense-MLP configs only")
+        self.cfg = model_cfg
+        self.p = params.get("params", params)
+        self.serve = serve or ServeConfig.from_env(model_cfg)
+        self.n_head = model_cfg.n_head
+        self.d_head = model_cfg.d_model // model_cfg.n_head
+
+    def layer_name(self, layer: int) -> str:
+        return f"layer_{layer}"
+
+    # -- forwards ----------------------------------------------------------
+
+    def _embed(self, tokens, positions):
+        wte = self.p["wte"]["embedding"]
+        wpe = self.p["wpe"]["embedding"]
+        x = wte[tokens] + wpe[positions]
+        return x.astype(self.cfg.dtype)
+
+    def _logits(self, x):
+        x = _ln(x, self.p["ln_f"]["scale"], self.p["ln_f"]["bias"])
+        wte = self.p["wte"]["embedding"].astype(jnp.float32)
+        return x.astype(jnp.float32) @ wte.T
+
+    def _block_tail(self, x, pl, attn_out):
+        """Shared post-attention half of a block: proj residual + MLP."""
+        dtype = self.cfg.dtype
+        ap = pl["attn"]["attn_proj"]
+        x = x + _dense(attn_out, ap["kernel"], ap.get("bias"), dtype)
+        y = _ln(x, pl["ln_2"]["scale"], pl["ln_2"]["bias"]).astype(dtype)
+        mi, mo = pl["mlp"]["mlp_in"], pl["mlp"]["mlp_out"]
+        h = jax.nn.gelu(_dense(y, mi["kernel"], mi.get("bias"), dtype))
+        return x + _dense(h, mo["kernel"], mo.get("bias"), dtype)
+
+    def _qkv(self, x, pl):
+        dtype = self.cfg.dtype
+        aq = pl["attn"]["attn_qkv"]
+        y = _ln(x, pl["ln_1"]["scale"], pl["ln_1"]["bias"]).astype(dtype)
+        qkv = _dense(y, aq["kernel"], aq.get("bias"), dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):  # (B, S, Dm) -> (B, H, S, Dh)
+            b, s, _ = t.shape
+            return t.reshape(b, s, self.n_head, self.d_head).transpose(
+                0, 2, 1, 3
+            )
+
+        return heads(q), heads(k), heads(v)
+
+    def prefill_forward(self, tokens, positions, last_idx):
+        """Full causal forward over a (right-padded) prompt, returning
+        the logits at ``last_idx`` and every layer's K/V.
+
+        tokens/positions: (B, S) int32 — S is the PADDED length
+        (prompts pad to a page multiple so distinct prompt lengths share
+        one compiled program; under causal attention right-padding
+        cannot perturb any earlier position's K/V or the ``last_idx``
+        logits). Returns (logits (B, vocab), ks, vs): each a list per
+        layer of (B, S, H, Dh) f32 — the cache payload the pages
+        quantize (callers slice off the pad)."""
+        x = self._embed(tokens, positions)
+        ks: List[jax.Array] = []
+        vs: List[jax.Array] = []
+        for layer in range(self.cfg.n_layer):
+            pl = self.p[f"h_{layer}"]
+            q, k, v = self._qkv(x, pl)
+            ks.append(k.transpose(0, 2, 1, 3).astype(jnp.float32))
+            vs.append(v.transpose(0, 2, 1, 3).astype(jnp.float32))
+            o = dense_attention(q, k, v, causal=True)
+            b, _, s, _ = o.shape
+            o = o.transpose(0, 2, 1, 3).reshape(b, s, self.cfg.d_model)
+            x = self._block_tail(x, pl, o)
+        x_last = jax.lax.dynamic_index_in_dim(x, last_idx, 1)
+        return self._logits(x_last)[:, -1], ks, vs
+
+    def decode_forward(self, state, specs: Tuple[paged_kv.PageSpec, ...]):
+        """One decode position against the paged cache: current tokens at
+        their positions, KV read = gathered committed pages (dequantized
+        at the consumer) + the raw tail with this token's K/V appended.
+        Returns (logits (B, vocab), new tail_k/tail_v lists)."""
+        cfg = self.cfg
+        pt = self.serve.page_tokens
+        p_dim = self.serve.pages_per_seq
+        x = self._embed(state["tokens"][:, None], state["pos"][:, None])
+        b = x.shape[0]
+        tail_idx = jnp.minimum(state["tail_len"], pt - 1)
+        onehot = (
+            jax.lax.broadcasted_iota(jnp.int32, (b, pt), 1)
+            == tail_idx[:, None]
+        )
+        committed = state["n_pages"] * pt
+        pos_c = jax.lax.broadcasted_iota(jnp.int32, (b, p_dim * pt), 1)
+        mask_c = pos_c < committed[:, None]
+        pos_t = jax.lax.broadcasted_iota(jnp.int32, (b, pt), 1)
+        mask_t = pos_t <= tail_idx[:, None]
+        kv_mask = jnp.concatenate([mask_c, mask_t], axis=1)
+        new_tk: List[jax.Array] = []
+        new_tv: List[jax.Array] = []
+        for layer in range(cfg.n_layer):
+            pl = self.p[f"h_{layer}"]
+            q, k, v = self._qkv(x, pl)  # (B, H, 1, Dh)
+            k_new = k[:, :, 0][:, None]  # (B, H, Dh) -> (B, 1, H, Dh)
+            v_new = v[:, :, 0][:, None]
+            sel = onehot[:, :, None, None]
+            tk = jnp.where(sel, k_new.astype(jnp.float32),
+                           state["tail_k"][layer])
+            tv = jnp.where(sel, v_new.astype(jnp.float32),
+                           state["tail_v"][layer])
+            new_tk.append(tk)
+            new_tv.append(tv)
+            pool = state["pools"][layer]
+            kc = paged_kv.gather_dequant_pages(
+                pool["k"], state["page_table"], specs[layer]
+            )
+            vc = paged_kv.gather_dequant_pages(
+                pool["v"], state["page_table"], specs[layer]
+            )
+            k_all = jnp.concatenate([kc, tk], axis=1).transpose(
+                0, 2, 1, 3
+            ).astype(cfg.dtype)
+            v_all = jnp.concatenate([vc, tv], axis=1).transpose(
+                0, 2, 1, 3
+            ).astype(cfg.dtype)
+            o = decode_attention(q, k_all, v_all, kv_mask=kv_mask)
+            o = o.transpose(0, 2, 1, 3).reshape(b, 1, cfg.d_model)
+            x = self._block_tail(x, pl, o)
+        return self._logits(x)[:, -1], new_tk, new_tv
+
+
+# ---------------------------------------------------------------------------
+# Resolved wire specs + the compiled-program LRU.
+# ---------------------------------------------------------------------------
+
+
+def _resolved_specs(server: GPT2Server) -> Tuple[paged_kv.PageSpec, ...]:
+    """Per-layer page specs under the CURRENT kv_page resolution: the
+    registered edge configs (the SLO controller's writes) or the
+    ``CGX_KV_BITS`` env default decide bits; the bucket is the resolved
+    config's (env-back-filled) bucket clipped to the page payload."""
+    flat = server.serve.page_tokens * server.cfg.d_model
+    specs = []
+    for layer in range(server.cfg.n_layer):
+        cc = kv_mod.resolve_kv_config(server.layer_name(layer))
+        if cc is None:
+            specs.append(paged_kv.PageSpec(
+                page_tokens=server.serve.page_tokens,
+                n_head=server.n_head, d_head=server.d_head,
+                bits=0, bucket_size=1,
+            ))
+        else:
+            specs.append(paged_kv.PageSpec(
+                page_tokens=server.serve.page_tokens,
+                n_head=server.n_head, d_head=server.d_head,
+                bits=cc.bits if cc.enabled else 0,
+                bucket_size=paged_kv.default_bucket(flat, cc.bucket_size),
+            ))
+    return tuple(specs)
+
+
+def _program_key(server: GPT2Server) -> Tuple:
+    """Everything the compiled serving programs bake in: model + serve
+    geometry, the per-layer resolved wire specs (covering the edge
+    registry through both the resolved values AND the registry version —
+    a re-registration that resolves identically keeps the key), and the
+    trace-affecting env knobs (``trace_knob_fingerprint`` carries the
+    CGX_KV_*/CGX_SERVE_* serving subset plus the codec-lowering knobs
+    the staged dequantize consumes)."""
+    cfg = server.cfg
+    return (
+        (cfg.n_layer, cfg.n_head, cfg.d_model, cfg.vocab_size,
+         cfg.max_seq, str(cfg.dtype)),
+        (server.serve.page_tokens, server.serve.max_batch,
+         server.serve.max_pages, server.serve.max_seq),
+        _resolved_specs(server),
+        cfg_mod.registry_version(),
+        cfg_mod.trace_knob_fingerprint(),
+    )
+
+
+_PROGRAM_CACHE: "OrderedDict" = OrderedDict()
+_PROGRAM_CACHE_MAX = 8
+
+
+def invalidate_decode_cache(reason: str = "reconfigure") -> None:
+    """Invalidation entry point — cascaded from
+    ``supervisor.invalidate_trace_caches``: compiled decode/commit/
+    prefill programs bake page-pool geometry and wire specs that a
+    recovery reconfiguration may have replaced."""
+    _PROGRAM_CACHE.clear()
+    metrics.add("cgx.serve.program_invalidations")
+    log.info("serving decode-program cache invalidated (%s)", reason)
+
+
+def _decode_program(server: GPT2Server) -> SimpleNamespace:
+    """The compiled serving programs for this server's current key —
+    from the LRU, building on miss."""
+    key = _program_key(server)
+    prog = _PROGRAM_CACHE.get(key)
+    if prog is not None:
+        _PROGRAM_CACHE.move_to_end(key)
+        metrics.add("cgx.serve.program_cache_hits")
+        return prog
+    metrics.add("cgx.serve.program_cache_misses")
+    prog = _build_programs(server)
+    _PROGRAM_CACHE[key] = prog
+    while len(_PROGRAM_CACHE) > _PROGRAM_CACHE_MAX:
+        _PROGRAM_CACHE.popitem(last=False)
+    return prog
+
+
+def _build_programs(server: GPT2Server) -> SimpleNamespace:
+    specs = _resolved_specs(server)
+    sv = server.serve
+
+    def decode_step(params, state):
+        srv = GPT2Server(server.cfg, params, sv)
+        logits, new_tk, new_tv = srv.decode_forward(state, specs)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        out = dict(state)
+        out["tail_k"] = tuple(new_tk)
+        out["tail_v"] = tuple(new_tv)
+        out["tail_len"] = jnp.where(
+            state["active"], state["tail_len"] + 1, state["tail_len"]
+        )
+        out["pos"] = jnp.where(state["active"], state["pos"] + 1,
+                               state["pos"])
+        out["tokens"] = jnp.where(state["active"], nxt, state["tokens"])
+        return out, nxt
+
+    def commit(state, commit_mask, page_ids):
+        """Promote full tails into pool pages: quantize every lane's
+        tail rows, scatter only the committing lanes' rows (others land
+        in the scratch row — pools carry ``max_pages + 1`` rows so the
+        masked scatter needs no dynamic shapes)."""
+        b = commit_mask.shape[0]
+        ids = jnp.where(commit_mask, page_ids, sv.max_pages)
+        out = dict(state)
+        pools = []
+        for layer in range(server.cfg.n_layer):
+            pool = state["pools"][layer]
+            rows_k = state["tail_k"][layer].reshape(b, -1)
+            rows_v = state["tail_v"][layer].reshape(b, -1)
+            pools.append({
+                "k": paged_kv.commit_page_rows(
+                    pool["k"], ids, rows_k, specs[layer]
+                ),
+                "v": paged_kv.commit_page_rows(
+                    pool["v"], ids, rows_v, specs[layer]
+                ),
+            })
+        out["pools"] = tuple(pools)
+        p_iota = jax.lax.broadcasted_iota(
+            jnp.int32, state["page_table"].shape, 1
+        )
+        slot = (p_iota == state["n_pages"][:, None]) & commit_mask[:, None]
+        out["page_table"] = jnp.where(
+            slot, page_ids[:, None], state["page_table"]
+        )
+        out["n_pages"] = state["n_pages"] + commit_mask.astype(jnp.int32)
+        out["tail_len"] = jnp.where(commit_mask, 0, state["tail_len"])
+        return out
+
+    def ingest(pools, layer_rows_k, layer_rows_v, ids):
+        """Batch-write received/locally-prefetched page payload rows
+        (n, flat) into pool rows ``ids (n,)`` for every layer — the
+        stream-completion path (payloads already in pool layout when
+        quantized)."""
+        out = []
+        for layer in range(server.cfg.n_layer):
+            pool = pools[layer]
+            out.append({
+                "k": _ingest_pool(
+                    pool["k"], ids, layer_rows_k[layer], specs[layer]
+                ),
+                "v": _ingest_pool(
+                    pool["v"], ids, layer_rows_v[layer], specs[layer]
+                ),
+            })
+        return tuple(out)
+
+    def prefill(params, tokens, positions, last_idx):
+        srv = GPT2Server(server.cfg, params, sv)
+        logits, ks, vs = srv.prefill_forward(tokens, positions, last_idx)
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), ks, vs
+
+    return SimpleNamespace(
+        specs=specs,
+        decode_step=jax.jit(decode_step, donate_argnums=(1,)),
+        commit=jax.jit(commit, donate_argnums=(0,)),
+        ingest=jax.jit(ingest, donate_argnums=(0,)),
+        prefill=jax.jit(prefill),
+    )
+
+
+def _ingest_pool(pool, ids, rows, spec: paged_kv.PageSpec):
+    """Scatter pre-encoded pool rows: quantized rows arrive as (packed,
+    meta) pairs (the transport's wire layout IS the pool layout), raw
+    rows as f32 payloads."""
+    if not spec.quantized:
+        pages = rows.reshape(
+            -1, spec.page_tokens, spec.n_head, spec.d_head
+        ).astype(jnp.float16)
+        return pool.at[ids].set(pages)
+    packed, meta = pool
+    rows_packed, rows_meta = rows
+    return (
+        packed.at[ids].set(rows_packed),
+        meta.at[ids].set(rows_meta),
+    )
+
+
+# ---------------------------------------------------------------------------
+# The scheduler.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class _Ready:
+    """A request whose KV is fully ingested, waiting for a lane."""
+
+    req: Request
+    page_ids: List[int]
+    tail_k: np.ndarray  # (L, page_tokens, H, Dh) f32
+    tail_v: np.ndarray
+    tail_len: int
+    first_token: int
+    pos: int
+
+
+class ContinuousBatchScheduler:
+    """Admit/evict-per-step decode over one :class:`GPT2Server`.
+
+    ``receiver`` (optional :class:`~.transport.KvPageReceiver`) is the
+    disaggregated mode: ``submit(req, remote=True)`` registers the
+    request's page stream and admission waits (without blocking — the
+    poll is a counter read) for the prefill worker's frames. Without a
+    receiver — or when a stream stalls past the failover bound — the
+    scheduler prefills locally. ``step()`` never blocks; ``run()`` is
+    the bounded convenience loop.
+    """
+
+    def __init__(
+        self,
+        server: GPT2Server,
+        *,
+        receiver: Optional[tp.KvPageReceiver] = None,
+    ):
+        self.server = server
+        sv = server.serve
+        self._receiver = receiver
+        self.cache = kv_mod.PagedKvCache(sv.max_pages, sv.page_tokens)
+        self._cache_gen = self.cache.generation
+        self._prog = _decode_program(server)
+        self._prog_key = _program_key(server)
+        self._state = self._fresh_state()
+        self._lanes: List[Optional[Request]] = [None] * sv.max_batch
+        self._waiting: List[Request] = []  # local-prefill queue
+        self._remote: "OrderedDict[str, Request]" = OrderedDict()
+        self._ready: List[_Ready] = []
+        self._frames: Dict[str, List[tp.PageFrame]] = {}
+        self._done: List[Request] = []
+        self._rekey_pending = False
+        self._tokens_total = 0
+        self._last_step_t: Optional[float] = None
+        self._tps = 0.0
+
+    # -- state plumbing ----------------------------------------------------
+
+    def _fresh_state(self) -> Dict:
+        sv = self.server.serve
+        specs = self._prog.specs
+        b, pt = sv.max_batch, sv.page_tokens
+        h, d = self.server.n_head, self.server.d_head
+        pools = tuple(
+            {
+                # +1 row: the masked-commit scratch row (see commit()).
+                "k": paged_kv.empty_pool(sv.max_pages + 1, specs[i]),
+                "v": paged_kv.empty_pool(sv.max_pages + 1, specs[i]),
+            }
+            for i in range(self.server.cfg.n_layer)
+        )
+        zeros_tail = tuple(
+            jnp.zeros((b, pt, h, d), jnp.float32)
+            for _ in range(self.server.cfg.n_layer)
+        )
+        return {
+            "pools": pools,
+            "tail_k": zeros_tail,
+            "tail_v": tuple(
+                jnp.zeros((b, pt, h, d), jnp.float32)
+                for _ in range(self.server.cfg.n_layer)
+            ),
+            "page_table": jnp.full(
+                (b, sv.pages_per_seq), -1, jnp.int32
+            ),
+            "n_pages": jnp.zeros((b,), jnp.int32),
+            "tail_len": jnp.zeros((b,), jnp.int32),
+            "tokens": jnp.zeros((b,), jnp.int32),
+            "pos": jnp.zeros((b,), jnp.int32),
+            "active": jnp.zeros((b,), bool),
+        }
+
+    def _maybe_rebuild(self) -> None:
+        """Program-era and cache-generation checks, once per step.
+
+        A cache-generation bump (the recovery cascade) drops every lane
+        IMMEDIATELY — page mappings from the old generation must never
+        be gathered again, whatever it costs the in-flight requests.
+
+        A program re-key (knob flip / SLO re-solve) adopts at a DRAIN
+        point instead: admission pauses, active lanes finish their
+        generations under the old program, and only then do the pools
+        and programs rebuild — pages quantized at two widths never mix
+        inside one sequence, and no lane loses generated tokens to a
+        bit-budget move (the slo.py adoption contract)."""
+        if self.cache.generation != self._cache_gen:
+            self._cache_gen = self.cache.generation
+            self._rekey_pending = False
+            self._evict_all_to_queue("cache generation bump")
+        key = _program_key(self.server)
+        if key != self._prog_key:
+            if any(r is not None for r in self._lanes):
+                if not self._rekey_pending:
+                    self._rekey_pending = True
+                    metrics.add("cgx.serve.rekey_drains")
+                    log.info(
+                        "serving scheduler: program re-key pending — "
+                        "draining active lanes before adoption"
+                    )
+                return
+            self._rekey_pending = False
+            self._prog_key = key
+            self._prog = _decode_program(self.server)
+            self._evict_all_to_queue("program re-key")
+            metrics.add("cgx.serve.bits_adoptions")
+        else:
+            self._rekey_pending = False
+
+    def _requeue(self, req: Request) -> None:
+        """Return a request to the waiting queue for a full re-prefill,
+        releasing its pool pages (free_seq is a no-op when the cache
+        generation bump already dropped the tables)."""
+        self.cache.free_seq(req.id)
+        req.output.clear()
+        req.first_token_at = None
+        self._waiting.insert(0, req)
+
+    def _evict_all_to_queue(self, reason: str) -> None:
+        requeued = 0
+        for lane, req in enumerate(self._lanes):
+            if req is not None and not req.done:
+                self._requeue(req)
+                requeued += 1
+            self._lanes[lane] = None
+        for r in self._ready:
+            if not r.req.done:
+                self._requeue(r.req)
+                requeued += 1
+        self._ready.clear()
+        self._frames.clear()
+        for stream, req in list(self._remote.items()):
+            # In-flight remote streams describe pool rows of the dead
+            # era; fail them over to local prefill — and drop the
+            # receiver's stream state, or its late frames would keep
+            # accumulating (and costing poll round-trips) forever.
+            if self._receiver is not None:
+                self._receiver.drop_stream(stream)
+            self._requeue(req)
+            self._remote.pop(stream)
+            requeued += 1
+        self._state = self._fresh_state()
+        if requeued:
+            log.info(
+                "serving scheduler reset (%s): %d request(s) requeued "
+                "for re-prefill", reason, requeued,
+            )
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, req: Request, *, remote: bool = False) -> None:
+        """Queue a request. ``remote=True`` expects a prefill worker to
+        ship the KV stream named by ``req.id`` (requires a receiver);
+        otherwise the scheduler prefills locally at admission."""
+        req.submitted_at = time.monotonic()
+        metrics.add("cgx.serve.requests_submitted")
+        if remote:
+            if self._receiver is None:
+                raise ValueError(
+                    "remote submission needs a KvPageReceiver"
+                )
+            self._receiver.add_stream(req.id)
+            self._remote[req.id] = req
+        else:
+            self._waiting.append(req)
+
+    def outstanding(self) -> int:
+        return (
+            len(self._waiting)
+            + len(self._remote)
+            + len(self._ready)
+            + sum(1 for r in self._lanes if r is not None)
+        )
+
+    @property
+    def completed(self) -> List[Request]:
+        return list(self._done)
+
+    # -- the per-step pipeline --------------------------------------------
+
+    def step(self) -> bool:
+        """One scheduler tick: drain transport, fail over stalled
+        streams, admit, commit full tails, decode one token for every
+        active lane, evict completed lanes. Returns whether anything
+        progressed (the run loop's idle-sleep signal). NEVER blocks."""
+        self._maybe_rebuild()
+        progressed = self._drain_transport()
+        progressed |= self._failover_stalled()
+        progressed |= self._admit()
+        progressed |= self._decode()
+        return progressed
+
+    def run(self, *, deadline_s: float = 120.0,
+            idle_sleep_s: float = 0.002) -> bool:
+        """Bounded convenience loop: step until every submitted request
+        completes or the deadline passes (False = timed out with work
+        outstanding — the caller decides whether that is an error)."""
+        deadline = time.monotonic() + deadline_s
+        while self.outstanding() and time.monotonic() < deadline:
+            if not self.step():
+                time.sleep(idle_sleep_s)
+        return not self.outstanding()
+
+    # -- transport ingest --------------------------------------------------
+
+    def _drain_transport(self) -> bool:
+        if self._receiver is None:
+            return False
+        progressed = False
+        for stream, frame in self._receiver.poll():
+            self._frames.setdefault(stream, []).append(frame)
+            progressed = True
+        for stream in [s for s in self._remote if
+                       self._receiver.complete(s)]:
+            req = self._remote.pop(stream)
+            frames = self._frames.pop(stream, [])
+            meta = self._receiver.meta(stream) or {}
+            self._receiver.drop_stream(stream)
+            try:
+                self._ingest_stream(req, meta, frames)
+            except Exception as e:
+                metrics.add("cgx.serve.ingest_errors")
+                log.warning(
+                    "serving: stream %s ingest failed (%s); failing over "
+                    "to local prefill", stream, e,
+                )
+                # Pages allocated before the failure must not stay
+                # mapped to a sequence that will re-prefill from scratch
+                # (free_seq is a no-op when nothing was allocated).
+                self.cache.free_seq(req.id)
+                self._waiting.insert(0, req)
+            progressed = True
+        return progressed
+
+    def _failover_stalled(self) -> bool:
+        if self._receiver is None or not self._remote:
+            return False
+        timeout_s = cfg_mod.serve_prefill_timeout_ms() / 1e3
+        progressed = False
+        for stream in [s for s in self._remote
+                       if self._receiver.stalled(s, timeout_s)]:
+            req = self._remote.pop(stream)
+            self._frames.pop(stream, None)
+            self._receiver.drop_stream(stream)
+            metrics.add("cgx.serve.prefill_failovers")
+            from ..observability import flightrec
+
+            flightrec.record(
+                "serve_prefill_failover", stream=stream,
+                timeout_ms=timeout_s * 1e3,
+            )
+            log.warning(
+                "serving: prefill stream %s stalled > %.0f ms — failing "
+                "over to local prefill (degraded, not wedged)",
+                stream, timeout_s * 1e3,
+            )
+            self._waiting.insert(0, req)
+            progressed = True
+        return progressed
+
+    def _ingest_stream(self, req: Request, meta: Dict,
+                       frames: Sequence[tp.PageFrame]) -> None:
+        """Turn a completed page stream into a ready lane payload: pool
+        rows written in one batched scatter per layer, tail + first
+        token from the META frame."""
+        specs = self._prog.specs
+        cfg = self.server.cfg
+        sv = self.server.serve
+        pt = sv.page_tokens
+        h, d = self.server.n_head, self.server.d_head
+        n_pages = int(meta["pages"])
+        if int(meta.get("page_tokens", pt)) != pt:
+            raise ValueError(
+                f"stream page_tokens {meta.get('page_tokens')} != "
+                f"serving {pt}"
+            )
+        page_ids: List[int] = []
+        for _ in range(n_pages):
+            pid = self.cache.alloc(req.id)
+            if pid is None:
+                self.cache.free_seq(req.id)
+                raise RuntimeError("KV pool exhausted during ingest")
+            page_ids.append(pid)
+        rows_k: List[List] = [[None] * n_pages for _ in range(cfg.n_layer)]
+        rows_v: List[List] = [[None] * n_pages for _ in range(cfg.n_layer)]
+        tail_k = np.zeros((cfg.n_layer, pt, h, d), np.float32)
+        tail_v = np.zeros((cfg.n_layer, pt, h, d), np.float32)
+        tail_len = int(meta.get("tail_tokens", 0))
+        for f in frames:
+            if f.is_meta:
+                continue
+            spec = specs[f.layer]
+            if f.kind in (tp.K_PAGE, tp.V_PAGE):
+                if f.bits != spec.bits or (
+                    spec.quantized and f.bucket != spec.bucket_size
+                ):
+                    raise ValueError(
+                        f"stream layer {f.layer} page wire spec "
+                        f"(bits={f.bits}, bucket={f.bucket}) does not "
+                        f"match the serving spec (bits={spec.bits}, "
+                        f"bucket={spec.bucket_size}) — prefill and "
+                        "decode must resolve the same kv_page configs"
+                    )
+                row = _decode_page_payload(f, spec)
+                (rows_k if f.kind == tp.K_PAGE else rows_v)[
+                    f.layer][f.page_idx] = row
+            else:  # tail
+                vals = np.frombuffer(f.payload, np.float16).astype(
+                    np.float32
+                ).reshape(-1, h, d)
+                t = (tail_k if f.kind == tp.K_TAIL else tail_v)
+                t[f.layer, : vals.shape[0]] = vals
+        if n_pages:
+            layer_rows_k = [_stack_rows(rows_k[i], specs[i])
+                            for i in range(cfg.n_layer)]
+            layer_rows_v = [_stack_rows(rows_v[i], specs[i])
+                            for i in range(cfg.n_layer)]
+            ids = jnp.asarray(page_ids, jnp.int32)
+            self._state = dict(
+                self._state,
+                pools=self._prog.ingest(
+                    self._state["pools"], layer_rows_k, layer_rows_v, ids
+                ),
+            )
+        for layer in range(cfg.n_layer):
+            spec = specs[layer]
+            _account_pages(
+                self.server.layer_name(layer), spec, 2 * n_pages
+            )
+        metrics.add("cgx.serve.pages_ingested", float(n_pages))
+        self._ready.append(_Ready(
+            req=req,
+            page_ids=page_ids,
+            tail_k=tail_k,
+            tail_v=tail_v,
+            tail_len=tail_len,
+            first_token=int(meta["first_token"]),
+            pos=int(meta["prompt_tokens"]),
+        ))
+
+    # -- local prefill (colocated mode + the failover rung) ---------------
+
+    def _local_prefill(self, req: Request) -> Optional[_Ready]:
+        sv = self.server.serve
+        cfg = self.server.cfg
+        pt = sv.page_tokens
+        prompt = np.asarray(req.tokens, np.int32)
+        s = prompt.shape[0]
+        if s < 1 or s + req.max_new_tokens > sv.max_seq:
+            raise ValueError(
+                f"request {req.id!r}: prompt {s} + max_new "
+                f"{req.max_new_tokens} exceeds CGX_SERVE_MAX_SEQ "
+                f"{sv.max_seq}"
+            )
+        n_full = s // pt
+        pids: List[int] = []
+        for _ in range(n_full):
+            pid = self.cache.alloc(req.id)
+            if pid is None:
+                self.cache.free_seq(req.id)
+                return None  # pool pressure: stay queued
+            pids.append(pid)
+        try:
+            return self._local_prefill_compute(req, n_full, pids, s)
+        except BaseException:
+            # A prefill failure (jit error, bad prompt) must release the
+            # pages it reserved — the request re-enters the queue or
+            # errors out, either way without pinning pool rows.
+            self.cache.free_seq(req.id)
+            raise
+
+    def _local_prefill_compute(
+        self, req: Request, n_full: int, pids: List[int], s: int
+    ) -> _Ready:
+        sv = self.server.serve
+        cfg = self.server.cfg
+        pt = sv.page_tokens
+        prompt = np.asarray(req.tokens, np.int32)
+        t0 = time.perf_counter()
+        padded = _pad_prompt(prompt, pt)
+        first, ks, vs = self._prog.prefill(
+            self.server.p, padded[None],
+            np.arange(padded.shape[0], dtype=np.int32)[None],
+            np.int32(s - 1),
+        )
+        h, d = self.server.n_head, self.server.d_head
+        tail_len = s - n_full * pt
+        tail_k = np.zeros((cfg.n_layer, pt, h, d), np.float32)
+        tail_v = np.zeros((cfg.n_layer, pt, h, d), np.float32)
+        if n_full:
+            ids = jnp.asarray(pids, jnp.int32)
+            layer_rows_k = []
+            layer_rows_v = []
+            for layer in range(cfg.n_layer):
+                spec = self._prog.specs[layer]
+                k_full = ks[layer][0, : n_full * pt].reshape(n_full, -1)
+                v_full = vs[layer][0, : n_full * pt].reshape(n_full, -1)
+                if spec.quantized:
+                    layer_rows_k.append(
+                        paged_kv.quantize_page_rows(k_full, spec)
+                    )
+                    layer_rows_v.append(
+                        paged_kv.quantize_page_rows(v_full, spec)
+                    )
+                    _observe_page_qerr(
+                        self.server.layer_name(layer), spec, k_full
+                    )
+                else:
+                    layer_rows_k.append(k_full)
+                    layer_rows_v.append(v_full)
+                _account_pages(
+                    self.server.layer_name(layer), spec, 2 * n_full
+                )
+            self._state = dict(
+                self._state,
+                pools=self._prog.ingest(
+                    self._state["pools"], layer_rows_k, layer_rows_v, ids
+                ),
+            )
+        for layer in range(cfg.n_layer):
+            if tail_len:
+                tail_k[layer, :tail_len] = np.asarray(
+                    ks[layer][0, n_full * pt: s]
+                )
+                tail_v[layer, :tail_len] = np.asarray(
+                    vs[layer][0, n_full * pt: s]
+                )
+        metrics.observe(
+            "cgx.serve.prefill_s", time.perf_counter() - t0
+        )
+        metrics.add("cgx.serve.local_prefills")
+        return _Ready(
+            req=req, page_ids=pids, tail_k=tail_k, tail_v=tail_v,
+            tail_len=tail_len, first_token=int(first[0]), pos=s,
+        )
+
+    # -- admission / eviction ---------------------------------------------
+
+    def _free_lanes(self) -> List[int]:
+        return [i for i, r in enumerate(self._lanes) if r is None]
+
+    def _admit(self) -> bool:
+        if self._rekey_pending:
+            return False  # draining toward a program re-key: no admits
+        progressed = False
+        free = self._free_lanes()
+        # Prefill-ahead is bounded by the lanes that could actually take
+        # the result this step: one free lane must not trigger a
+        # whole-queue prefill burst (which would hold pool pages for
+        # requests that cannot run yet and inflate every TTFT behind
+        # the synchronous forwards).
+        while self._waiting and len(self._ready) < len(free):
+            req = self._waiting.pop(0)
+            try:
+                ready = self._local_prefill(req)
+            except Exception as e:
+                metrics.add("cgx.serve.request_errors")
+                log.warning("serving: request %s failed prefill: %s",
+                            req.id, e)
+                req.done = True
+                self._done.append(req)
+                progressed = True
+                continue
+            if ready is None:
+                self._waiting.insert(0, req)  # pool pressure
+                break
+            self._ready.append(ready)
+            progressed = True
+        while free and self._ready:
+            lane = free.pop(0)
+            ready = self._ready.pop(0)
+            self._admit_lane(lane, ready)
+            progressed = True
+        return progressed
+
+    def _admit_lane(self, lane: int, ready: _Ready) -> None:
+        sv = self.server.serve
+        req = ready.req
+        st = self._state
+        padded = np.full((sv.pages_per_seq,), -1, np.int32)
+        padded[: len(ready.page_ids)] = ready.page_ids
+        st["page_table"] = st["page_table"].at[lane].set(padded)
+        st["n_pages"] = st["n_pages"].at[lane].set(len(ready.page_ids))
+        st["tail_len"] = st["tail_len"].at[lane].set(ready.tail_len)
+        st["tokens"] = st["tokens"].at[lane].set(ready.first_token)
+        st["pos"] = st["pos"].at[lane].set(ready.pos)
+        st["active"] = st["active"].at[lane].set(True)
+        st["tail_k"] = tuple(
+            st["tail_k"][i].at[lane].set(ready.tail_k[i])
+            for i in range(self.server.cfg.n_layer)
+        )
+        st["tail_v"] = tuple(
+            st["tail_v"][i].at[lane].set(ready.tail_v[i])
+            for i in range(self.server.cfg.n_layer)
+        )
+        self._lanes[lane] = req
+        # The prefill's own argmax IS the first generated token — the
+        # disaggregated convention: TTFT is admission, not first decode.
+        now = time.monotonic()
+        req.output.append(ready.first_token)
+        req.first_token_at = now
+        metrics.observe(
+            "cgx.serve.ttft_ms", (now - req.submitted_at) * 1e3
+        )
+        metrics.add("cgx.serve.requests_admitted")
+        self._note_tokens(1)
+        if len(req.output) >= req.max_new_tokens or (
+            sv.eos_token is not None and ready.first_token == sv.eos_token
+        ):
+            self._finish_lane(lane)
+
+    def _finish_lane(self, lane: int) -> None:
+        req = self._lanes[lane]
+        assert req is not None
+        self.cache.free_seq(req.id)
+        req.done = True
+        self._done.append(req)
+        self._lanes[lane] = None
+        st = self._state
+        st["active"] = st["active"].at[lane].set(False)
+        st["n_pages"] = st["n_pages"].at[lane].set(0)
+        st["tail_len"] = st["tail_len"].at[lane].set(0)
+        st["page_table"] = st["page_table"].at[lane].set(
+            np.full((self.server.serve.pages_per_seq,), -1, np.int32)
+        )
+        metrics.add("cgx.serve.requests_completed")
+
+    # -- decode ------------------------------------------------------------
+
+    def _decode(self) -> bool:
+        active = [i for i, r in enumerate(self._lanes) if r is not None]
+        if not active:
+            return False
+        sv = self.server.serve
+        st = self._state
+        # Promote full tails first so every lane has tail room.
+        tail_len = np.asarray(st["tail_len"])
+        full = [
+            i for i in active
+            if tail_len[i] >= sv.page_tokens
+        ]
+        if full:
+            mask = np.zeros((sv.max_batch,), bool)
+            pids = np.zeros((sv.max_batch,), np.int32)
+            committed = []
+            for lane in full:
+                req = self._lanes[lane]
+                pid = self.cache.alloc(req.id)
+                if pid is None:
+                    # Pool pressure mid-decode: evict this lane back to
+                    # the queue (it re-prefills when pages free up)
+                    # rather than stalling every other lane.
+                    metrics.add("cgx.serve.decode_evictions")
+                    self.cache.free_seq(req.id)
+                    req.output.clear()
+                    req.first_token_at = None
+                    self._waiting.append(req)
+                    self._lanes[lane] = None
+                    st["active"] = st["active"].at[lane].set(False)
+                    continue
+                mask[lane] = True
+                pids[lane] = pid
+                committed.append(lane)
+            if committed:
+                if cfg_mod.qerr_stats():
+                    for layer in range(self.server.cfg.n_layer):
+                        spec = self._prog.specs[layer]
+                        if spec.quantized:
+                            rows = np.asarray(
+                                st["tail_k"][layer]
+                            )[committed].reshape(len(committed), -1)
+                            _observe_page_qerr(
+                                self.server.layer_name(layer), spec,
+                                rows, already_host=True,
+                            )
+                self._state = self._prog.commit(
+                    self._state, jnp.asarray(mask), jnp.asarray(pids)
+                )
+                for layer in range(self.server.cfg.n_layer):
+                    _account_pages(
+                        self.server.layer_name(layer),
+                        self._prog.specs[layer], 2 * len(committed),
+                    )
+                metrics.add(
+                    "cgx.serve.pages_committed",
+                    float(2 * len(committed) * self.server.cfg.n_layer),
+                )
+            active = [i for i, r in enumerate(self._lanes)
+                      if r is not None]
+            if not active:
+                return True
+        t0 = time.perf_counter()
+        self._state, nxt = self._prog.decode_step(
+            self.server.p, self._state
+        )
+        nxt = np.asarray(nxt)
+        dt = time.perf_counter() - t0
+        metrics.observe("cgx.serve.decode_step_s", dt)
+        metrics.add("cgx.serve.decode_steps")
+        metrics.set(
+            "cgx.serve.batch_occupancy",
+            len(active) / self.server.serve.max_batch,
+        )
+        n_new = 0
+        for lane in active:
+            req = self._lanes[lane]
+            token = int(nxt[lane])
+            req.output.append(token)
+            n_new += 1
+            if len(req.output) >= req.max_new_tokens or (
+                self.server.serve.eos_token is not None
+                and token == self.server.serve.eos_token
+            ):
+                self._finish_lane(lane)
+        self._note_tokens(n_new)
+        return True
+
+    def _note_tokens(self, n: int) -> None:
+        self._tokens_total += n
+        metrics.add("cgx.serve.tokens_generated", float(n))
+        now = time.monotonic()
+        if self._last_step_t is not None and n:
+            dt = now - self._last_step_t
+            if dt > 0:
+                inst = n / dt
+                self._tps = (
+                    inst if not self._tps
+                    else (1 - _TPS_EWMA) * self._tps + _TPS_EWMA * inst
+                )
+                metrics.set("cgx.serve.tokens_per_s", self._tps)
+        self._last_step_t = now
+
+
+# ---------------------------------------------------------------------------
+# Shared page helpers (ingest + accounting).
+# ---------------------------------------------------------------------------
+
+
+def _pad_prompt(prompt: np.ndarray, page_tokens: int) -> np.ndarray:
+    """Right-pad a prompt to the next page multiple so distinct lengths
+    share one compiled prefill program (causal attention makes the pad
+    inert for every real position — see ``prefill_forward``)."""
+    s = prompt.shape[0]
+    padded_len = -(-s // page_tokens) * page_tokens
+    if padded_len == s:
+        return prompt
+    return np.pad(prompt, (0, padded_len - s))
+
+
+def _decode_page_payload(frame: tp.PageFrame, spec: paged_kv.PageSpec):
+    """A page frame's payload in pool-row form: (packed, meta) numpy
+    pair for quantized specs (the host-codec wire layout — zero
+    re-encoding), or the raw f32 payload row."""
+    if not spec.quantized:
+        return np.frombuffer(frame.payload, np.float16).astype(
+            np.float32
+        )
+    q = codec_host.from_bytes(
+        np.frombuffer(frame.payload, np.uint8),
+        spec.flat, spec.bits, spec.bucket_size, np.float32,
+    )
+    return np.asarray(q.packed), np.asarray(q.meta, np.float32)
+
+
+def _stack_rows(rows: List, spec: paged_kv.PageSpec):
+    """Stack per-page ingest rows into the batched scatter operands."""
+    if any(r is None for r in rows):
+        raise ValueError("incomplete page set in a completed stream")
+    if not spec.quantized:
+        return jnp.asarray(np.stack(rows))
+    return (
+        jnp.asarray(np.stack([r[0] for r in rows])),
+        jnp.asarray(np.stack([r[1] for r in rows])),
+    )
+
+
+def _account_pages(name: str, spec: paged_kv.PageSpec, n_pages: int) -> None:
+    """Wire-plane accounting for shipped/committed pages: the same
+    ``cgx.wire.bytes_*.kv_page`` counters and controller side table every
+    other edge feeds (``wire.dispatch.note_external_edge``)."""
+    wire_dispatch.note_external_edge(
+        "kv_page", name,
+        numel=spec.flat, bits=spec.bits,
+        raw_bytes=float(spec.raw_bytes() * n_pages),
+        wire_bytes=float(spec.wire_bytes() * n_pages),
+    )
+
+
+def _observe_page_qerr(
+    name: str, spec: paged_kv.PageSpec, rows, *, already_host: bool = False
+) -> None:
+    """CGX_QERR_STATS: the kv_page edge's relative-L2 round-trip error,
+    observed into the same ``cgx.qerr.wire:kv_page:<layer>`` stream the
+    SLO controller solves from (host-side — the pages travel a host
+    transport, so no staged callback is needed)."""
+    if not cfg_mod.qerr_stats():
+        return
+    rows_np = rows if already_host else np.asarray(rows)
+    rows_np = rows_np.reshape(-1, spec.flat).astype(np.float32)
+    for row in rows_np:
+        q = codec_host.quantize(row, spec.bits, spec.bucket_size)
+        rt = codec_host.dequantize(q, out_dtype=np.float32)
+        denom = float(np.linalg.norm(row)) or 1.0
+        rel = float(np.linalg.norm(row - rt)) / denom
+        metrics.observe(
+            f"cgx.qerr.{wire_dispatch.edge_label('kv_page', name)}", rel
+        )

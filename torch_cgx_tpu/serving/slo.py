@@ -1,0 +1,215 @@
+"""SLO-driven KV bit-width control: the WireController's serving objective.
+
+The training planes' closed-loop controller (``wire/controller.py``)
+minimizes quantization error under a FIXED average-bits budget. Serving
+inverts the objective: latency and throughput are the contract
+(``CGX_SERVE_TTFT_SLO_MS`` / ``CGX_SERVE_TPS_SLO``) and the bit budget
+is the lever — fewer KV bits mean fewer wire bytes per shipped page and
+fewer bytes under the decode gather, so TTFT and tokens/s improve at the
+cost of KV fidelity. This controller closes that loop from the live
+metric stream (the same registry the Prometheus endpoint exports):
+
+* a ``cgx.serve.ttft_ms`` p90 over the TTFT SLO, or a
+  ``cgx.serve.tokens_per_s`` gauge under the throughput SLO, steps the
+  budget DOWN one bit (floor ``min_bits``);
+* comfortably inside both SLOs (p90 ≤ 80% of the TTFT target, tokens/s
+  ≥ 110% of the throughput target), the budget RECOVERS one bit toward
+  ``max_bits`` — quality is restored as soon as latency allows.
+
+The budget is applied through a scoped :class:`WireController`
+(``label_prefix="wire:kv_page:"``): with ``CGX_QERR_STATS`` streaming
+per-layer kv_page error, the solver re-allocates the budget ACROSS
+layers (error-heavy layers keep more bits); without qerr telemetry a
+uniform ``kv_page`` edge registration applies the budget flat. Either
+write bumps the registry version, which re-keys the scheduler's
+decode-program cache — the new widths take effect at the scheduler's
+next idle adoption point, never mid-sequence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+from .. import config as cfg_mod
+from ..config import CompressionConfig
+from ..utils.logging import get_logger, metrics
+from ..wire import edges
+from ..wire.controller import WireController
+
+log = get_logger()
+
+KV_LABEL_PREFIX = "wire:kv_page:"
+
+
+class ServeSloController:
+    """Drive the kv_page bit budget from TTFT/tokens-per-second SLOs.
+
+    Host-side, called from the serving loop::
+
+        slo = ServeSloController(every=50)
+        while serving:
+            scheduler.step()
+            slo.step()
+
+    SLO targets default to the ``CGX_SERVE_TTFT_SLO_MS`` /
+    ``CGX_SERVE_TPS_SLO`` knobs (None = that objective off; with both
+    off the controller is inert). The budget starts at the resolved
+    ``CGX_KV_BITS`` width and moves one bit per update — a deliberately
+    slow outer loop: each move costs one decode-program retrace, so
+    hysteresis beats responsiveness here.
+    """
+
+    def __init__(
+        self,
+        *,
+        ttft_slo_ms: Optional[float] = None,
+        tps_slo: Optional[float] = None,
+        every: int = 100,
+        min_bits: int = 2,
+        max_bits: Optional[int] = None,
+        min_observations: int = 8,
+    ):
+        self.ttft_slo_ms = (
+            ttft_slo_ms if ttft_slo_ms is not None
+            else cfg_mod.serve_ttft_slo_ms()
+        )
+        self.tps_slo = (
+            tps_slo if tps_slo is not None else cfg_mod.serve_tps_slo()
+        )
+        self.every = max(0, int(every))
+        self.min_bits = int(min_bits)
+        self.max_bits = int(
+            max_bits if max_bits is not None
+            else (cfg_mod.kv_bits() or cfg_mod.MAX_BITS)
+        )
+        if not 1 <= self.min_bits <= self.max_bits <= cfg_mod.MAX_BITS:
+            raise ValueError(
+                f"bad bits range [{self.min_bits}, {self.max_bits}]"
+            )
+        self.budget = self.max_bits
+        self.updates = 0
+        self._count = 0
+        self._min_obs = max(1, int(min_observations))
+        self._last_uniform: Optional[int] = None
+        self._controller = WireController(
+            float(self.budget),
+            every=0,
+            bits_range=(self.min_bits, self.max_bits),
+            min_observations=self._min_obs,
+            label_prefix=KV_LABEL_PREFIX,
+        )
+
+    @property
+    def engaged(self) -> bool:
+        return self.ttft_slo_ms is not None or self.tps_slo is not None
+
+    def step(self) -> Optional[Dict[str, int]]:
+        """Note one serving tick; every ``every``-th call re-solves."""
+        self._count += 1
+        if self.every and self._count % self.every == 0:
+            return self.update()
+        return None
+
+    # -- the control law ---------------------------------------------------
+
+    def _pressure(self) -> int:
+        """-1 = violate (drop a bit), +1 = comfortable (recover a bit),
+        0 = hold. Reads the live metric stream only. Per-objective
+        verdicts: ANY configured objective violating drops; recovery
+        needs EVERY configured objective (with signal) comfortable — so
+        a tokens/s-only deployment recovers exactly like a TTFT-only
+        one (the control law the docstring promises)."""
+        verdicts = []  # per configured objective: -1 / 0 / +1
+        if self.ttft_slo_ms is not None:
+            ttft = metrics.histogram_stats("cgx.serve.ttft_ms")
+            if ttft and ttft.get("count"):
+                p90 = ttft.get("p90", 0.0)
+                verdicts.append(
+                    -1 if p90 > self.ttft_slo_ms
+                    else 1 if p90 <= 0.8 * self.ttft_slo_ms
+                    else 0
+                )
+        if self.tps_slo is not None:
+            tps = metrics.get("cgx.serve.tokens_per_s")
+            if tps:
+                verdicts.append(
+                    -1 if tps < self.tps_slo
+                    else 1 if tps >= 1.1 * self.tps_slo
+                    else 0
+                )
+        if not verdicts:
+            return 0  # no signal yet: hold
+        if min(verdicts) < 0:
+            return -1
+        return 1 if min(verdicts) > 0 else 0
+
+    def update(self) -> Dict[str, int]:
+        """Read the SLO signals, move the budget, write it into the
+        kv_page edge registry. Returns the applied per-layer allocation
+        ({} = nothing moved). Idempotent when the signals hold steady:
+        an unchanged budget with an unchanged qerr solve writes
+        nothing."""
+        if not self.engaged:
+            return {}
+        direction = self._pressure()
+        before = self.budget
+        if direction < 0:
+            self.budget = max(self.min_bits, self.budget - 1)
+            metrics.add("cgx.serve.slo_violations")
+        elif direction > 0:
+            self.budget = min(self.max_bits, self.budget + 1)
+        metrics.set("cgx.serve.slo_bits_budget", float(self.budget))
+        self.updates += 1
+        moved = self.budget != before
+        # Per-layer re-allocation from the kv_page qerr stream when it
+        # exists; a flat registration otherwise (or additionally, as the
+        # env-default floor the solver's labels override).
+        self._controller.avg_bits = float(self.budget)
+        alloc = self._controller.update()
+        if not alloc and (moved or self._last_uniform != self.budget):
+            edges.set_edge_config(
+                edges.EDGE_KV_PAGE,
+                ".*",
+                edges.EdgeConfig(
+                    cc=CompressionConfig(bits=self.budget, bucket_size=0)
+                ),
+            )
+            self._last_uniform = self.budget
+            alloc = {KV_LABEL_PREFIX + "*": self.budget}
+        if moved:
+            metrics.add("cgx.serve.slo_updates")
+            from ..observability import flightrec
+
+            flightrec.record(
+                "serve_slo",
+                budget_bits=self.budget,
+                direction=direction,
+                ttft_slo_ms=self.ttft_slo_ms,
+                tps_slo=self.tps_slo,
+                alloc={k: int(v) for k, v in sorted(alloc.items())[:16]},
+            )
+            log.info(
+                "serving SLO controller: kv bit budget %d -> %d "
+                "(%s pressure)", before, self.budget,
+                "latency" if direction < 0 else "quality",
+            )
+        return alloc if moved or alloc else {}
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSnapshot:
+    """Debug/report view of the controller's inputs (cgx_report)."""
+
+    ttft_p90_ms: float
+    tokens_per_s: float
+    budget_bits: int
+
+    @classmethod
+    def capture(cls, controller: ServeSloController) -> "SloSnapshot":
+        ttft = metrics.histogram_stats("cgx.serve.ttft_ms") or {}
+        return cls(
+            ttft_p90_ms=float(ttft.get("p90", 0.0)),
+            tokens_per_s=float(metrics.get("cgx.serve.tokens_per_s")),
+            budget_bits=controller.budget,
+        )

@@ -37,6 +37,14 @@ from . import dispatch, edges
 
 _QERR_PREFIX = "cgx.qerr."
 
+# Label prefixes OWNED by another controller objective: the default
+# (unscoped) training controller must not ingest them — in a colocated
+# train-and-serve process it would otherwise re-width the serving KV
+# pages from the training objective, the exact cross-plane write the
+# serving SLO controller's own scoping exists to prevent (it claims
+# "wire:kv_page:" via label_prefix; see serving/slo.py).
+_FOREIGN_OBJECTIVE_PREFIXES = ("wire:kv_page:",)
+
 # Controllers auto-reset with the rest of the per-edge derived state
 # (supervisor.invalidate_trace_caches / config.reset_registries): a
 # cadence counter surviving a recovery reconfiguration would fire the
@@ -86,6 +94,7 @@ class WireController:
         every: int = 500,
         bits_range: Tuple[int, int] = (2, 8),
         min_observations: int = 1,
+        label_prefix: str = "",
     ):
         if every < 0:
             raise ValueError(f"every must be >= 0, got {every}")
@@ -93,6 +102,13 @@ class WireController:
         self.every = every
         self.bits_range = bits_range
         self.min_observations = max(1, min_observations)
+        # Objective scope: only qerr labels under this prefix join the
+        # solve (and the write-back). "" = every label — the training
+        # planes' whole-step budget. The serving SLO controller
+        # (serving/slo.py) scopes its latency-driven budget to
+        # "wire:kv_page:" so re-solving the KV width can never disturb
+        # the training edges' allocation (one solver, two objectives).
+        self.label_prefix = label_prefix
         self.updates = 0
         self.last_alloc: Dict[str, int] = {}
         self._count = 0
@@ -134,6 +150,11 @@ class WireController:
             if not hname.startswith(_QERR_PREFIX):
                 continue
             label = hname[len(_QERR_PREFIX):]
+            if self.label_prefix:
+                if not label.startswith(self.label_prefix):
+                    continue  # outside this controller's objective scope
+            elif label.startswith(_FOREIGN_OBJECTIVE_PREFIXES):
+                continue  # another objective's labels (serving KV)
             meta = info.get(label)
             if meta is None or not meta.get("bits"):
                 continue  # raw or non-quantize edge: nothing to re-bit

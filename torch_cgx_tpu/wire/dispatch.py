@@ -134,6 +134,32 @@ def _note_edge(
     timeline.instant("wire_edge", **rec)
 
 
+def note_external_edge(
+    kind: str,
+    name: str,
+    *,
+    numel: int,
+    bits: int,
+    raw_bytes: float,
+    wire_bytes: float,
+) -> None:
+    """Per-payload accounting for edges whose bytes move OUTSIDE a staged
+    collective — the serving plane's KV pages travel through a host
+    transport, not a ``lax`` primitive, so :func:`_note_edge`'s
+    trace-time convention (once per compiled program, with flightrec/
+    timeline structure events) doesn't fit. This updates the same
+    ``cgx.wire.bytes_{raw,wire}.<kind>`` counters the report/top wire
+    ratios scan and the same (numel, bits) side table the closed-loop
+    controllers rebuild LayerStats from — one telemetry surface
+    regardless of which plane moved the bytes."""
+    edges._check_kind(kind)
+    metrics.add(f"cgx.wire.bytes_raw.{kind}", float(raw_bytes))
+    metrics.add(f"cgx.wire.bytes_wire.{kind}", float(wire_bytes))
+    _EDGE_INFO[edge_label(kind, name)] = {
+        "numel": int(numel), "bits": int(bits)
+    }
+
+
 def _stage_qerr(label: str, x, rt) -> Optional[jax.Array]:
     """CGX_QERR_STATS: stage this edge's relative-L2 round-trip error into
     the live ``cgx.qerr.<label>`` histogram — the same stream the

@@ -27,6 +27,12 @@ kind                 traffic
                      local-SGD outer loop's DCN payload
                      (``parallel/async_plane.py``), shipped by the
                      dedicated sender thread with per-edge error feedback
+``kv_page``          serving-plane KV-cache pages: the fixed-size blocks
+                     the paged allocator (``serving/kv_cache.py``)
+                     quantizes for the disaggregated prefill→decode hop
+                     and the decode scheduler's paged attention read —
+                     resolved per layer, driven by the serving SLO
+                     controller (``serving/slo.py``)
 ===================  ====================================================
 
 Resolution order for a non-``dp_grad`` edge ``(kind, name)``:
@@ -58,6 +64,7 @@ EDGE_RING_KV = "ring_kv"
 EDGE_PP_ACT = "pp_act"
 EDGE_POWERSGD_FACTOR = "powersgd_factor"
 EDGE_XSLICE_DELTA = "xslice_delta"
+EDGE_KV_PAGE = "kv_page"
 
 EDGE_KINDS = (
     EDGE_DP_GRAD,
@@ -66,6 +73,7 @@ EDGE_KINDS = (
     EDGE_PP_ACT,
     EDGE_POWERSGD_FACTOR,
     EDGE_XSLICE_DELTA,
+    EDGE_KV_PAGE,
 )
 
 # Peer compressors the dispatcher can put behind an edge (max-min
@@ -189,7 +197,11 @@ def resolve_edge(kind: str, name: str) -> Optional[EdgeConfig]:
     for (k, pattern), ec in _edge_configs.items():
         if k == kind and re.search(pattern, name):
             match = ec
-    if match is None and kind != EDGE_DP_GRAD:
+    if match is None and kind not in (EDGE_DP_GRAD, EDGE_KV_PAGE):
+        # kv_page skips the CGX_WIRE_BITS fallback like dp_grad skips it:
+        # its env default is CGX_KV_BITS, consulted by the serving
+        # resolver (serving/kv_cache.py resolve_kv_config) — a training
+        # wire knob must not silently re-width the serving KV pages.
         bits = cfg_mod.wire_default_bits()
         if bits:
             match = EdgeConfig(cc=CompressionConfig(bits=bits, bucket_size=0))
