@@ -195,6 +195,9 @@ def test_stochastic_rounding_unbiased():
     assert np.abs(mean - x).mean() < step / 4
 
 
+# Slow tier: exhaustive three-way fuzz (~20 s); the pinned-combo
+# byte-identity tests above stay in tier-1.
+@pytest.mark.slow
 def test_fuzz_three_way_byte_identity():
     """Seeded fuzz over the config space: every (n, bits, bucket) combo
     must produce BYTE-IDENTICAL wire from all three implementations

@@ -466,6 +466,9 @@ def test_mul_encode_tpu(monkeypatch):
     assert np.abs(np.asarray(y) - np.asarray(xs)).max() <= unit / 2 + 1e-6
 
 
+# Slow tier: the interpret-mode sweep runs ~40 s serially; the
+# targeted parity tests above keep both kernel families in tier-1.
+@pytest.mark.slow
 def test_fuzz_pallas_wire_matches_xla():
     """Seeded fuzz over supported (n, bits, bucket) combos — both kernel
     families (flat whole-chunk rows and chunk-block tails) must stay

@@ -42,6 +42,8 @@ def test_resnet18_forward_and_grad():
     )
 
 
+# Slow tier: depth-scaling rerun of the resnet18 coverage above.
+@pytest.mark.slow
 def test_resnet50_forward():
     model = ResNet50(num_classes=100, cifar_stem=False)
     x = jnp.zeros((2, 64, 64, 3), jnp.float32)

@@ -109,6 +109,10 @@ def _run_local(cfg, params, prompts, gen=10, sv=None):
 # ---------------------------------------------------------------------------
 
 
+# Slow tier: the exhaustive full-model oracle (~30 s);
+# test_8bit_kv_token_identical_to_f16 keeps the decode-path token
+# identity in tier-1.
+@pytest.mark.slow
 def test_decode_matches_full_model_greedy(model_setup, monkeypatch):
     """Raw-KV serving decode == full-model greedy recompute, token for
     token (the paged-cache forward is the module's math)."""

@@ -26,14 +26,14 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
-from . import caches, knobs, locks
+from . import caches, generations, knobs, locks
 from .graph import Project, get_source
 from .report import Finding
 
 WHOLE_PROGRAM_PASSES = (
     "knob-key", "stale-allowlist", "orphan-memo",
     "lock-order", "lock-blocking", "thread-shared-write",
-    "pragma-format",
+    "pragma-format", "generation-hygiene",
 )
 
 
@@ -87,6 +87,8 @@ def run_project(
         findings.extend(caches.check(proj))
     if on("lock-order", "lock-blocking", "thread-shared-write"):
         findings.extend(locks.check(proj))
+    if on("generation-hygiene"):
+        findings.extend(generations.check(proj))
     if on("pragma-format"):
         findings.extend(check_pragma_format(proj))
     if want is not None:

@@ -1,0 +1,307 @@
+"""Generation-hygiene pass: store-key writes must carry the epoch tag.
+
+Every store key a recovery-aware module writes is supposed to live in
+the ``g<N>/`` generation namespace (``ProcessGroupCGX._ns``) or carry
+the generation in-band (the rendezvous's ``cgxrdz/g<N>/...`` keys, the
+elastic join's ``cgxjoin/g<N>/...``). A write that skips the tag aliases
+across reconfigurations: the post-recovery group reads the dead
+generation's payloads under identical keys — exactly the corruption
+class the whole epoch discipline exists to kill, and invisible in any
+single-generation test.
+
+The ``generation-hygiene`` rule walks every ``store.set`` /
+``store.add`` / ``_publish`` call in ``robustness/`` and
+``torch_backend/`` and flags keys that PROVABLY lack a generation tag:
+
+* a key is **ok** when it goes through ``_ns(...)``, or when its
+  f-string (after substituting simple locals, ``self.<attr>``
+  assignments, module constants, and single-return key-helper functions)
+  contains a ``g{...}`` segment;
+* a key is **skipped** when it cannot be seen at all — a bare name that
+  is a function parameter (the CALLER's site is checked instead), an
+  unresolvable attribute, or a call into another module;
+* everything else — a resolved f-string or literal with no tag — is a
+  finding.
+
+``store.add(key, 0)`` is a read (the non-blocking flag-probe idiom) and
+is never flagged. Deliberately cross-generation keys (join intents,
+comeback notices, page re-request side channels) carry a
+``# cgx-analysis: allow(generation-hygiene) — <why>`` pragma at the
+write site; the reasons are the documentation of WHY each key may
+outlive a generation (docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from .graph import ModuleInfo, Project
+from .report import Finding
+
+RULE = "generation-hygiene"
+
+# Package dirs under epoch discipline. serving/ and parallel/ ride the
+# backend's _ns-wrapped keys or per-stream namespaces owned elsewhere.
+_SCANNED_DIRS = ("robustness", "torch_backend")
+
+# Unresolved f-string placeholder marker in rendered key text.
+_HOLE = "\x00"
+
+_OK, _BAD, _UNKNOWN = "ok", "bad", "unknown"
+
+
+def _is_store_write(call: ast.Call) -> Optional[ast.AST]:
+    """The key expression when ``call`` writes a store key, else None."""
+    fn = call.func
+    # store.set(key, v) / store.add(key, delta) with a store-ish receiver
+    if isinstance(fn, ast.Attribute) and fn.attr in ("set", "add"):
+        base = fn.value
+        name = (
+            base.id if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute)
+            else ""
+        )
+        if "store" in name.lower() and call.args:
+            if fn.attr == "add" and len(call.args) > 1:
+                d = call.args[1]
+                if isinstance(d, ast.Constant) and d.value == 0:
+                    return None  # add(key, 0): the flag-probe READ idiom
+            return call.args[0]
+        return None
+    # _publish(store, key, payload) — the rendezvous publish-after-write
+    # helper (direct or module-qualified).
+    pname = (
+        fn.id if isinstance(fn, ast.Name)
+        else fn.attr if isinstance(fn, ast.Attribute)
+        else ""
+    )
+    if pname == "_publish" and len(call.args) >= 2:
+        return call.args[1]
+    return None
+
+
+def js_values(js: ast.JoinedStr) -> List[ast.AST]:
+    """The interpolated expressions of an f-string."""
+    return [
+        v.value for v in js.values if isinstance(v, ast.FormattedValue)
+    ]
+
+
+class _Scope:
+    """Resolution context for one function body."""
+
+    def __init__(self, mod: ModuleInfo, params: set,
+                 local_assigns: Dict[str, ast.AST],
+                 self_attrs: Dict[str, List[ast.AST]],
+                 class_methods: Dict[str, ast.FunctionDef]):
+        self.mod = mod
+        self.params = params
+        self.local_assigns = local_assigns
+        self.self_attrs = self_attrs
+        self.class_methods = class_methods
+
+
+def _classify(expr: ast.AST, scope: _Scope, depth: int = 0) -> str:
+    if depth > 6:
+        return _UNKNOWN
+    if isinstance(expr, ast.Call):
+        fn = expr.func
+        callee = (
+            fn.id if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute)
+            else ""
+        )
+        if callee == "_ns":
+            return _OK
+        ret = _helper_return(callee, fn, scope)
+        if ret is not None:
+            return _classify(ret, scope, depth + 1)
+        return _UNKNOWN
+    if isinstance(expr, ast.JoinedStr):
+        # An interpolated value that itself classifies ok (a local bound
+        # from `_ns(...)`, a g-tagged helper) tags the whole key.
+        for v in js_values(expr):
+            if _classify(v, scope, depth + 1) == _OK:
+                return _OK
+        text, saw_hole = _render(expr, scope, depth)
+        if f"g{_HOLE}" in text or "g{" in text:
+            return _OK
+        # A key that never interpolates anything AND has no tag is bad
+        # outright; one with holes is still bad — the namespace lives in
+        # the literal skeleton, and an int placeholder cannot supply it.
+        return _BAD
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return _OK if "g{" in expr.value else _BAD
+    if isinstance(expr, ast.Name):
+        if expr.id in scope.local_assigns:
+            return _classify(scope.local_assigns[expr.id], scope, depth + 1)
+        if expr.id in scope.params:
+            return _UNKNOWN  # the caller's site is checked instead
+        if expr.id in scope.mod.constants:
+            return _OK if "g{" in scope.mod.constants[expr.id] else _BAD
+        return _UNKNOWN
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            rhss = scope.self_attrs.get(expr.attr, [])
+            verdicts = [_classify(r, scope, depth + 1) for r in rhss]
+            if _OK in verdicts:
+                return _OK
+            if verdicts and all(v == _BAD for v in verdicts):
+                return _BAD
+        return _UNKNOWN
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Add):
+        left = _classify(expr.left, scope, depth + 1)
+        right = _classify(expr.right, scope, depth + 1)
+        if _OK in (left, right):
+            return _OK
+        if _UNKNOWN in (left, right):
+            return _UNKNOWN
+        return _BAD
+    return _UNKNOWN
+
+
+def _helper_return(callee: str, fn: ast.AST,
+                   scope: _Scope) -> Optional[ast.AST]:
+    """The single returned expression of a same-module key helper:
+    ``_intent_key(k)`` resolves to its f-string so call sites inherit
+    its verdict. Self-method calls resolve through the enclosing class."""
+    node: Optional[ast.FunctionDef] = None
+    if isinstance(fn, ast.Name):
+        info = scope.mod.funcs.get(callee)
+        node = getattr(info, "node", None) if info is not None else None
+        if node is None:
+            node = _module_func(scope.mod, callee)
+    elif (isinstance(fn, ast.Attribute)
+          and isinstance(fn.value, ast.Name) and fn.value.id == "self"):
+        node = scope.class_methods.get(callee)
+    if node is None:
+        return None
+    returns = [
+        n.value for n in ast.walk(node)
+        if isinstance(n, ast.Return) and n.value is not None
+    ]
+    return returns[0] if len(returns) == 1 else None
+
+
+def _module_func(mod: ModuleInfo, name: str) -> Optional[ast.FunctionDef]:
+    for n in mod.tree.body:
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and n.name == name:
+            return n
+    return None
+
+
+def _render(js: ast.JoinedStr, scope: _Scope,
+            depth: int) -> Tuple[str, bool]:
+    """The f-string's text with every unresolvable interpolation as a
+    hole marker; resolvable string-valued names splice in recursively."""
+    parts: List[str] = []
+    saw_hole = False
+    for v in js.values:
+        if isinstance(v, ast.Constant):
+            parts.append(str(v.value))
+        elif isinstance(v, ast.FormattedValue):
+            inner = v.value
+            spliced: Optional[str] = None
+            if depth <= 6:
+                if isinstance(inner, ast.Name):
+                    tgt = scope.local_assigns.get(inner.id)
+                    if tgt is None and inner.id in scope.mod.constants:
+                        spliced = scope.mod.constants[inner.id]
+                    elif isinstance(tgt, ast.JoinedStr):
+                        spliced, _ = _render(tgt, scope, depth + 1)
+                    elif isinstance(tgt, ast.Constant) and isinstance(
+                            tgt.value, str):
+                        spliced = tgt.value
+                elif isinstance(inner, ast.JoinedStr):
+                    spliced, _ = _render(inner, scope, depth + 1)
+            if spliced is None:
+                parts.append(_HOLE)
+                saw_hole = True
+            else:
+                parts.append(spliced)
+    return "".join(parts), saw_hole
+
+
+def _function_scopes(mod: ModuleInfo):
+    """(scope, body_calls) per function (methods get their class's
+    self-attr map); module-level calls get an empty-locals scope."""
+    def self_attr_map(cls: ast.ClassDef) -> Dict[str, List[ast.AST]]:
+        out: Dict[str, List[ast.AST]] = {}
+        for n in ast.walk(cls):
+            if isinstance(n, ast.Assign) and n.value is not None:
+                for t in n.targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        out.setdefault(t.attr, []).append(n.value)
+        return out
+
+    def locals_of(fn: ast.FunctionDef) -> Dict[str, ast.AST]:
+        out: Dict[str, ast.AST] = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 \
+                    and isinstance(n.targets[0], ast.Name):
+                out[n.targets[0].id] = n.value
+        return out
+
+    def params_of(fn: ast.FunctionDef) -> set:
+        a = fn.args
+        names = [p.arg for p in (
+            list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+        )]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return set(names)
+
+    def funcs_in(body, attrs, methods):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield _Scope(mod, params_of(n), locals_of(n), attrs,
+                             methods), n
+            elif isinstance(n, ast.ClassDef):
+                cattrs = self_attr_map(n)
+                cmethods = {
+                    m.name: m for m in n.body
+                    if isinstance(m, ast.FunctionDef)
+                }
+                yield from funcs_in(n.body, cattrs, cmethods)
+
+    yield from funcs_in(mod.tree.body, {}, {})
+
+
+def check(proj: Project) -> List[Finding]:
+    out: List[Finding] = []
+    for mod in proj.modules.values():
+        parts = mod.path.parts
+        if not any(d in parts for d in _SCANNED_DIRS):
+            continue
+        for scope, fn in _function_scopes(mod):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                key_expr = _is_store_write(node)
+                if key_expr is None:
+                    continue
+                if _classify(key_expr, scope) != _BAD:
+                    continue
+                if proj.suppressed(mod.path, node.lineno, RULE):
+                    continue
+                key_src = ast.get_source_segment(
+                    mod.source.text, key_expr
+                ) or "<key>"
+                out.append(Finding(
+                    path=str(mod.path), line=node.lineno, rule=RULE,
+                    message=(
+                        f"[{RULE}] store write key {key_src!r} carries no "
+                        "g<N>/ generation namespace — a post-recovery "
+                        "group will alias this against the dead "
+                        "generation's traffic; route it through _ns(...) "
+                        "or put g{generation} in the key, or pragma the "
+                        "write if it is deliberately cross-generation"
+                    ),
+                ))
+    return out

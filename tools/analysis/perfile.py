@@ -412,9 +412,13 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # counters, the tokens_per_s gauge and ttft_ms histogram (the SLO
     # controller's inputs), transport stream counters, prefill-failover
     # and pool-pressure incidents — docs/OBSERVABILITY.md.
-    "async", "codec", "collective", "faults", "flightrec", "health",
-    "heartbeat", "plan", "qerr", "recovery", "ring", "runtime", "sched",
-    "serve", "shm", "sra", "step", "trace", "wire", "xla",
+    # "elastic" is the elastic membership plane (PR 16): join intents /
+    # triggers / admissions, snapshot-page ship/receive/re-request
+    # counters, the last_join_ms gauge and reaped-key counts —
+    # docs/OBSERVABILITY.md.
+    "async", "codec", "collective", "elastic", "faults", "flightrec",
+    "health", "heartbeat", "plan", "qerr", "recovery", "ring", "runtime",
+    "sched", "serve", "shm", "sra", "step", "trace", "wire", "xla",
 })
 
 

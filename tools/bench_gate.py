@@ -286,13 +286,37 @@ def normalize_serve_ttft(rec: dict) -> Optional[Tuple[str, float]]:
     return key, 1000.0 / float(v)
 
 
+# Elastic rejoin floor (ISSUE 16): rejoin bench records carry the
+# announce-to-step-loop latency of a checkpoint-free rank join. Lower is
+# better, so the gated trajectory is the INVERSE (1000/ms — "joins per
+# second"), same machinery as the TTFT floor above.
+_REJOIN_SUFFIX = ":rejoin_inv"
+
+
+def normalize_rejoin(rec: dict) -> Optional[Tuple[str, float]]:
+    """(``<metric>:rejoin_inv`` key, 1000/rejoin_latency_ms) for records
+    carrying a top-level ``rejoin_latency_ms``, or None."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return None
+    metric = rec.get("metric")
+    v = rec.get("rejoin_latency_ms")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return None
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        return None
+    key = f"{metric}{_REJOIN_SUFFIX}"
+    if is_placeholder(rec):
+        key += _PLACEHOLDER_SUFFIX
+    return key, 1000.0 / float(v)
+
+
 def normalize_all(rec: dict) -> List[Tuple[str, float]]:
     """Every gated (key, higher-is-better value) pair one record yields:
     its throughput trajectory and, when present, its overlap-fraction,
-    prediction-ratio and TTFT-inverse trajectories."""
+    prediction-ratio, TTFT-inverse and rejoin-inverse trajectories."""
     out = []
     for fn in (normalize, normalize_overlap, normalize_pred,
-               normalize_serve_ttft):
+               normalize_serve_ttft, normalize_rejoin):
         norm = fn(rec)
         if norm is not None:
             out.append(norm)

@@ -71,6 +71,7 @@ def summarize(data: dict) -> dict:
                      "suspected_dead": [], "counters": {}, "recovery": {},
                      "wire": {}}
     recovery_events: List[dict] = []
+    membership_events: List[dict] = []
     coll_time: Dict[str, float] = defaultdict(float)
     coll_n: Dict[str, int] = defaultdict(int)
     ratios: Dict[str, List[float]] = defaultdict(list)
@@ -163,6 +164,19 @@ def summarize(data: dict) -> dict:
                 if kind == "recovery_retry":
                     row["phase"] = "retry"
                 recovery_events.append(row)
+            elif kind == "elastic":
+                row = {"rank": rank, "ts": ev.get("ts")}
+                row.update(
+                    {
+                        k: v for k, v in ev.items()
+                        if k in ("phase", "generation", "ws", "step",
+                                 "join_step", "joiners", "donors",
+                                 "intents", "donor_idx", "bytes",
+                                 "leaves", "ms")
+                        and v is not None
+                    }
+                )
+                membership_events.append(row)
     # Newest exporter line per rank folds in counters the dumps may miss.
     step_p50 = None  # measured step time (the planner section's contrast)
     # Planner gauges are LEVELS, never tallies: they fold max-within-rank
@@ -270,6 +284,43 @@ def summarize(data: dict) -> dict:
             "generation": int(max(gens)) if gens else 0,
             "evicted": sorted(evicted),
             "counters": rec_counters,
+        }
+    # Membership section: the elastic plane's audit trail (PR 16). Join
+    # lifecycle counters are cluster totals; the generation / ws are the
+    # newest levels any event reported; joiners and donors accumulate
+    # over every grow the run saw.
+    el_counters = {
+        k: v for k, v in totals.items() if k.startswith("cgx.elastic.")
+    }
+    if membership_events or el_counters:
+        membership_events.sort(key=lambda e: (e.get("ts") or 0))
+        joiners: set = set()
+        donor_ranks: set = set()
+        el_gens: List[int] = []
+        ws = None
+        last_join_ms = None
+        for ev in membership_events:
+            for g in ev.get("joiners") or []:
+                joiners.add(int(g))
+            for g in ev.get("donors") or []:
+                donor_ranks.add(int(g))
+            if isinstance(ev.get("generation"), (int, float)):
+                el_gens.append(int(ev["generation"]))
+            if isinstance(ev.get("ws"), (int, float)):
+                ws = int(ev["ws"])
+            if isinstance(ev.get("ms"), (int, float)):
+                last_join_ms = float(ev["ms"])
+        summary["membership"] = {
+            "events": membership_events,
+            "generation": max(el_gens) if el_gens else 0,
+            "ws": ws,
+            "joiners": sorted(joiners),
+            "donors": sorted(donor_ranks),
+            "grows": int(el_counters.get("cgx.elastic.grows", 0)),
+            "joins": int(el_counters.get("cgx.elastic.joins", 0)),
+            "aborts": int(el_counters.get("cgx.elastic.join_aborts", 0)),
+            "last_join_ms": last_join_ms,
+            "counters": el_counters,
         }
     # Unified wire plane: per-edge byte tallies (counters, summed across
     # ranks) + the closed-loop controller's current bit gauges (taken as
@@ -514,6 +565,37 @@ def render(summary: dict) -> str:
         if rows:
             parts.append(
                 _fmt_table(rows, ("rank", "phase", "gen", "detail", "step"))
+            )
+    if summary.get("membership"):
+        mem = summary["membership"]
+        parts.append(
+            f"\n== membership (generation {mem['generation']}, "
+            f"ws {mem['ws'] if mem['ws'] is not None else '?'}) =="
+        )
+        parts.append(
+            f"  grows: {mem['grows']}  joins: {mem['joins']}  "
+            f"aborts: {mem['aborts']}  "
+            f"joiners: {mem['joiners'] or 'none'}  "
+            f"donors: {mem['donors'] or 'none'}"
+        )
+        if mem.get("last_join_ms") is not None:
+            parts.append(f"  last_join_ms: {mem['last_join_ms']:.1f}")
+        for k, v in sorted(mem["counters"].items()):
+            parts.append(f"  {k}: {v:g}")
+        rows = [
+            (
+                ev.get("rank"),
+                ev.get("phase", "?"),
+                ev.get("generation", ""),
+                ev.get("joiners") or ev.get("donor_idx", ""),
+                (ev.get("step") if ev.get("step") is not None
+                 else ev.get("join_step", "")),
+            )
+            for ev in mem["events"]
+        ]
+        if rows:
+            parts.append(
+                _fmt_table(rows, ("rank", "phase", "gen", "joiners", "step"))
             )
     if summary.get("wire"):
         w = summary["wire"]

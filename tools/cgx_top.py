@@ -8,7 +8,7 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  lag  async$  straggler  gen  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  lag  async$  straggler  gen  ws  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
@@ -48,6 +48,10 @@ dumps — and renders one row per rank:
 * **straggler** — the health engine's worst per-peer skew score as
   ``score→peer`` (needs CGX_HEALTH on the ranks).
 * **gen** — the recovery generation gauge (``cgx.recovery.generation``).
+* **ws** — the live world size (``cgx.recovery.ws``): shrinks on an
+  eviction, grows back when the elastic plane admits a joiner — the
+  membership story at a glance (``?`` before the first reconfigure
+  publishes it).
 * **last fault** — newest ``failure`` event in the rank's flight dump.
 
 Plain-refresh by default (ANSI clear + redraw — works over any ssh);
@@ -357,7 +361,7 @@ def render(directory: str, state: dict) -> str:
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
                "edges", "overlap", "sched$", "plan$", "pred", "atune$",
                "roofl", "lag", "async$", "tok/s", "ttft",
-               "straggler", "gen", "last_fault")
+               "straggler", "gen", "ws", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     for rank, d in sorted(view.items()):
@@ -381,6 +385,7 @@ def render(directory: str, state: dict) -> str:
             _serve_ttft(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
+            str(int(m.get("cgx.recovery.ws", 0)) or "?"),
             _last_fault(d["last_fault"]),
         ))
         for ev in ((d["status"] or {}).get("events_recent") or [])[-3:]:

@@ -124,6 +124,11 @@ SERVE_MAX_SEQ = "CGX_SERVE_MAX_SEQ"  # per-sequence KV capacity, in tokens
 SERVE_PREFILL_TIMEOUT_MS = "CGX_SERVE_PREFILL_TIMEOUT_MS"  # failover bound
 SERVE_TTFT_SLO_MS = "CGX_SERVE_TTFT_SLO_MS"  # SLO controller: TTFT target
 SERVE_TPS_SLO = "CGX_SERVE_TPS_SLO"  # SLO controller: tokens/s target
+# Elastic membership (robustness/elastic.py — PR 16): checkpoint-free
+# rank join with snapshot-page state transfer over the kv transport.
+ELASTIC = "CGX_ELASTIC"  # master enable for the elastic join plane
+JOIN_TIMEOUT_MS = "CGX_JOIN_TIMEOUT_MS"  # bound on every join-path wait
+JOIN_DONORS = "CGX_JOIN_DONORS"  # snapshot-page donor fan-out
 # Live health plane (observability/health.py + watch.py — PR 6):
 HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
@@ -839,6 +844,41 @@ def snapshot_every() -> int:
     from the current state without replay."""
     v = _env.get_int_env_or_default(SNAPSHOT_EVERY, 0)
     return max(v, 0)
+
+
+def elastic_enabled() -> bool:
+    """CGX_ELASTIC: master enable for the elastic membership plane
+    (``robustness/elastic.py``) — survivors poll the join-intent counter
+    at step boundaries, a preempted-then-respawned rank re-enters through
+    the join rendezvous, and the group can GROW back to its original
+    world size without a checkpoint file ever touching disk. Off
+    (default) = membership is shrink-only, exactly the PR 5 ladder; no
+    store traffic, no staged-program or wire-byte changes
+    (docs/ROBUSTNESS.md "Elastic membership")."""
+    return _env.get_bool_env_or_default(ELASTIC, False)
+
+
+def join_timeout_ms() -> float:
+    """CGX_JOIN_TIMEOUT_MS: the single bound on every join-path wait —
+    the joiner's wait for its admit record, the survivors' wait for the
+    joiner's ack, the snapshot-page stream's staleness probe, and the
+    post-reconfigure ready barrier. A joiner that cannot make the bound
+    aborts ALONE (survivors have not reconfigured yet and continue at the
+    old generation unharmed); a survivor-side expiry abandons the grow
+    and resumes stepping. Survivors therefore never stall longer than
+    this bound on a join attempt."""
+    v = _env.get_float_env_or_default(JOIN_TIMEOUT_MS, 30000.0)
+    return v if v > 0 else 30000.0
+
+
+def join_donors() -> int:
+    """CGX_JOIN_DONORS: snapshot-page donor fan-out — how many survivors
+    (ranked by the health plane's load scores, least-loaded first) stripe
+    the joiner's state pages (page ordinal modulo donors; every survivor
+    holds identical state, so any stripe assignment is correct). 1
+    (default) = the single least-loaded survivor ships everything."""
+    v = _env.get_int_env_or_default(JOIN_DONORS, 1)
+    return max(v, 1)
 
 
 # ---------------------------------------------------------------------------

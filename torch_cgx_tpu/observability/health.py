@@ -68,6 +68,12 @@ ARENA_PRESSURE = "arena_pressure"
 # staleness bookkeeping, long before any bridge wait could expire
 # (the async plane never blocks on DCN, so no wait ever WOULD expire).
 ASYNC_LAG = "async_lag"
+# Elastic membership (PR 16): a peer announced a preemption with a
+# comeback promise (the supervisor's rejoin rung reads the same notice),
+# and membership actually changed (grow or shrink — the policy's
+# cooldown anchor).
+PREEMPT_NOTICE = "preempt_notice"
+MEMBERSHIP = "membership"
 
 # Wait-signal floor: peer skew is judged relative to the median peer, but
 # a baseline of ~0 (healthy peers answer in microseconds) would make any
@@ -527,6 +533,7 @@ class HealthEngine:
                 "p99_s": round(self._step_p99.value(), 6),
                 "n": self._step_slow.n,
             }
+        pol = _policy
         return {
             "rank": self.rank,
             "ts": round(time.time(), 6),
@@ -535,6 +542,7 @@ class HealthEngine:
             },
             "step": step,
             "events_recent": events,
+            "membership": pol.status() if pol is not None else None,
         }
 
     def _events_path(self) -> Optional[str]:
@@ -595,12 +603,156 @@ class HealthEngine:
         self._thread = None
 
 
+class MembershipPolicy:
+    """The grow/shrink-deciding half of the health plane (PR 16).
+
+    The engine above decides *whom to suspect*; this policy decides
+    *when the group's membership should change*: it queues join intents,
+    tracks preemption notices (a dying rank promising to come back —
+    the supervisor's rejoin rung prefers re-admission over permanent
+    eviction for those), rate-limits membership churn through a cooldown
+    anchored at the last actual change, and ranks this rank's fitness as
+    a snapshot donor. Advice only: the elastic coordinator
+    (``robustness/elastic.py``) owns the store protocol that *acts*.
+    """
+
+    # Membership changes are expensive (rendezvous + reconfigure + trace
+    # cache rebuild): back-to-back grows/shrinks within this window are
+    # churn, not capacity management.
+    COOLDOWN_S = 5.0
+    # A rejoin reservation outlives the promised respawn delay by this
+    # slack before the rank is treated as permanently gone.
+    REJOIN_SLACK_S = 60.0
+
+    def __init__(self, engine: Optional[HealthEngine] = None):
+        self._engine = engine
+        self._lock = threading.Lock()
+        self._pending: Dict[int, float] = {}  # joiner global rank -> t seen
+        self._rejoins: Dict[int, float] = {}  # global rank -> deadline
+        self._last_change_t = 0.0
+
+    # -- inputs ------------------------------------------------------------
+
+    def note_join_intent(self, rank: int) -> None:
+        with self._lock:
+            self._pending[int(rank)] = time.monotonic()
+        metrics.set("cgx.elastic.pending_joiners", float(len(self._pending)))
+
+    def note_preempt_notice(self, rank: int, delay_s: float) -> None:
+        """A peer published a comeback notice before dying: reserve its
+        global rank for re-admission and surface the event."""
+        deadline = time.monotonic() + float(delay_s) + self.REJOIN_SLACK_S
+        with self._lock:
+            self._rejoins[int(rank)] = deadline
+        metrics.add("cgx.elastic.preempt_notices")
+        eng = self._engine
+        if eng is not None:
+            eng._emit(HealthEvent(
+                kind=PREEMPT_NOTICE, rank=eng.rank, value=float(delay_s),
+                threshold=0.0, suspect=int(rank),
+                detail=(("respawn_s", float(delay_s)),),
+                ts=round(time.time(), 6),
+                t_mono=round(time.perf_counter(), 6),
+            ))
+
+    def expect_rejoin(self, rank: int, deadline_s: float) -> None:
+        """Reserve ``rank`` for re-admission until ``deadline_s`` from
+        now (the supervisor's rejoin rung calls this when it shrinks a
+        suspect that announced a comeback)."""
+        with self._lock:
+            self._rejoins[int(rank)] = time.monotonic() + float(deadline_s)
+
+    def note_membership_change(self, generation: int, ws: int) -> None:
+        """An actual grow/shrink landed: anchor the churn cooldown, clear
+        admitted joiners, and surface the event."""
+        now = time.monotonic()
+        with self._lock:
+            self._last_change_t = now
+            self._pending.clear()
+        metrics.set("cgx.elastic.pending_joiners", 0.0)
+        eng = self._engine
+        if eng is not None:
+            eng._emit(HealthEvent(
+                kind=MEMBERSHIP, rank=eng.rank, value=float(ws),
+                threshold=0.0,
+                detail=(("generation", int(generation)), ("ws", int(ws))),
+                ts=round(time.time(), 6),
+                t_mono=round(time.perf_counter(), 6),
+            ))
+
+    # -- outputs -----------------------------------------------------------
+
+    def expected_rejoin(self, rank: int) -> bool:
+        """True while ``rank`` holds a fresh comeback reservation."""
+        now = time.monotonic()
+        with self._lock:
+            dl = self._rejoins.get(int(rank))
+            if dl is not None and now > dl:
+                del self._rejoins[int(rank)]
+                dl = None
+            return dl is not None
+
+    def pending_joiners(self) -> List[int]:
+        with self._lock:
+            return sorted(self._pending)
+
+    def load_score(self) -> float:
+        """This rank's donor-fitness load: the fast step-time EWMA (the
+        straggler signal's numerator) — lower means the rank has the
+        most headroom to encode and ship snapshot pages. 0.0 with the
+        engine off, so donor selection degrades to lowest-global-rank."""
+        eng = self._engine
+        if eng is None:
+            return 0.0
+        with eng._lock:
+            return round(eng._step_fast.value, 6)
+
+    def advise(self) -> Dict[str, Any]:
+        """Current membership advice: ``grow`` (admit the pending
+        joiners now — intents queued and the churn cooldown has
+        passed), the pending joiner list, and sustained-straggler shrink
+        candidates (peers the engine's skew score names, the same
+        evidence the eviction vote consumes as hints)."""
+        now = time.monotonic()
+        with self._lock:
+            pending = sorted(self._pending)
+            cooled = now - self._last_change_t >= self.COOLDOWN_S
+        shrink: List[int] = []
+        eng = self._engine
+        if eng is not None:
+            factor = eng._straggler_factor
+            shrink = sorted(
+                p for p, s in eng.straggler_scores().items() if s >= factor
+            )
+        return {
+            "grow": bool(pending) and cooled,
+            "pending_joiners": pending,
+            "shrink_candidates": shrink,
+            "cooldown_passed": cooled,
+        }
+
+    def status(self) -> Dict[str, Any]:
+        now = time.monotonic()
+        with self._lock:
+            rejoins = sorted(
+                r for r, dl in self._rejoins.items() if dl >= now
+            )
+            pending = sorted(self._pending)
+        return {
+            "pending_joiners": pending,
+            "expected_rejoins": rejoins,
+            "ws": int(metrics.get("cgx.recovery.ws")),
+            "generation": int(metrics.get("cgx.recovery.generation")),
+        }
+
+
 # ---------------------------------------------------------------------------
 # Process singleton + zero-cost hot-path shims.
 # ---------------------------------------------------------------------------
 
 _engine: Optional[HealthEngine] = None
 _engine_lock = threading.Lock()
+_policy: Optional[MembershipPolicy] = None
 
 
 def active() -> bool:
@@ -633,11 +785,26 @@ def maybe_start(rank: Optional[int] = None) -> Optional[HealthEngine]:
         return _engine
 
 
+def membership_policy() -> MembershipPolicy:
+    """The process membership policy (created lazily; bound to the
+    running engine when there is one, engine-less otherwise — the
+    elastic coordinator works either way, it just loses the event
+    emission and straggler-derived advice)."""
+    global _policy
+    with _engine_lock:
+        if _policy is None:
+            _policy = MembershipPolicy(_engine)
+        elif _policy._engine is None and _engine is not None:
+            _policy._engine = _engine
+        return _policy
+
+
 def stop() -> None:
     """Stop and drop the process engine (tests / explicit teardown)."""
-    global _engine
+    global _engine, _policy
     with _engine_lock:
         eng, _engine = _engine, None
+        _policy = None
     if eng is not None:
         eng.stop()
 

@@ -90,6 +90,15 @@ def reset_planes(reason: str = "reconfigure") -> None:
         log.info("async plane membership marked stale (%s)", reason)
 
 
+def note_membership(generation: int) -> None:
+    """Elastic membership hook (``robustness/elastic.py``): mark every
+    live plane's membership stale at the bumped ``generation`` — a grow
+    changes slice leadership exactly like an eviction does, and a joiner
+    whose received anchor rides the snapshot pages must fold its first
+    outer round against the NEW membership, never the donor's old one."""
+    reset_planes(f"membership g{generation}")
+
+
 @dataclasses.dataclass(frozen=True)
 class Membership:
     """One slice's view of the cross-slice group: which slice it is, how

@@ -792,6 +792,19 @@ def invalidate_plan_cache(reason: str = "reconfigure") -> None:
     get_logger().info("step-plan cache invalidated (%s)", reason)
 
 
+def note_membership(generation: int, world_size: int) -> None:
+    """Elastic membership hook (``robustness/elastic.py``): the group
+    just reshaped to ``world_size`` members at ``generation`` — grow or
+    shrink. Plans solved for any other world are dead; the first
+    post-reshape step re-derives its plan (and the bandwidth split /
+    chunk geometry underneath it) at the bumped generation. Distinct
+    from the eviction cascade only in attribution: the metric and log
+    line name the membership event so a grow's re-plan cost is
+    distinguishable from a failure's."""
+    invalidate_plan_cache(f"membership g{generation} ws{world_size}")
+    metrics.add("cgx.plan.membership_replans")
+
+
 def _chip_fingerprint() -> str:
     try:
         dev = jax.devices()[0]

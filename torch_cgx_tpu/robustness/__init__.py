@@ -26,6 +26,7 @@ observability package (and certainly without an accelerator runtime).
 from .errors import (
     BridgeTimeoutError,
     EvictedError,
+    JoinAbortedError,
     RecoveryFailedError,
     StaleGenerationError,
     WireCorruptionError,
@@ -42,7 +43,7 @@ from .heartbeat import Heartbeat, ensure_heartbeat, suspect_dead_pids
 # Only modules NOT already bound by the eager imports above: the import
 # system sets `faults`/`heartbeat`/`errors` as package attributes when
 # the from-imports run, so __getattr__ never fires for those.
-_LAZY = ("supervisor", "rendezvous", "retry")
+_LAZY = ("supervisor", "rendezvous", "retry", "elastic")
 
 
 def __getattr__(name: str):
@@ -60,6 +61,7 @@ __all__ = [
     "WireCorruptionError",
     "StaleGenerationError",
     "EvictedError",
+    "JoinAbortedError",
     "RecoveryFailedError",
     "FaultInjector",
     "FaultSpec",

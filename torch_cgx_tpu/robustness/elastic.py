@@ -1,0 +1,1250 @@
+"""Checkpoint-free elastic membership: rank join without a restart.
+
+The PR 2 supervisor made the group *shrinkable*: an evicted rank is cut
+out in place and the survivors keep training. This module adds the other
+direction — a fresh process joins a RUNNING group and enters the step
+loop bit-identical to a rank that was never gone, with zero checkpoint
+files on disk. The pieces:
+
+* **Join rendezvous** — the joiner announces itself through a store
+  intent counter; survivors notice at a step boundary, agree on a
+  step-synchronized join point two steps out (first seer claims the
+  trigger slot atomically — no leader, exactly the rendezvous claim
+  discipline), then run a vote → claim → decision round under
+  ``cgxjoin/g<N>/`` mirroring :mod:`.rendezvous`. The decision carries
+  the new member set, the joiners' assigned global ranks, the
+  load-ranked donor set, every member's host fingerprint, and the step
+  the joiner will resume at. A two-phase **outcome claim** closes the
+  round: survivors wait for the joiners' admit acks; whoever first sees
+  the acks complete (or the deadline expire) claims the outcome slot and
+  publishes ``commit`` or ``abort`` — every side follows the published
+  outcome, so a survivor timing out while another sees the ack land can
+  never split the group.
+
+* **Snapshot pages** — on commit the donors ship the live in-memory
+  training state (params, optimizer state, EF residuals, the async
+  outer-plane anchor — whatever rides the user's state tree) as
+  crc32-framed pages over the PR 15 counter-stream transport (the new
+  ``P_RAW``/``P_PAGE`` frame kinds). The default is RAW pages: the
+  joiner's state is byte-for-byte the donors'. Registering a
+  ``param_page`` wire edge makes the join wire lossy, in which case
+  every SURVIVOR snaps its own state to the codec grid at the commit
+  point (encode + decode locally through the same deterministic codec),
+  so all members land on identical bytes again. A corrupt page frame is
+  re-requested from its donor (header identity via
+  ``transport.peek_header``), bounded — never a wedge.
+
+* **Membership deltas** — survivors call
+  :meth:`ProcessGroupCGX.reconfigure` with the grown member list plus
+  the joiners' host info; the joiner constructs its group directly at
+  the bumped generation (``peer_info=`` skips the store exchange a
+  mid-step group would never answer). Trace caches, plans, and the
+  async plane are invalidated through the same cascade an eviction
+  runs.
+
+Every wait on the join path — the joiner's admit poll, the survivors'
+ack wait, page staleness, the final ready barrier — is bounded by the
+single ``CGX_JOIN_TIMEOUT_MS`` knob. A joiner that times out aborts
+ALONE (:class:`JoinAbortedError`); survivors are never stalled longer
+than the bound. With ``CGX_ELASTIC`` unset the whole plane is inert:
+the step-boundary hook returns immediately and no store key is touched.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import config as cfg
+from ..observability import flightrec
+from ..observability import health as health_mod
+from ..observability import timeline
+from ..serving import transport as wire
+from ..utils.logging import get_logger, metrics
+from . import rendezvous as rdz
+from .errors import BridgeTimeoutError, JoinAbortedError
+
+log = get_logger()
+
+JOIN_PREFIX = "cgxjoin"
+
+# Page geometry: 1 MiB of wire bytes per frame — large enough that the
+# store round-trips amortize, small enough that a corruption re-request
+# re-ships a bounded sliver of the snapshot.
+PAGE_BYTES = 1 << 20
+
+# Re-requests per page before the joiner declares the wire hopeless.
+MAX_PAGE_REREQS = 3
+
+# Grace added to a comeback notice's own delay before the reserved slot
+# expires (mirrors MembershipPolicy.REJOIN_SLACK_S).
+REJOIN_GRACE_S = 60.0
+
+_POLL_S = 0.05
+
+
+# ---------------------------------------------------------------------------
+# Cross-generation keys. Everything under cgxelastic/ deliberately lives
+# OUTSIDE the g<N>/ namespace: a joiner announcing itself does not know
+# the group's generation yet, and a comeback notice must survive the very
+# generation bump it causes. The per-generation protocol keys all live
+# under cgxjoin/g<N>/ and are reaped with the rendezvous's.
+# ---------------------------------------------------------------------------
+
+
+def _intent_counter_key() -> str:
+    return "cgxelastic/intents/n"
+
+
+def _intent_key(k: int) -> str:
+    return f"cgxelastic/intents/{k}"
+
+
+def _admit_key(k: int) -> str:
+    return f"cgxelastic/admit/{k}"
+
+
+def _comeback_key(global_rank: int) -> str:
+    return f"cgxelastic/comeback/{global_rank}"
+
+
+def _trigger_key(consumed: int, generation: int) -> str:
+    # Keyed by (intent watermark, target generation): a shrink landing
+    # between trigger and join point moves every survivor to a new
+    # generation together, so a trigger claimed for the dead generation
+    # is simply never adopted again (the stale key is a bounded leak).
+    return f"cgxelastic/trig/{consumed}g{generation}"
+
+
+def _stream_name(generation: int, joiner: int, donor_idx: int) -> str:
+    return f"join-g{generation}-r{joiner}-d{donor_idx}"
+
+
+def _my_host_info() -> str:
+    from ..torch_backend import shm as shm_mod
+
+    return f"{shm_mod.host_fingerprint()}|{os.getpid()}"
+
+
+# ---------------------------------------------------------------------------
+# The join decision record.
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class JoinDecision:
+    """The converged outcome of one join rendezvous. All ranks GLOBAL.
+
+    ``step`` is the step index every survivor shipped its state at and
+    the joiner resumes from; ``step == -1`` marks a claim winner that
+    could not admit anyone (no live intents, or the survivors' voted
+    steps disagreed — a should-never-happen drift) — survivors treat it
+    as an immediate abort and the joiner, receiving no admit record,
+    times out alone. ``bits == 0`` means raw (lossless) snapshot pages.
+    """
+
+    generation: int
+    members: Tuple[int, ...]
+    survivors: Tuple[int, ...]
+    joiners: Tuple[int, ...]
+    donors: Tuple[int, ...]
+    hosts: Dict[int, str]
+    intents: Dict[int, int]  # joiner global rank -> intent index
+    intents_n: int
+    step: int
+    bits: int
+    bucket: int
+    trigger_key: str
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "generation": self.generation,
+                "members": list(self.members),
+                "survivors": list(self.survivors),
+                "joiners": list(self.joiners),
+                "donors": list(self.donors),
+                "hosts": {str(g): v for g, v in self.hosts.items()},
+                "intents": {str(g): k for g, k in self.intents.items()},
+                "intents_n": self.intents_n,
+                "step": self.step,
+                "bits": self.bits,
+                "bucket": self.bucket,
+                "trigger_key": self.trigger_key,
+            },
+            sort_keys=True,
+        )
+
+    @classmethod
+    def from_json(cls, raw: str) -> "JoinDecision":
+        d = json.loads(raw)
+        return cls(
+            generation=int(d["generation"]),
+            members=tuple(int(g) for g in d["members"]),
+            survivors=tuple(int(g) for g in d["survivors"]),
+            joiners=tuple(int(g) for g in d["joiners"]),
+            donors=tuple(int(g) for g in d["donors"]),
+            hosts={int(g): str(v) for g, v in d["hosts"].items()},
+            intents={int(g): int(k) for g, k in d["intents"].items()},
+            intents_n=int(d["intents_n"]),
+            step=int(d["step"]),
+            bits=int(d["bits"]),
+            bucket=int(d["bucket"]),
+            trigger_key=str(d["trigger_key"]),
+        )
+
+
+def _param_page_config() -> Tuple[int, int]:
+    """(bits, bucket) the snapshot pages ship under. (0, 0) = raw — the
+    default, because ``param_page`` is excluded from the CGX_WIRE_BITS
+    env fallback (wire/edges.py): only an explicitly registered edge may
+    trade the joiner's bit-identity for wire bytes."""
+    from ..wire import edges as wire_edges
+
+    ec = wire_edges.resolve_edge(wire_edges.EDGE_PARAM_PAGE, "state")
+    if ec is None or ec.cc.bits <= 0:
+        return 0, 0
+    return int(ec.cc.bits), int(ec.cc.bucket_size or 512)
+
+
+# ---------------------------------------------------------------------------
+# Comeback notices (the preempt fault's survivor-visible half).
+# ---------------------------------------------------------------------------
+
+
+def publish_comeback(store, global_rank: int, delay_s: float) -> None:
+    """A rank about to die with notice (platform preemption) records that
+    it intends to return in ``delay_s`` seconds. The supervisor's rejoin
+    rung reads this to prefer reserving the rank over forgetting it."""
+    rec = {
+        "rank": int(global_rank),
+        "delay_s": float(delay_s),
+        "ts": time.time(),
+    }
+    # cgx-analysis: allow(generation-hygiene) — the comeback notice must survive the generation bump the death it announces will cause; keyed by global rank, overwritten per notice
+    rdz._publish(store, _comeback_key(global_rank), json.dumps(rec, sort_keys=True))
+    metrics.add("cgx.elastic.comebacks")
+    log.warning(
+        "elastic: rank %d published a comeback notice (back in ~%.1fs)",
+        global_rank, delay_s,
+    )
+
+
+def fresh_comeback(store, global_rank: int) -> Optional[dict]:
+    """The rank's comeback record, if one exists and has not expired
+    (its own promised delay plus :data:`REJOIN_GRACE_S`)."""
+    key = _comeback_key(global_rank)
+    if not rdz._flag_set(store, key):
+        return None
+    try:
+        rec = json.loads(rdz._read(store, key))
+    except Exception as e:
+        log.warning("elastic: comeback record for %d unreadable: %s",
+                    global_rank, e)
+        return None
+    age = time.time() - float(rec.get("ts", 0.0))
+    if age > float(rec.get("delay_s", 0.0)) + REJOIN_GRACE_S:
+        return None
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Snapshot paging: state tree <-> wire bytes.
+# ---------------------------------------------------------------------------
+
+
+def _leaf_wire(arr: np.ndarray, bits: int, bucket: int) -> Tuple[int, bytes]:
+    """(frame kind, wire bytes) for one state leaf. Only float32 leaves
+    are ever codec-compressed — integer leaves (step counters, rng keys)
+    must arrive exact regardless of the edge config."""
+    if bits and arr.dtype == np.float32 and arr.size:
+        from ..ops import codec_host
+
+        q = codec_host.quantize(
+            np.ascontiguousarray(arr.reshape(-1)), bits, bucket
+        )
+        return wire.P_PAGE, q.to_bytes().tobytes()
+    return wire.P_RAW, np.ascontiguousarray(arr).tobytes()
+
+
+def _decode_leaf(desc: dict, buf: bytes, bits: int, bucket: int) -> np.ndarray:
+    shape = tuple(int(s) for s in desc["shape"])
+    dtype = np.dtype(str(desc["dtype"]))
+    numel = int(desc["numel"])
+    if int(desc["kind"]) == wire.P_PAGE:
+        from ..ops import codec_host
+
+        q = codec_host.from_bytes(
+            np.frombuffer(buf, np.uint8), numel, bits, bucket, dtype
+        )
+        return codec_host.dequantize(q).reshape(shape).astype(
+            dtype, copy=False
+        )
+    if numel == 0:
+        return np.zeros(shape, dtype=dtype)
+    arr = np.frombuffer(buf[: numel * dtype.itemsize], dtype=dtype)
+    return arr.reshape(shape).copy()
+
+
+def _encode_state(state: Any, bits: int, bucket: int):
+    """Flatten ``state`` and encode every leaf: (wires, descs). All
+    donors hold bit-identical state (the group invariant the supervisor
+    replay machinery maintains) and the codec is deterministic, so every
+    donor produces the SAME bytes per leaf — which is what lets the page
+    stripes interleave across donors."""
+    import jax
+
+    leaves, _ = jax.tree_util.tree_flatten(state)
+    wires: List[bytes] = []
+    descs: List[dict] = []
+    for leaf in leaves:
+        arr = np.asarray(leaf)
+        kind, wb = _leaf_wire(arr, bits, bucket)
+        pages = max(1, -(-len(wb) // PAGE_BYTES))
+        wires.append(wb)
+        descs.append({
+            "kind": int(kind),
+            "dtype": str(arr.dtype),
+            "shape": [int(s) for s in arr.shape],
+            "numel": int(arr.size),
+            "bytes": len(wb),
+            "pages": int(pages),
+        })
+    return wires, descs
+
+
+def snap_state_to_grid(state: Any, bits: int, bucket: int) -> Any:
+    """Encode + decode every float32 leaf through the join codec
+    locally. When the join wire is lossy, every SURVIVOR runs this at
+    the commit point so its state lands on the same codec grid the
+    joiner's decoded pages land on — cross-rank bit-identity is restored
+    without shipping a byte between survivors."""
+    if not bits:
+        return state
+    import jax
+
+    def snap(x):
+        arr = np.asarray(x)
+        if arr.dtype == np.float32 and arr.size:
+            from ..ops import codec_host
+
+            q = codec_host.quantize(
+                np.ascontiguousarray(arr.reshape(-1)), bits, bucket
+            )
+            return codec_host.dequantize(q).reshape(arr.shape).astype(
+                np.float32, copy=False
+            )
+        return x
+
+    return jax.tree_util.tree_map(snap, state)
+
+
+class _SnapshotDonor:
+    """One donor's shipping job for one joiner: frame and post this
+    donor's page stripe (global page ordinal mod n_donors), then serve
+    bounded re-requests until the joiner's done flag or the deadline."""
+
+    def __init__(
+        self,
+        store,
+        stream: str,
+        wires: List[bytes],
+        descs: List[dict],
+        *,
+        meta: Optional[dict],
+        donor_idx: int,
+        n_donors: int,
+        bits: int,
+        bucket: int,
+        deadline: float,
+        injector=None,
+    ):
+        self._store = store
+        self._stream = stream
+        self._wires = wires
+        self._descs = descs
+        self._meta = meta
+        self._donor_idx = donor_idx
+        self._n_donors = n_donors
+        self._bits = bits
+        self._bucket = bucket
+        self._deadline = deadline
+        self._injector = injector
+        self._sender = wire.KvPageSender(store, stream)
+        self._rereq_seen = 0
+        self._thread = threading.Thread(
+            target=self._run, name=f"cgx-elastic-donor-{stream}", daemon=True
+        )
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def done(self) -> bool:
+        return not self._thread.is_alive()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout=timeout)
+
+    # -- shipping ---------------------------------------------------------
+
+    def _frame(self, leaf: int, page: int) -> bytes:
+        d = self._descs[leaf]
+        payload = self._wires[leaf][page * PAGE_BYTES:(page + 1) * PAGE_BYTES]
+        return wire.frame_page(
+            leaf, int(d["kind"]), page, self._bits, self._bucket,
+            int(d["numel"]), payload, checksum=True,
+        )
+
+    def _run(self) -> None:
+        try:
+            if self._meta is not None:
+                self._sender._post(wire.meta_frame(self._meta))
+            ordinal = 0
+            shipped = 0
+            for li, d in enumerate(self._descs):
+                for p in range(int(d["pages"])):
+                    if ordinal % self._n_donors == self._donor_idx:
+                        buf = self._frame(li, p)
+                        if self._injector is not None:
+                            # corrupt_join_page fires AFTER the crc was
+                            # computed — the flip reaches the wire.
+                            hdr = wire._FRAME.size
+                            buf = buf[:hdr] + self._injector.\
+                                corrupt_join_payload(buf[hdr:], ordinal)
+                        self._sender._post(buf)
+                        shipped += 1
+                    ordinal += 1
+            metrics.add("cgx.elastic.pages_shipped", float(shipped))
+            self._serve_rereqs()
+        except Exception as e:
+            log.warning("elastic donor %s: shipping failed: %s",
+                        self._stream, e)
+            flightrec.record_failure(e, op="elastic.donate",
+                                     key=self._stream)
+        finally:
+            self._sender.stop()
+
+    def _serve_rereqs(self) -> None:
+        """Poll the joiner's re-request counter until it flags the
+        stream done (or the join deadline passes). Re-ships post CLEAN
+        frames — the injector's page ordinal already fired once."""
+        base = f"cgxkv/{self._stream}"
+        while time.monotonic() < self._deadline:
+            try:
+                if int(self._store.add(f"{base}/done", 0)) > 0:
+                    return
+                n = int(self._store.add(f"{base}/rereq/n", 0))
+            except Exception as e:
+                log.warning("elastic donor %s: rereq poll failed: %s",
+                            self._stream, e)
+                return
+            for i in range(self._rereq_seen + 1, n + 1):
+                try:
+                    req = json.loads(rdz._read(self._store,
+                                               f"{base}/rereq/{i}"))
+                    self._sender._post(
+                        self._frame(int(req["leaf"]), int(req["page"]))
+                    )
+                    metrics.add("cgx.elastic.page_reships")
+                except Exception as e:
+                    log.warning(
+                        "elastic donor %s: rereq %d unserveable: %s",
+                        self._stream, i, e,
+                    )
+            self._rereq_seen = n
+            time.sleep(_POLL_S)
+        log.warning(
+            "elastic donor %s: deadline passed with the stream not "
+            "flagged done", self._stream,
+        )
+
+
+class _SnapshotReceiver:
+    """Joiner side: drain every donor stream, re-request corrupt pages,
+    assemble per-leaf wire buffers. Completion comes from the META
+    frame's leaf descriptors; every wait is bounded by the deadline."""
+
+    def __init__(self, store, streams: Sequence[str], deadline: float):
+        self._store = store
+        self._streams = list(streams)
+        self._deadline = deadline
+        self._consumed = {s: 0 for s in self._streams}
+        self._rereq_sent = {s: 0 for s in self._streams}
+        self._rereq_count: Dict[Tuple[int, int], int] = {}
+        self._meta: Optional[dict] = None
+        self._bufs: List[bytearray] = []
+        self._got: set = set()
+        self._need = -1
+        self._stash: List[wire.PageFrame] = []
+
+    def receive(self) -> Tuple[dict, List[bytes]]:
+        while True:
+            progressed = False
+            for si, s in enumerate(self._streams):
+                progressed |= self._drain(si, s)
+            if self._meta is not None and len(self._got) >= self._need:
+                for s in self._streams:
+                    # cgx-analysis: allow(generation-hygiene) — the stream name carries the generation in-band (join-g<N>-r<J>-d<D>)
+                    self._store.add(f"cgxkv/{s}/done", 1)
+                metrics.add("cgx.elastic.pages_received",
+                            float(len(self._got)))
+                return self._meta, [bytes(b) for b in self._bufs]
+            if time.monotonic() > self._deadline:
+                metrics.add("cgx.elastic.join_aborts")
+                raise JoinAbortedError(
+                    f"elastic join: snapshot transfer incomplete at the "
+                    f"deadline ({len(self._got)}/{self._need} pages, meta "
+                    f"{'seen' if self._meta else 'missing'}) — donors "
+                    "died or CGX_JOIN_TIMEOUT_MS is too tight for the "
+                    "state size"
+                )
+            if not progressed:
+                time.sleep(_POLL_S)
+
+    # -- internals --------------------------------------------------------
+
+    def _drain(self, si: int, stream: str) -> bool:
+        try:
+            n = int(self._store.add(f"cgxkv/{stream}/n", 0))
+        except Exception as e:
+            log.warning("elastic join: counter read for %s failed: %s",
+                        stream, e)
+            return False
+        progressed = False
+        for seq in range(self._consumed[stream] + 1, n + 1):
+            key = f"cgxkv/{stream}/{seq}"
+            try:
+                buf = bytes(self._store.get(key))
+            except Exception as e:
+                log.warning("elastic join: fetch %s failed: %s", key, e)
+                return progressed
+            self._consumed[stream] = seq
+            rdz._delete(self._store, key)
+            progressed = True
+            try:
+                frame = wire.unframe_page(buf)
+            except Exception:
+                self._rerequest(stream, buf)
+                continue
+            if frame.is_meta:
+                self._on_meta(json.loads(frame.payload.decode()))
+            else:
+                self._place(frame)
+        return progressed
+
+    def _on_meta(self, meta: dict) -> None:
+        self._meta = meta
+        descs = meta["leaves"]
+        self._bufs = [bytearray(int(d["bytes"])) for d in descs]
+        self._need = sum(int(d["pages"]) for d in descs)
+        for frame in self._stash:
+            self._place(frame)
+        self._stash = []
+
+    def _place(self, frame: wire.PageFrame) -> None:
+        if self._meta is None:
+            self._stash.append(frame)
+            return
+        li, p = frame.layer, frame.page_idx
+        if (li, p) in self._got or li >= len(self._bufs):
+            return  # duplicate (late original after a re-request) or junk
+        off = p * PAGE_BYTES
+        self._bufs[li][off:off + len(frame.payload)] = frame.payload
+        self._got.add((li, p))
+
+    def _rerequest(self, stream: str, buf: bytes) -> None:
+        """A frame failed its checksum: name the page from the unverified
+        header and ask its donor to re-ship — the corrupt-page contract
+        (re-request, never wedge, never silently accept)."""
+        try:
+            hdr = wire.peek_header(buf)
+        except Exception as e:
+            raise JoinAbortedError(
+                "elastic join: received a frame too mangled to even name "
+                f"the page to re-request ({e})"
+            )
+        pk = (hdr.layer, hdr.page_idx)
+        self._rereq_count[pk] = self._rereq_count.get(pk, 0) + 1
+        if self._rereq_count[pk] > MAX_PAGE_REREQS:
+            metrics.add("cgx.elastic.join_aborts")
+            raise JoinAbortedError(
+                f"elastic join: page (leaf {hdr.layer}, page "
+                f"{hdr.page_idx}) failed its checksum "
+                f"{self._rereq_count[pk]} times — the join wire is "
+                "persistently corrupt"
+            )
+        i = self._rereq_sent[stream] + 1
+        self._rereq_sent[stream] = i
+        # Publish-after-write, single writer: payload key first, counter
+        # after, so the donor's poll never reads a half-posted request.
+        # cgx-analysis: allow(generation-hygiene) — the stream name carries the generation in-band (join-g<N>-r<J>-d<D>)
+        self._store.set(
+            f"cgxkv/{stream}/rereq/{i}",
+            json.dumps({"leaf": hdr.layer, "page": hdr.page_idx}).encode(),
+        )
+        # cgx-analysis: allow(generation-hygiene) — the stream name carries the generation in-band (join-g<N>-r<J>-d<D>)
+        self._store.add(f"cgxkv/{stream}/rereq/n", 1)
+        metrics.add("cgx.elastic.page_rereqs")
+        log.warning(
+            "elastic join: page (leaf %d, page %d) corrupt on %s — "
+            "re-requested (%d/%d)", hdr.layer, hdr.page_idx, stream,
+            self._rereq_count[pk], MAX_PAGE_REREQS,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Joiner entry.
+# ---------------------------------------------------------------------------
+
+
+def announce_join(store, *, global_rank: int = -1,
+                  host: Optional[str] = None) -> int:
+    """Post a join intent; returns the intent index the admit record
+    will be published under. ``global_rank`` is the identity the joiner
+    wants back (a respawned preempted rank reuses its original); -1
+    requests fresh capacity and the decision claim winner assigns the
+    next free global rank."""
+    rec = {
+        "rank": int(global_rank),
+        "host": host or _my_host_info(),
+        "ts": time.time(),
+    }
+    # The counter IS the index allocator; the payload flag (written
+    # after the payload) is what survivors trust, so the early bump is
+    # safe — an intent whose flag never lands is skipped at decision
+    # time and its joiner times out and re-announces.
+    # cgx-analysis: allow(generation-hygiene) — join intents are PRE-generation by nature: the joiner cannot know the group's generation before being admitted to one
+    k = int(store.add(_intent_counter_key(), 1))
+    # cgx-analysis: allow(generation-hygiene) — same pre-generation intent record as the counter above
+    rdz._publish(store, _intent_key(k), json.dumps(rec, sort_keys=True))
+    metrics.add("cgx.elastic.join_intents")
+    log.info("elastic: join intent %d posted (rank %d)", k, global_rank)
+    return k
+
+
+@dataclasses.dataclass
+class JoinResult:
+    """What :func:`join` hands back: a live group at the bumped
+    generation plus the received state, positioned at ``step``. Pass
+    ``decision.intents_n`` as the coordinator's ``consumed`` watermark
+    when wiring the joiner's own :class:`ElasticCoordinator`."""
+
+    group: Any
+    state: Any
+    step: int
+    generation: int
+    members: List[int]
+    decision: JoinDecision
+
+
+def join(
+    store,
+    skeleton: Any,
+    *,
+    global_rank: int = -1,
+    timeout_s: Optional[float] = None,
+) -> JoinResult:
+    """Boot into a running group with no checkpoint: announce, wait for
+    the admit record, ack, follow the published outcome, receive the
+    snapshot pages, and construct the group at the bumped generation.
+
+    ``skeleton`` is a state tree with the right STRUCTURE (the caller
+    builds it from model code — shapes/dtypes are validated against the
+    donors' leaf descriptors, values are ignored). Raises
+    :class:`JoinAbortedError` on any bounded wait expiring — the joiner
+    aborts alone; survivors carry on untouched and a later re-announce
+    starts a fresh intent."""
+    t0 = time.perf_counter()
+    timeout = (timeout_s if timeout_s is not None
+               else cfg.join_timeout_ms() / 1000.0)
+    deadline = time.monotonic() + timeout
+    k = announce_join(store, global_rank=global_rank)
+    akey = _admit_key(k)
+    while not rdz._flag_set(store, akey):
+        if time.monotonic() > deadline:
+            metrics.add("cgx.elastic.join_aborts")
+            raise JoinAbortedError(
+                f"elastic join: intent {k} was never admitted within "
+                f"{timeout:.1f}s — no survivor noticed (CGX_ELASTIC off "
+                "on the group?), the group aborted the grow, or there is "
+                "no group"
+            )
+        time.sleep(_POLL_S)
+    admit = json.loads(rdz._read(store, akey))
+    decision = JoinDecision.from_json(json.dumps(admit))
+    me = int(admit["you"])
+    N = decision.generation
+    jbase = f"{JOIN_PREFIX}/g{N}"
+    store.add(f"{jbase}/jack", 1)
+    okey = f"{jbase}/outcome"
+    while not rdz._flag_set(store, okey):
+        if time.monotonic() > deadline:
+            metrics.add("cgx.elastic.join_aborts")
+            raise JoinAbortedError(
+                f"elastic join: admitted as rank {me} at generation {N} "
+                f"but no outcome was published within {timeout:.1f}s"
+            )
+        time.sleep(_POLL_S)
+    if rdz._read(store, okey) != "commit":
+        metrics.add("cgx.elastic.join_aborts")
+        raise JoinAbortedError(
+            f"elastic join: the survivors aborted the generation-{N} grow "
+            "(a joiner's ack never landed within the bound)"
+        )
+    streams = [_stream_name(N, me, di) for di in range(len(decision.donors))]
+    meta, bufs = _SnapshotReceiver(store, streams, deadline).receive()
+    state, step = _decode_into_skeleton(skeleton, meta, bufs)
+    from .. import checkpoint as ckpt
+
+    ckpt.restore_registry(meta.get("registry") or {})
+    members = list(decision.members)
+    rank = members.index(me)
+    peer_info = [decision.hosts[g] for g in members]
+    from ..torch_backend.backend import ProcessGroupCGX
+
+    group = ProcessGroupCGX(
+        store, rank, len(members),
+        generation=N, global_ranks=members, peer_info=peer_info,
+    )
+    _publish_shmok(store, N, group, decision, me)
+    store.add(f"{jbase}/ready", 1)
+    while int(store.add(f"{jbase}/ready", 0)) < len(members):
+        if time.monotonic() > deadline:
+            metrics.add("cgx.elastic.join_aborts")
+            raise JoinAbortedError(
+                f"elastic join: ready barrier did not fill within "
+                f"{timeout:.1f}s ({int(store.add(f'{jbase}/ready', 0))}"
+                f"/{len(members)}) — a survivor died mid-grow"
+            )
+        time.sleep(_POLL_S)
+    _apply_shm_consensus(store, N, group, decision)
+    from . import supervisor as sup_mod
+
+    sup_mod.invalidate_trace_caches()
+    _note_membership(N, len(members))
+    health_mod.membership_policy().note_membership_change(N, len(members))
+    dt = time.perf_counter() - t0
+    metrics.add("cgx.elastic.joins")
+    metrics.set("cgx.elastic.last_join_ms", dt * 1000.0)
+    timeline.record("elastic.join", timeline.CAT_RECOVERY, t0, dt,
+                    generation=N, rank=me, ws=len(members))
+    flightrec.record(
+        "elastic", phase="joined", generation=N, rank=me,
+        ws=len(members), step=step, ms=round(dt * 1000.0, 3),
+    )
+    log.info(
+        "elastic: joined generation %d as global rank %d (ws %d, step "
+        "%d, %.0f ms)", N, me, len(members), step, dt * 1000.0,
+    )
+    return JoinResult(
+        group=group, state=state, step=step, generation=N,
+        members=members, decision=decision,
+    )
+
+
+def _decode_into_skeleton(skeleton: Any, meta: dict,
+                          bufs: List[bytes]) -> Tuple[Any, int]:
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(skeleton)
+    descs = meta["leaves"]
+    if len(leaves) != len(descs):
+        raise JoinAbortedError(
+            f"elastic join: skeleton has {len(leaves)} leaves but the "
+            f"donors shipped {len(descs)} — the joiner is running "
+            "different model code than the group"
+        )
+    bits, bucket = int(meta.get("bits", 0)), int(meta.get("bucket", 0))
+    out = []
+    for i, (leaf, desc, buf) in enumerate(zip(leaves, descs, bufs)):
+        want = tuple(np.asarray(leaf).shape)
+        got = tuple(int(s) for s in desc["shape"])
+        if want != got:
+            raise JoinAbortedError(
+                f"elastic join: leaf {i} shape mismatch — skeleton "
+                f"{want}, donors {got}"
+            )
+        out.append(_decode_leaf(desc, buf, bits, bucket))
+    return jax.tree_util.tree_unflatten(treedef, out), int(meta["step"])
+
+
+# ---------------------------------------------------------------------------
+# shm admission consensus (grow version of the boot-time ok handshake).
+# ---------------------------------------------------------------------------
+
+
+def _publish_shmok(store, generation: int, group, decision: JoinDecision,
+                   me: int) -> None:
+    """Before the ready ack: '1' when this member either has a live shm
+    channel or needs none (alone on its host). Published-before-ready,
+    so after the barrier every member reads a complete, identical set
+    and the degrade verdict is unanimous without another round."""
+    jbase = f"{JOIN_PREFIX}/g{generation}"
+    fp = decision.hosts.get(me, "|").rsplit("|", 1)[0]
+    local_peers = sum(
+        1 for g in decision.members
+        if g != me and decision.hosts.get(g, "|").rsplit("|", 1)[0] == fp
+    )
+    ok = "1" if (getattr(group, "_shm", None) is not None
+                 or local_peers == 0) else "0"
+    rdz._publish(store, f"{jbase}/shmok{me}", ok)
+
+
+def _apply_shm_consensus(store, generation: int, group,
+                         decision: JoinDecision) -> None:
+    jbase = f"{JOIN_PREFIX}/g{generation}"
+    bad = []
+    for g in decision.members:
+        key = f"{jbase}/shmok{g}"
+        try:
+            if rdz._flag_set(store, key) and rdz._read(store, key) == "0":
+                bad.append(g)
+        except Exception:
+            bad.append(g)
+    if bad and getattr(group, "_shm", None) is not None:
+        log.warning(
+            "elastic: member(s) %s could not (re)admit their shm arena — "
+            "whole group drops to the store transport", bad,
+        )
+        group.degrade_to_store()
+
+
+def _note_membership(generation: int, ws: int) -> None:
+    """Planner / async-plane invalidation hooks, lazy: neither module is
+    imported into a process that never used it."""
+    import sys
+
+    planner = sys.modules.get("torch_cgx_tpu.parallel.planner")
+    if planner is not None:
+        planner.note_membership(generation, ws)
+    async_plane = sys.modules.get("torch_cgx_tpu.parallel.async_plane")
+    if async_plane is not None:
+        async_plane.note_membership(generation)
+
+
+# ---------------------------------------------------------------------------
+# Survivor side.
+# ---------------------------------------------------------------------------
+
+
+class ElasticCoordinator:
+    """The survivors' half of the join plane, driven from the
+    supervisor's step boundary (``run_steps`` calls
+    :meth:`on_step_boundary` before every step once attached).
+
+    ``consumed`` is the intent watermark — a joiner wiring its own
+    coordinator after :func:`join` passes
+    ``result.decision.intents_n`` so already-admitted intents are never
+    re-triggered."""
+
+    def __init__(self, store, supervisor, *, consumed: int = 0):
+        self._store = store
+        self._sup = supervisor
+        self._consumed = int(consumed)
+        self._trigger: Optional[dict] = None
+        self._donations: List[_SnapshotDonor] = []
+        supervisor.attach_elastic(self)
+
+    @property
+    def consumed(self) -> int:
+        return self._consumed
+
+    # -- the per-step hook ------------------------------------------------
+
+    def on_step_boundary(self, state: Any, step: int) -> Any:
+        """One store counter read per boundary when idle; runs the whole
+        admit sequence at the agreed join step. Returns the (possibly
+        grid-snapped) state. Inert without ``CGX_ELASTIC``."""
+        if not cfg.elastic_enabled():
+            return state
+        self._donations = [d for d in self._donations if not d.done()]
+        if self._trigger is None:
+            self._check_trigger(step)
+        trig = self._trigger
+        if trig is not None and step >= int(trig["join_step"]):
+            self._trigger = None
+            if int(trig["generation"]) != self._sup.generation + 1:
+                # A shrink landed between trigger and join point: every
+                # survivor dropped to this same branch (they all bumped
+                # together), and the next boundary re-triggers under the
+                # new generation's key.
+                flightrec.record(
+                    "elastic", phase="stale_trigger",
+                    trigger=trig, generation=self._sup.generation,
+                )
+                return state
+            state = self._admit(state, step, trig)
+        return state
+
+    def _check_trigger(self, step: int) -> None:
+        try:
+            n = int(self._store.add(_intent_counter_key(), 0))
+        except Exception as e:
+            log.warning("elastic: intent counter read failed: %s", e)
+            return
+        if n <= self._consumed:
+            return
+        gen_next = self._sup.generation + 1
+        tk = _trigger_key(self._consumed, gen_next)
+        if rdz._flag_set(self._store, tk):
+            trig = json.loads(rdz._read(self._store, tk))
+        elif int(self._store.add(tk + "/claim", 1)) == 1:
+            # First seer: pin the join point two steps out — every
+            # survivor sees the counter move within one step (the claim
+            # happened after the intent's add, and step t+1 collectives
+            # order every peer's boundary t+1 after this boundary), so
+            # all adopt this trigger before the join step arrives.
+            trig = {
+                "join_step": int(step) + 2,
+                "generation": gen_next,
+                "n": n,
+                "key": tk,
+            }
+            rdz._publish(self._store, tk, json.dumps(trig, sort_keys=True))
+            metrics.add("cgx.elastic.triggers")
+        else:
+            return  # claim lost; the winner's record is one boundary away
+        pol = health_mod.membership_policy()
+        for k in range(self._consumed + 1, int(trig["n"]) + 1):
+            if rdz._flag_set(self._store, _intent_key(k)):
+                try:
+                    rec = json.loads(rdz._read(self._store, _intent_key(k)))
+                    pol.note_join_intent(int(rec.get("rank", -1)))
+                except (KeyError, TypeError, ValueError):
+                    # Malformed or raced intent record: the health note
+                    # is advisory — admission itself re-reads and
+                    # validates every intent under the decision claim.
+                    continue
+        self._trigger = trig
+        flightrec.record(
+            "elastic", phase="trigger", join_step=trig["join_step"],
+            generation=trig["generation"], intents=trig["n"],
+        )
+
+    # -- the join rendezvous (survivor) -----------------------------------
+
+    def _admit(self, state: Any, step: int, trig: dict) -> Any:
+        t0 = time.perf_counter()
+        group = self._sup.group
+        me = group.global_rank
+        N = self._sup.generation + 1
+        jbase = f"{JOIN_PREFIX}/g{N}"
+        timeout = cfg.join_timeout_ms() / 1000.0
+        deadline = time.monotonic() + timeout
+        pol = health_mod.membership_policy()
+        rdz._publish(
+            self._store, f"{jbase}/v{me}",
+            json.dumps({
+                "load": pol.load_score(),
+                "host": _my_host_info(),
+                "step": int(step),
+            }, sort_keys=True),
+        )
+        decision = self._converge(N, me, step, trig, deadline)
+        if decision is None:
+            return state  # vote timeout: grow abandoned via outcome=abort
+        self._consumed = int(decision.intents_n)
+        if decision.step < 0 or not decision.joiners:
+            metrics.add("cgx.elastic.join_aborts")
+            flightrec.record(
+                "elastic", phase="empty_decision", generation=N,
+            )
+            return state
+        outcome = self._await_acks(decision, deadline)
+        if outcome != "commit":
+            metrics.add("cgx.elastic.join_aborts")
+            flightrec.record(
+                "elastic", phase="abort", generation=N,
+                joiners=list(decision.joiners),
+            )
+            log.warning(
+                "elastic: generation-%d grow aborted (joiner ack never "
+                "landed within %.1fs) — survivors carry on", N, timeout,
+            )
+            return state
+        # COMMIT: donors ship pages concurrently with the reconfigure —
+        # the page streams are plain store keys, untouched by the group
+        # rebuild.
+        if me in decision.donors:
+            self._start_donation(state, decision, deadline)
+        if decision.bits:
+            state = snap_state_to_grid(state, decision.bits,
+                                       decision.bucket)
+        joiner_info = {g: decision.hosts[g] for g in decision.joiners}
+        group.reconfigure(list(decision.members), N,
+                          joiner_info=joiner_info)
+        from . import supervisor as sup_mod
+
+        sup_mod.invalidate_trace_caches()
+        _note_membership(N, len(decision.members))
+        pol.note_membership_change(N, len(decision.members))
+        _publish_shmok(self._store, N, group, decision, me)
+        self._store.add(f"{jbase}/ready", 1)
+        while int(self._store.add(f"{jbase}/ready", 0)) < len(decision.members):
+            if time.monotonic() > deadline:
+                # Post-commit wedge: the joiner (or a peer) died between
+                # its ack and the barrier. Name the joiners as suspects
+                # in the NEW group's local indexing and let the regular
+                # recovery ladder evict them at generation N+1 — the
+                # survivors' bound on a broken grow is this one timeout.
+                suspects = [
+                    decision.members.index(j) for j in decision.joiners
+                ]
+                raise BridgeTimeoutError(
+                    f"elastic grow to generation {N}: ready barrier did "
+                    f"not fill within {timeout:.1f}s after commit",
+                    suspects=suspects,
+                )
+            time.sleep(_POLL_S)
+        _apply_shm_consensus(self._store, N, group, decision)
+        dt = time.perf_counter() - t0
+        metrics.add("cgx.elastic.grows")
+        metrics.set("cgx.elastic.last_join_ms", dt * 1000.0)
+        timeline.record(
+            "elastic.grow", timeline.CAT_RECOVERY, t0, dt,
+            generation=N, ws=len(decision.members),
+            joiners=list(decision.joiners),
+        )
+        flightrec.record(
+            "elastic", phase="grow", generation=N,
+            ws=len(decision.members), joiners=list(decision.joiners),
+            donors=list(decision.donors), step=int(decision.step),
+            ms=round(dt * 1000.0, 3),
+        )
+        log.info(
+            "elastic: grew to generation %d (ws %d, joiners %s, "
+            "%.0f ms)", N, len(decision.members),
+            list(decision.joiners), dt * 1000.0,
+        )
+        return state
+
+    def _converge(self, generation: int, me: int, step: int, trig: dict,
+                  deadline: float) -> Optional[JoinDecision]:
+        jbase = f"{JOIN_PREFIX}/g{generation}"
+        participants = sorted(self._sup.survivors)
+        votes: Dict[int, dict] = {}
+        while True:
+            if rdz._flag_set(self._store, f"{jbase}/decision"):
+                return JoinDecision.from_json(
+                    rdz._read(self._store, f"{jbase}/decision")
+                )
+            for p in participants:
+                if p not in votes and rdz._flag_set(
+                        self._store, f"{jbase}/v{p}"):
+                    votes[p] = json.loads(
+                        rdz._read(self._store, f"{jbase}/v{p}")
+                    )
+            if len(votes) == len(participants):
+                if int(self._store.add(f"{jbase}/decision/claim", 1)) == 1:
+                    decision = self._decide(step, trig, votes)
+                    rdz._publish(self._store, f"{jbase}/decision",
+                                 decision.to_json())
+                    if decision.step >= 0:
+                        for g, k in decision.intents.items():
+                            admit = json.loads(decision.to_json())
+                            admit["you"] = int(g)
+                            # cgx-analysis: allow(generation-hygiene) — admit records are keyed by PRE-generation intent index; the joiner reading them learns its generation from the payload
+                            rdz._publish(
+                                self._store, _admit_key(k),
+                                json.dumps(admit, sort_keys=True),
+                            )
+                    # One writer, exactly once: the previous generation's
+                    # rendezvous AND join keys retire together.
+                    rdz.reap_all(self._store, decision.generation - 1)
+                    return decision
+                continue  # claim lost — adopt the record next poll
+            if time.monotonic() > deadline:
+                # A survivor never voted (died mid-join). Abandon the
+                # grow through the outcome slot so a peer that converges
+                # a moment later cannot commit behind our back; the dead
+                # peer itself surfaces through the data plane's bounded
+                # waits and the normal shrink ladder.
+                if int(self._store.add(f"{jbase}/outcome/claim", 1)) == 1:
+                    rdz._publish(self._store, f"{jbase}/outcome", "abort")
+                self._consumed = max(self._consumed, int(trig["n"]))
+                metrics.add("cgx.elastic.join_aborts")
+                flightrec.record(
+                    "elastic", phase="vote_timeout",
+                    votes=sorted(votes), participants=participants,
+                )
+                log.warning(
+                    "elastic: join vote did not converge (votes from %s "
+                    "of %s) — grow abandoned", sorted(votes), participants,
+                )
+                return None
+            time.sleep(_POLL_S)
+
+    def _decide(self, step: int, trig: dict,
+                votes: Dict[int, dict]) -> JoinDecision:
+        N = self._sup.generation + 1
+        survivors = sorted(votes)
+        hosts = {p: str(v["host"]) for p, v in votes.items()}
+        step_ok = all(int(v["step"]) == int(step) for v in votes.values())
+        joiner_by_rank: Dict[int, str] = {}
+        intents: Dict[int, int] = {}
+        next_free = (max(survivors) + 1) if survivors else 0
+        for k in range(self._consumed + 1, int(trig["n"]) + 1):
+            if not rdz._flag_set(self._store, _intent_key(k)):
+                continue  # torn announce: skipped, joiner re-announces
+            try:
+                rec = json.loads(rdz._read(self._store, _intent_key(k)))
+            except Exception:
+                continue
+            want = int(rec.get("rank", -1))
+            taken = set(survivors) | set(joiner_by_rank)
+            if want >= 0 and want not in taken:
+                g = want  # identity preserved: a respawned rank is
+                # re-admitted under its original global rank
+            else:
+                while next_free in taken:
+                    next_free += 1
+                g = next_free
+            joiner_by_rank[g] = str(rec.get("host", ""))
+            intents[g] = k
+        if not intents or not step_ok:
+            # Nothing (or nothing coherent) to admit: a step=-1 record
+            # tells every survivor to consume the intents and move on.
+            return JoinDecision(
+                generation=N, members=tuple(survivors),
+                survivors=tuple(survivors), joiners=(), donors=(),
+                hosts=hosts, intents={}, intents_n=int(trig["n"]),
+                step=-1, bits=0, bucket=0,
+                trigger_key=str(trig.get("key", "")),
+            )
+        members = tuple(sorted(set(survivors) | set(joiner_by_rank)))
+        hosts.update(joiner_by_rank)
+        nd = min(cfg.join_donors(), len(survivors))
+        donors = tuple(sorted(
+            survivors,
+            key=lambda p: (float(votes[p].get("load", 0.0)), p),
+        )[:nd])
+        bits, bucket = _param_page_config()
+        return JoinDecision(
+            generation=N, members=members, survivors=tuple(survivors),
+            joiners=tuple(sorted(joiner_by_rank)), donors=donors,
+            hosts=hosts, intents=intents, intents_n=int(trig["n"]),
+            step=int(step), bits=bits, bucket=bucket,
+            trigger_key=str(trig.get("key", "")),
+        )
+
+    def _await_acks(self, decision: JoinDecision,
+                    deadline: float) -> str:
+        """Wait for every joiner's admit ack, then settle the outcome
+        through the atomic claim: commit wins over abort whenever the
+        acks are complete, and whichever survivor decides first decides
+        for all — the published outcome is the only truth."""
+        jbase = f"{JOIN_PREFIX}/g{decision.generation}"
+        okey = f"{jbase}/outcome"
+        want = len(decision.joiners)
+        while True:
+            if rdz._flag_set(self._store, okey):
+                return rdz._read(self._store, okey)
+            try:
+                got = int(self._store.add(f"{jbase}/jack", 0))
+            except Exception:
+                got = 0
+            if got >= want:
+                if int(self._store.add(okey + "/claim", 1)) == 1:
+                    rdz._publish(self._store, okey, "commit")
+                    return "commit"
+            elif time.monotonic() > deadline:
+                if int(self._store.add(okey + "/claim", 1)) == 1:
+                    rdz._publish(self._store, okey, "abort")
+                    return "abort"
+            time.sleep(_POLL_S)
+
+    def _start_donation(self, state: Any, decision: JoinDecision,
+                        deadline: float) -> None:
+        """Encode once, ship one stripe per joiner. The sender threads
+        run concurrently with this survivor's reconfigure + next steps;
+        :meth:`on_step_boundary` reaps finished donors."""
+        from .. import checkpoint as ckpt
+        from . import faults as faults_mod
+
+        group = self._sup.group
+        me = group.global_rank
+        di = list(decision.donors).index(me)
+        wires, descs = _encode_state(state, decision.bits, decision.bucket)
+        total = sum(len(w) for w in wires)
+        metrics.add("cgx.elastic.snapshot_bytes", float(total))
+        meta = None
+        if di == 0:
+            meta = {
+                "leaves": descs,
+                "step": int(decision.step),
+                "generation": int(decision.generation),
+                "registry": ckpt.registry_snapshot(),
+                "bits": int(decision.bits),
+                "bucket": int(decision.bucket),
+                "n_donors": len(decision.donors),
+            }
+        injector = faults_mod.get_injector(me)
+        for jg in decision.joiners:
+            donor = _SnapshotDonor(
+                self._store,
+                _stream_name(decision.generation, jg, di),
+                wires, descs, meta=meta, donor_idx=di,
+                n_donors=len(decision.donors), bits=decision.bits,
+                bucket=decision.bucket, deadline=deadline,
+                injector=injector,
+            )
+            donor.start()
+            self._donations.append(donor)
+        flightrec.record(
+            "elastic", phase="donate", generation=decision.generation,
+            donor_idx=di, joiners=list(decision.joiners),
+            bytes=total, leaves=len(descs),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Store-key hygiene: the join namespace reaps with the rendezvous's.
+# ---------------------------------------------------------------------------
+
+
+def _reap_join_generation(store, generation: int) -> int:
+    """Delete everything a finished generation's join round left behind:
+    votes, the decision (+ claim), jack/outcome/ready, shmok flags, the
+    trigger, and the consumed intent + admit records. Registered with
+    :func:`rendezvous.register_reaper`, so BOTH claim winners (shrink
+    and grow) retire generation N-1's keys whichever kind N is."""
+    base = f"{JOIN_PREFIX}/g{generation}"
+    reaped = 0
+    members: List[int] = []
+    if rdz._flag_set(store, f"{base}/decision"):
+        try:
+            d = JoinDecision.from_json(rdz._read(store, f"{base}/decision"))
+            members = sorted(set(d.members) | set(d.survivors))
+            for g, k in d.intents.items():
+                for key in (_intent_key(k), _admit_key(k)):
+                    reaped += rdz._delete(store, key)
+                    reaped += rdz._delete(store, key + "/flag")
+            if d.trigger_key:
+                reaped += rdz._delete(store, d.trigger_key)
+                reaped += rdz._delete(store, d.trigger_key + "/flag")
+                reaped += rdz._delete(store, d.trigger_key + "/claim")
+        except Exception as e:
+            log.warning(
+                "elastic: cannot enumerate generation %d join keys for "
+                "reaping: %s", generation, e,
+            )
+    for p in members:
+        reaped += rdz._delete(store, f"{base}/v{p}")
+        reaped += rdz._delete(store, f"{base}/v{p}/flag")
+        reaped += rdz._delete(store, f"{base}/shmok{p}")
+        reaped += rdz._delete(store, f"{base}/shmok{p}/flag")
+    for key in ("decision", "decision/flag", "decision/claim", "jack",
+                "outcome", "outcome/flag", "outcome/claim", "ready"):
+        reaped += rdz._delete(store, f"{base}/{key}")
+    if reaped:
+        metrics.add("cgx.elastic.keys_reaped", float(reaped))
+    return reaped
+
+
+rdz.register_reaper(_reap_join_generation)

@@ -96,8 +96,22 @@ class EvictedError(RuntimeError):
     """The recovery rendezvous converged on a survivor set that does not
     include this rank: a quorum of peers suspected it dead (stale
     heartbeat during their bounded waits). The correct reaction is to
-    exit — the group has already moved to a new generation without us,
-    and rejoining is not supported (``docs/ROBUSTNESS.md`` Recovery)."""
+    exit — the group has already moved to a new generation without us;
+    with ``CGX_ELASTIC`` on, a fresh process may re-enter through the
+    join rendezvous (``robustness/elastic.py``) at a later generation —
+    this *process* is still done (``docs/ROBUSTNESS.md`` Elastic
+    membership)."""
+
+
+class JoinAbortedError(RuntimeError):
+    """An elastic join attempt did not complete within
+    ``CGX_JOIN_TIMEOUT_MS``. Raised on whichever side timed out: the
+    joiner (admit record or snapshot pages never arrived — it aborts
+    ALONE; the survivors have not reconfigured yet and keep stepping at
+    the old generation) or a survivor (the joiner's ack never landed —
+    the grow is abandoned and the group resumes unharmed). Never
+    recoverable in-place: a fresh join attempt starts from a fresh
+    intent."""
 
 
 class RecoveryFailedError(RuntimeError):

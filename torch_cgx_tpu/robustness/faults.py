@@ -33,12 +33,23 @@ mode                      effect at its injection site
                           is published ``delay`` late instead of never —
                           the first bounded wait may expire, a retry
                           succeeds
+``preempt``               SIGKILL-style exit at a collective entry, then
+                          auto-respawn after ``delay`` (the respawn is
+                          ``$CGX_PREEMPT_RESPAWN``, detached before the
+                          exit) — the elastic join path's rehearsal: the
+                          rank announces it is coming back, dies, and
+                          re-enters through the join rendezvous
+``corrupt_join_page``     flip a byte of ONE snapshot page frame AFTER
+                          its checksum is computed (``step=N`` picks the
+                          N-th shipped page) — the joiner must re-request
+                          the page, not wedge or silently diverge
 ========================  =====================================================
 
 Spec tokens: a bare float is a per-event probability; ``NNms``/``NNs`` a
 delay; ``step=N`` fires only on the mode's N-th event (0-based; for
-``nan_grad`` the training step index); ``rank=N`` restricts to one rank
-(a bare integer on ``kill_rank``/``slow_rank`` is shorthand for
+``nan_grad`` the training step index, for ``corrupt_join_page`` the
+shipped page ordinal); ``rank=N`` restricts to one rank (a bare integer
+on ``kill_rank``/``slow_rank``/``preempt`` is shorthand for
 ``rank=N``); ``edge=dcn`` scopes ``slow_rank`` to the cross-slice (DCN)
 exchange sites ONLY — the two-level reduction's cross stage and the
 async plane's sender thread — modeling a slow DCN *edge* instead of a
@@ -79,7 +90,11 @@ MODES = (
     "stall_ack",
     "slow_rank",
     "flap",
+    "preempt",
+    "corrupt_join_page",
 )
+
+PREEMPT_RESPAWN_ENV = "CGX_PREEMPT_RESPAWN"
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)$")
 
@@ -122,6 +137,14 @@ class FaultSpec:
                 f"CGX_FAULTS: {self.mode} needs a duration, e.g. "
                 f"'{self.mode}:800ms'"
             )
+        if self.mode == "preempt" and self.delay_ms <= 0:
+            # The duration IS the respawn delay — a preempt without one
+            # is just kill_rank spelled wrong, and the join path the
+            # mode exists to exercise would never run.
+            raise ValueError(
+                "CGX_FAULTS: preempt needs a respawn duration, e.g. "
+                "'preempt:2s@rank=1@step=5'"
+            )
 
 
 def parse_faults(raw: str) -> List[FaultSpec]:
@@ -147,7 +170,10 @@ def parse_faults(raw: str) -> List[FaultSpec]:
                 kw["rank"] = int(tok[len("rank="):])
             elif tok.startswith("edge="):
                 kw["edge"] = tok[len("edge="):]
-            elif mode in ("kill_rank", "slow_rank") and "." not in tok:
+            elif (
+                mode in ("kill_rank", "slow_rank", "preempt")
+                and "." not in tok
+            ):
                 kw["rank"] = int(tok)  # kill_rank:2 == kill_rank:rank=2
             else:
                 try:
@@ -254,6 +280,59 @@ class FaultInjector:
                 self._rank,
             )
             os._exit(KILL_EXIT_CODE)
+
+    def maybe_preempt(self, notify=None, step: Optional[int] = None) -> None:
+        """``preempt``: the kill_rank death, preceded by a comeback
+        notice and followed by an auto-respawn — the elastic join path's
+        chaos rehearsal. ``notify(delay_s)`` (the call site owns the
+        store; the injector has none) publishes the comeback notice the
+        supervisor's rejoin rung reads; ``$CGX_PREEMPT_RESPAWN`` (a shell
+        command) is spawned DETACHED before the exit and sleeps out the
+        respawn delay itself, so the kill stays SIGKILL-shaped — no
+        atexit, no teardown, the respawner is already a separate
+        process."""
+        s = self._specs.get("preempt")
+        if s is None or not self.fire("preempt", step=step):
+            return
+        delay_s = s.delay_ms / 1000.0
+        if notify is not None:
+            try:
+                notify(delay_s)
+            except Exception as e:
+                log.warning("preempt comeback notice failed: %s", e)
+        respawn = os.environ.get(PREEMPT_RESPAWN_ENV, "").strip()
+        if respawn:
+            import subprocess
+
+            subprocess.Popen(
+                ["/bin/sh", "-c",
+                 f"sleep {delay_s} && exec {respawn}"],
+                start_new_session=True,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+        log.warning(
+            "CGX_FAULTS preempt firing on rank %s: exiting hard, respawn "
+            "in %.1fs", self._rank, delay_s,
+        )
+        os._exit(KILL_EXIT_CODE)
+
+    def corrupt_join_payload(self, payload: bytes, page_ordinal: int) -> bytes:
+        """``corrupt_join_page``: flip one byte of a snapshot page frame
+        AFTER its checksum was computed (``step=N`` gates on the shipped
+        page ordinal). The joiner's receive loop must turn this into a
+        bounded page re-request — never a wedge, never silent
+        divergence."""
+        if not payload or not self.fire(
+            "corrupt_join_page", step=page_ordinal
+        ):
+            return payload
+        log.warning(
+            "CGX_FAULTS corrupt_join_page firing on page %d", page_ordinal
+        )
+        buf = bytearray(payload)
+        buf[len(buf) // 2] ^= 0xFF
+        return bytes(buf)
 
 
 # cgx-analysis: allow(orphan-memo) — injectors are keyed by the (spec, seed, rank) env contract, generation-independent by design: a recovery must not re-randomize the fault schedule under the chaos suite

@@ -121,6 +121,100 @@ def _read(store, key: str) -> str:
     return bytes(store.get(key)).decode()
 
 
+def _delete(store, key: str) -> int:
+    """Best-effort single-key delete (the consume-side GC contract:
+    stores without ``delete_key`` keep their keys — a bounded leak,
+    never an error). Returns 1 when a key was removed."""
+    try:
+        return 1 if store.delete_key(key) else 0
+    except (NotImplementedError, AttributeError):
+        return 0
+    except Exception as e:
+        log.debug("rendezvous: delete(%r) failed: %s", key, e)
+        return 0
+
+
+def reap_generation(
+    store,
+    generation: int,
+    *,
+    key_prefix: str = KEY_PREFIX,
+    participants: Optional[Sequence[int]] = None,
+) -> int:
+    """Delete every store key a FINISHED rendezvous generation left
+    behind: votes (+ flags), the decision record (+ flag), the decision
+    claim and the ack barrier. Without this, every recovery leaks its
+    whole key namespace into the store for the process lifetime — on a
+    FileStore that is a file that only ever grows.
+
+    Called by the decision-claim winner when it publishes generation
+    ``N``'s record, pointed at generation ``N - 1``: that rendezvous is
+    strictly finished (every survivor passed its ack barrier before any
+    rank could reach a new one). ``participants`` enumerates the voter
+    set; when None it is recovered from the old decision record itself
+    (survivors + evicted). A generation with no published decision has
+    nothing enumerable and only its fixed keys are reaped.
+
+    Accepted sharp edge: a falsely-suspected rank arriving at a reaped
+    generation finds no decision and times out with
+    :class:`RecoveryFailedError` instead of adopting the record and
+    raising :class:`EvictedError` — it dies loudly either way, and a
+    survivor that late (a full further recovery completed meanwhile) was
+    never going to re-enter the group."""
+    base = f"{key_prefix}/g{generation}"
+    ranks: List[int] = sorted(int(p) for p in participants or ())
+    if not ranks and _flag_set(store, f"{base}/decision"):
+        try:
+            old = Decision.from_json(_read(store, f"{base}/decision"))
+            ranks = sorted(set(old.survivors) | set(old.evicted))
+        except Exception as e:
+            log.warning(
+                "rendezvous: cannot enumerate generation %d voters for "
+                "reaping: %s", generation, e,
+            )
+    reaped = 0
+    for p in ranks:
+        reaped += _delete(store, f"{base}/v{p}")
+        reaped += _delete(store, f"{base}/v{p}/flag")
+    reaped += _delete(store, f"{base}/decision")
+    reaped += _delete(store, f"{base}/decision/flag")
+    reaped += _delete(store, f"{base}/decision/claim")
+    reaped += _delete(store, f"{base}/ack")
+    if reaped:
+        metrics.add("cgx.recovery.keys_reaped", float(reaped))
+    return reaped
+
+
+# Extra per-generation reapers (the elastic join plane registers one for
+# its ``cgxjoin/g<N>/`` namespace): called alongside the rendezvous reap
+# whenever a decision-claim winner retires generation N-1, so a shrink
+# following a grow also collects the grow's keys and vice versa.
+# cgx-analysis: allow(orphan-memo) — import-time registration list, not a cache: resetting it would silently drop the elastic reaper until its module is re-imported
+_extra_reapers: List = []
+
+
+def register_reaper(fn) -> None:
+    """Register ``fn(store, generation) -> int`` to run at every
+    generation reap point (idempotent per fn)."""
+    if fn not in _extra_reapers:
+        _extra_reapers.append(fn)
+
+
+def reap_all(store, generation: int) -> int:
+    """Reap generation ``generation``'s keys across every registered
+    namespace (the rendezvous's own plus extras)."""
+    n = reap_generation(store, generation)
+    for fn in list(_extra_reapers):
+        try:
+            n += int(fn(store, generation) or 0)
+        except Exception as e:
+            log.warning(
+                "rendezvous: extra reaper %r failed for generation %d: %s",
+                fn, generation, e,
+            )
+    return n
+
+
 def negotiate(
     store,
     *,
@@ -198,6 +292,18 @@ def negotiate(
                     replay_step=min(snaps) if snaps else None,
                 )
                 _publish(store, f"{base}/decision", decision.to_json())
+                # Store-key hygiene: generation N-1's rendezvous is
+                # strictly finished (its ack barrier filled before any
+                # rank could start this one), so the claim winner reaps
+                # its whole key namespace here — one writer, exactly
+                # once per generation.
+                if generation > 0:
+                    if key_prefix == KEY_PREFIX:
+                        reap_all(store, generation - 1)
+                    else:
+                        reap_generation(
+                            store, generation - 1, key_prefix=key_prefix
+                        )
                 break
         if time.monotonic() > deadline:
             metrics.add("cgx.recovery.rendezvous_failed")

@@ -227,6 +227,10 @@ class RecoverySupervisor:
         # fires, merged into the eviction vote when the ladder runs.
         # global rank -> (monotonic receive time, score).
         self._suspect_hints: Dict[int, Tuple[float, float]] = {}
+        # Elastic join plane (robustness/elastic.py): when a coordinator
+        # is attached, run_steps gives it every step boundary — grow
+        # decisions are step-synchronized across survivors.
+        self._elastic = None
         health_mod.add_consumer(self.note_health_event)
 
     # -- introspection ----------------------------------------------------
@@ -260,6 +264,11 @@ class RecoverySupervisor:
     @property
     def last_rollback_step(self) -> Optional[int]:
         return self._last_rollback_step
+
+    def attach_elastic(self, coordinator) -> None:
+        """Hook an :class:`~.elastic.ElasticCoordinator` into the step
+        loop (called by its constructor)."""
+        self._elastic = coordinator
 
     # -- health hints (the observability→control handoff, PR 6) -----------
 
@@ -361,6 +370,36 @@ class RecoverySupervisor:
             if g in globals_now and g not in suspects:
                 suspects.append(g)
                 metrics.add("cgx.recovery.health_hint_votes")
+        # Rejoin rung (preferred over a bare evict when the suspect says
+        # it is coming back): a preempted rank publishes a comeback
+        # notice before dying. The shrink still proceeds — the group
+        # cannot wait out a respawn — but the membership policy reserves
+        # the rank's identity and the ladder records the softer rung, so
+        # the respawned process re-enters through the elastic join at a
+        # later generation instead of being forgotten.
+        if cfg.elastic_enabled() and suspects:
+            from . import elastic as elastic_mod
+
+            rejoining = []
+            for g in suspects:
+                cb = elastic_mod.fresh_comeback(self._store, g)
+                if cb is not None:
+                    rejoining.append(g)
+                    health_mod.membership_policy().expect_rejoin(
+                        g,
+                        float(cb.get("delay_s", 0.0))
+                        + elastic_mod.REJOIN_GRACE_S,
+                    )
+            if rejoining:
+                metrics.add("cgx.recovery.rejoin_rungs")
+                flightrec.record(
+                    "recovery", phase="rejoin_rung", suspects=rejoining,
+                    generation=self.generation,
+                )
+                log.warning(
+                    "recovery: suspect(s) %s announced a comeback — "
+                    "shrinking now, rank reserved for rejoin", rejoining,
+                )
         degrade_vote = False
         if isinstance(exc, WireCorruptionError):
             self._corruptions += 1
@@ -439,9 +478,22 @@ class RecoverySupervisor:
         end = start_step + n_steps
         every = self._policy.snapshot_every
         while step < end:
-            if every and (step - start_step) % every == 0:
-                self.take_snapshot(step, state)
             try:
+                if self._elastic is not None:
+                    # Elastic grow point: runs BEFORE the snapshot so a
+                    # commit's grid-snapped state is what gets retained,
+                    # and inside the try so a post-commit ready-barrier
+                    # wedge walks the normal ladder (the joiners become
+                    # the suspects).
+                    state = self._elastic.on_step_boundary(state, step)
+                # Cadence on the ABSOLUTE step index: a joiner's
+                # run_steps starts mid-run (start_step = the join step),
+                # and the rendezvous pins replay to the MINIMUM voted
+                # snapshot step — survivors and joiners must snapshot
+                # the same steps or a post-join recovery pins a point
+                # the joiner never took.
+                if every and step % every == 0:
+                    self.take_snapshot(step, state)
                 state = step_fn(self._group, state, step)
             except RECOVERABLE as e:
                 log.warning(

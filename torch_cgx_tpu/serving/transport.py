@@ -53,6 +53,11 @@ V_PAGE = 1
 K_TAIL = 2  # raw f16 tail block (the not-yet-full last page)
 V_TAIL = 3
 META = 4
+# Elastic-join snapshot pages (robustness/elastic.py — the param_page
+# wire edge): the `layer` field carries the flat LEAF index of the
+# training-state tree, `page_idx` the page within that leaf.
+P_PAGE = 5  # codec-compressed leaf page (HostQTensor wire bytes)
+P_RAW = 6  # raw leaf page bytes (lossless — the bit-identity default)
 
 # layer(u16) kind(u16) page_idx(u16) bits(u16) bucket(u32) numel(u32)
 # crc(u32; the sentinel _NO_CRC = unchecked)
@@ -132,6 +137,20 @@ def unframe_page(buf: bytes) -> PageFrame:
             f"{kind}, page {page_idx}) — the page payload is corrupted"
         )
     return PageFrame(layer, kind, page_idx, bits, bucket, numel, payload)
+
+
+def peek_header(buf: bytes) -> PageFrame:
+    """Decode a frame's fixed header WITHOUT verifying the payload crc,
+    payload attached unverified. The snapshot receiver's re-request path
+    needs the (leaf, page) identity of a frame whose checksum just
+    failed — the header is outside the checksummed region, so it is
+    still trustworthy enough to name the page to re-request (a corrupted
+    header at worst re-requests the wrong page, which the donor serves
+    idempotently)."""
+    layer, kind, page_idx, bits, bucket, numel, _ = _FRAME.unpack_from(buf)
+    return PageFrame(
+        layer, kind, page_idx, bits, bucket, numel, bytes(buf[_FRAME.size:])
+    )
 
 
 def meta_frame(meta: Dict, *, checksum: bool = True) -> bytes:

@@ -59,7 +59,7 @@ import threading
 import time
 import queue as _queue
 from concurrent.futures import ThreadPoolExecutor
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 import numpy as np
 import torch
@@ -795,6 +795,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         *,
         generation: int = 0,
         global_ranks: Optional[Sequence[int]] = None,
+        peer_info: Optional[Sequence[str]] = None,
     ):
         super().__init__(rank, size)
         self._store = store
@@ -849,6 +850,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         health_mod.maybe_start(rank)
         watch_mod.maybe_start_prom(rank)
         metrics.set("cgx.recovery.generation", float(generation))
+        metrics.set("cgx.recovery.ws", float(size))
         self._pid_by_rank: List[int] = []
         self._seq = 0  # collective sequence number (issued on calling thread)
         self._p2p_send = {}  # (dst, tag) -> count
@@ -892,7 +894,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         self._async_sender = None
         if size > 1:
             try:
-                self._init_shm()
+                self._init_shm(peer_info)
             except Exception as e:
                 log.warning(
                     "cgx shm rendezvous failed (%s); store transport only", e
@@ -903,26 +905,45 @@ class ProcessGroupCGX(dist.ProcessGroup):
         )
         self._worker.start()
 
-    def _init_shm(self) -> None:
+    def _init_shm(self, peer_info: Optional[Sequence[str]] = None) -> None:
         """Host rendezvous (always, when ws > 1 — the hierarchy gate needs
         the host map) + SHM channel creation (gated by CGX_SHM and >1
-        same-host rank)."""
+        same-host rank).
+
+        ``peer_info`` (one ``"<host_fp>|<pid>"`` per group-local rank)
+        replaces the blocking store exchange AND the two-phase ok
+        negotiation: an elastic joiner boots with the hosts map its
+        admit record carried (robustness/elastic.py), because a blocking
+        ``get`` against peers mid-step would park for the store timeout —
+        past the join bound — and the ok handshake's consensus is owned
+        by the join protocol's shmok flags instead.
+        """
         from . import shm as shm_mod
 
         fp = shm_mod.host_fingerprint()
-        # Piggyback this rank's pid on the host-key exchange: peers need
-        # it to resolve the per-process liveness heartbeat file — no
-        # extra store round-trips (an init-time rendezvous here proved
-        # destabilizing under rapid group churn). Generation-namespaced:
-        # a post-recovery group's exchange (shrunk world, re-indexed
-        # ranks) must never read the dead world's stale values.
-        self._store.set(
-            self._ns(f"cgxshm/h{self._rank}"), f"{fp}|{os.getpid()}".encode()
-        )
-        raw = [
-            bytes(self._store.get(self._ns(f"cgxshm/h{j}"))).decode()
-            for j in range(self._size)
-        ]
+        if peer_info is not None:
+            if len(peer_info) != self._size:
+                raise ValueError(
+                    f"peer_info has {len(peer_info)} entries for group "
+                    f"size {self._size}"
+                )
+            raw = [str(v) for v in peer_info]
+        else:
+            # Piggyback this rank's pid on the host-key exchange: peers
+            # need it to resolve the per-process liveness heartbeat file
+            # — no extra store round-trips (an init-time rendezvous here
+            # proved destabilizing under rapid group churn).
+            # Generation-namespaced: a post-recovery group's exchange
+            # (shrunk world, re-indexed ranks) must never read the dead
+            # world's stale values.
+            self._store.set(
+                self._ns(f"cgxshm/h{self._rank}"),
+                f"{fp}|{os.getpid()}".encode(),
+            )
+            raw = [
+                bytes(self._store.get(self._ns(f"cgxshm/h{j}"))).decode()
+                for j in range(self._size)
+            ]
         hosts, pids = [], []
         for v in raw:
             h, _, p = v.rpartition("|")
@@ -965,6 +986,17 @@ class ProcessGroupCGX(dist.ProcessGroup):
                         "negotiating store fallback", e
                     )
                     self._shm = None
+            if peer_info is not None:
+                # Elastic boot: no blocking ok handshake against peers
+                # that are mid-step — the join protocol's shmok flags
+                # carry the consensus (any local-group member without a
+                # channel degrades EVERYONE to the store at the ready
+                # barrier).
+                self._all_local = (
+                    self._shm is not None
+                    and len(self._local_ranks) == self._size
+                )
+                return
             self._store.set(self._ns(f"cgxshm/ok{self._rank}"), mine)
             peers_ok = all(
                 bytes(self._store.get(self._ns(f"cgxshm/ok{j}"))) == b"1"
@@ -1041,6 +1073,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     # does (no abort poison, no atexit) — each dequeued
                     # work entry is one step of the injector's counter.
                     self._injector.maybe_kill()
+                    # preempt fault: same SIGKILL-grade death, but the
+                    # platform gave notice — the comeback record lets the
+                    # supervisor ladder prefer the rejoin rung over a
+                    # permanent evict (robustness/elastic.py).
+                    self._injector.maybe_preempt(notify=self._preempt_notify)
                     # slow_rank fault: a straggler, not a corpse — the
                     # heartbeat keeps beating while peers' bounded waits
                     # expire, which is exactly what the recovery retry
@@ -1128,6 +1165,17 @@ class ProcessGroupCGX(dist.ProcessGroup):
         except Exception:
             msg = "unknown"
         raise RuntimeError(f"cgx: process group aborted ({msg})")
+
+    def _preempt_notify(self, delay_s: float) -> None:
+        """Preempt-fault notice hook: publish this rank's comeback record
+        so the survivors' recovery ladder can take the rejoin rung instead
+        of a permanent evict (robustness/elastic.py). Best-effort — the
+        process is about to die either way."""
+        from ..robustness import elastic as elastic_mod
+
+        elastic_mod.publish_comeback(
+            self._store, self.global_rank, delay_s
+        )
 
     def _wait_key(self, key: str, bounded: bool = True) -> None:
         """Block until ``key`` exists OR the group is aborted.
@@ -2775,9 +2823,22 @@ class ProcessGroupCGX(dist.ProcessGroup):
             "(generation %d)", self._generation,
         )
 
-    def reconfigure(self, survivors: Sequence[int], generation: int) -> None:
-        """Recovery ladder rung 3: shrink this group in place to the
-        agreed survivor set (GLOBAL rank ids) at a new generation.
+    def reconfigure(
+        self,
+        survivors: Sequence[int],
+        generation: int,
+        *,
+        joiner_info: Optional[Mapping[int, str]] = None,
+    ) -> None:
+        """Recovery ladder rung 3 — and the elastic grow path: reshape
+        this group in place to the agreed member set (GLOBAL rank ids)
+        at a new generation. ``survivors`` may be any membership delta:
+        a shrink (the PR 5 ladder), a grow (elastic join), or both at
+        once; global-rank identity is preserved across every reshape.
+        Members not currently in the group REQUIRE a ``joiner_info``
+        entry (global rank → ``"<host_fp>|<pid>"``, carried by the join
+        decision) — the host/pid maps extend from it without any store
+        exchange, exactly as the shrink path filters them without one.
 
         * queued-but-unstarted work entries fail with
           :class:`StaleGenerationError` (the worker loop also re-checks
@@ -2815,11 +2876,17 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 f"cgx: global rank {me} is not in the agreed survivor set "
                 f"{survivors} (generation {generation}) — evicted"
             )
+        joiners = {
+            int(g): str(v) for g, v in (joiner_info or {}).items()
+        }
         unknown = [g for g in survivors if g not in self._global_ranks]
-        if unknown:
+        missing = [g for g in unknown if g not in joiners]
+        if missing:
             raise ValueError(
-                f"reconfigure: survivors {unknown} are not members of "
-                f"this group (globals {self._global_ranks})"
+                f"reconfigure: members {missing} are not in this group "
+                f"(globals {self._global_ranks}) and no joiner_info "
+                "names their host — a grow without the join decision's "
+                "hosts map cannot rebuild the topology"
             )
         evicted = [g for g in self._global_ranks if g not in survivors]
         # Fail everything still queued under the old generation.
@@ -2836,15 +2903,43 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 break
             self._completions.submit(self._finish, (fut, None, stale_err))
         old_index = {g: i for i, g in enumerate(self._global_ranks)}
-        keep = [old_index[g] for g in survivors]
-        self._host_by_rank = (
-            [self._host_by_rank[i] for i in keep]
-            if self._host_by_rank else []
-        )
-        self._pid_by_rank = (
-            [self._pid_by_rank[i] for i in keep]
-            if self._pid_by_rank else []
-        )
+        if unknown:
+            # Grow (or mixed delta): merge the retained members' facts
+            # with the joiners' admitted host/pid info. A solo group has
+            # no host map yet (size 1 skips _init_shm) — its own entry
+            # derives locally.
+            from . import shm as shm_mod
+
+            info: Dict[int, str] = {}
+            for g in self._global_ranks:
+                i = old_index[g]
+                if self._host_by_rank and i < len(self._host_by_rank):
+                    pid = (
+                        self._pid_by_rank[i]
+                        if i < len(self._pid_by_rank) else -1
+                    )
+                    info[g] = f"{self._host_by_rank[i]}|{pid}"
+            info.setdefault(
+                me, f"{shm_mod.host_fingerprint()}|{os.getpid()}"
+            )
+            info.update(joiners)
+            hosts, pids = [], []
+            for g in survivors:
+                h, _, p = info[g].rpartition("|")
+                hosts.append(h)
+                pids.append(int(p) if p.lstrip("-").isdigit() else -1)
+            self._host_by_rank = hosts
+            self._pid_by_rank = pids
+        else:
+            keep = [old_index[g] for g in survivors]
+            self._host_by_rank = (
+                [self._host_by_rank[i] for i in keep]
+                if self._host_by_rank else []
+            )
+            self._pid_by_rank = (
+                [self._pid_by_rank[i] for i in keep]
+                if self._pid_by_rank else []
+            )
         self._global_ranks = survivors
         self._rank = survivors.index(me)
         self._size = len(survivors)
@@ -2881,11 +2976,33 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 # readers left.
                 self._shm.close()
                 self._shm = None
+        elif unknown and len(self._local_ranks) > 1 and cfg.shm_enabled():
+            # A joiner landed on this host and this rank had no channel
+            # (it was solo, or a prior degrade closed it): re-admit the
+            # byte plane under the same quota/creation path as boot.
+            # Consensus with the local peers rides the join protocol's
+            # shmok flags, not a blocking handshake — on any mismatch
+            # the coordinator degrades the whole group to the store.
+            from . import shm as shm_mod
+
+            try:
+                hb_mod.ensure_heartbeat(shm_mod.default_dir())
+                self._shm = shm_mod.ShmChannel(
+                    self._store, self._rank, wait_key=self._wait_key
+                )
+                self._shm.bump_epoch(generation)
+            except Exception as e:
+                log.warning(
+                    "cgx: shm re-admission on grow failed (%s); store "
+                    "transport for this rank", e
+                )
+                self._shm = None
         self._all_local = (
             self._shm is not None and len(self._local_ranks) == self._size
         )
         metrics.add("cgx.recovery.reconfigurations")
         metrics.set("cgx.recovery.generation", float(generation))
+        metrics.set("cgx.recovery.ws", float(self._size))
         flightrec.record(
             "recovery", phase="reconfigure", generation=generation,
             survivors=survivors, evicted=evicted, rank=self._rank,

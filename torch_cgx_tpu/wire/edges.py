@@ -65,6 +65,7 @@ EDGE_PP_ACT = "pp_act"
 EDGE_POWERSGD_FACTOR = "powersgd_factor"
 EDGE_XSLICE_DELTA = "xslice_delta"
 EDGE_KV_PAGE = "kv_page"
+EDGE_PARAM_PAGE = "param_page"
 
 EDGE_KINDS = (
     EDGE_DP_GRAD,
@@ -74,6 +75,7 @@ EDGE_KINDS = (
     EDGE_POWERSGD_FACTOR,
     EDGE_XSLICE_DELTA,
     EDGE_KV_PAGE,
+    EDGE_PARAM_PAGE,
 )
 
 # Peer compressors the dispatcher can put behind an edge (max-min
@@ -197,11 +199,16 @@ def resolve_edge(kind: str, name: str) -> Optional[EdgeConfig]:
     for (k, pattern), ec in _edge_configs.items():
         if k == kind and re.search(pattern, name):
             match = ec
-    if match is None and kind not in (EDGE_DP_GRAD, EDGE_KV_PAGE):
+    if match is None and kind not in (
+        EDGE_DP_GRAD, EDGE_KV_PAGE, EDGE_PARAM_PAGE
+    ):
         # kv_page skips the CGX_WIRE_BITS fallback like dp_grad skips it:
         # its env default is CGX_KV_BITS, consulted by the serving
         # resolver (serving/kv_cache.py resolve_kv_config) — a training
         # wire knob must not silently re-width the serving KV pages.
+        # param_page likewise: its default is LOSSLESS (raw pages — the
+        # joiner's bit-identity guarantee), so only an explicitly
+        # registered edge may make the join wire lossy.
         bits = cfg_mod.wire_default_bits()
         if bits:
             match = EdgeConfig(cc=CompressionConfig(bits=bits, bucket_size=0))
