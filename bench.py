@@ -973,6 +973,18 @@ def _sched_bridge_child(mb: int, ws: int, iters: int, chunks: int,
         rec["overlap_frac"] = (
             round(sum(fracs) / len(fracs), 4) if fracs else 0.0
         )
+        # Mean per-rank measured stage seconds (ISSUE 17): the parent
+        # turns these into per-component prediction ratios
+        # (bench_gate's <metric>:pred_ratio:<component> trajectories).
+        if att["per_rank"]:
+            n_ranks = len(att["per_rank"])
+            rec["measured_components"] = {
+                k: round(
+                    sum(c.get(k, 0.0) for c in att["per_rank"].values())
+                    / n_ranks, 6,
+                )
+                for k in ("quantize", "wire", "wait", "other")
+            }
         if mode == "plan":
             # span-calibrated cost model of THIS run (rates + overlap):
             # computed post-measurement in the child, never in a rank —
@@ -1048,6 +1060,26 @@ def bench_schedule(mb: int = 32, ws: int = 4, iters: int = 4,
             "bridge": "ProcessGroupCGX shm/store, ws real processes",
         },
     }
+
+
+def _planner_pred_components(
+    fitted, n: int, ws: int, iters: int, measured,
+) -> dict:
+    """{component: predicted/measured ratio} for the calibrated model's
+    per-stage raw-work predictions vs the run's span attribution —
+    empty when the child attached no measurement (spanless run)."""
+    if not isinstance(measured, dict):
+        return {}
+    per_slice = fitted.predict_slice_components(
+        n, ws, BITS, BUCKET, chunks=1, route="bridge"
+    )
+    out = {}
+    for comp in ("quantize", "wire"):
+        m = float(measured.get(comp, 0.0))
+        p = per_slice.get(comp, 0.0) * iters
+        if m > 1e-9 and p > 0:
+            out[comp] = round(p / m, 4)
+    return out
 
 
 def bench_planner(mb: int = 32, ws: int = 4, iters: int = 4) -> dict:
@@ -1171,6 +1203,13 @@ def bench_planner(mb: int = 32, ws: int = 4, iters: int = 4) -> dict:
         "predicted_step_ms": round(predicted_ms, 3),
         "measured_step_ms": round(t_p, 3),
         "pred_ratio": round(predicted_ms / t_p, 4) if t_p else 0.0,
+        # Per-component prediction accuracy (ISSUE 17): raw per-stage
+        # work (chunks=1 — span durations measure work, not exposure)
+        # against the planner run's measured span attribution. Gated as
+        # <metric>:pred_ratio:<component> trajectories by bench_gate.
+        "pred_components": _planner_pred_components(
+            fitted, n, ws, iters, plan.get("measured_components")
+        ),
         # Host-plane measurement (the bridge always runs on host CPU) —
         # a genuine trajectory, like bench_schedule/shm_bench.
         "backend": "host",
@@ -1733,8 +1772,15 @@ def _serve_child(
     throttle_mbps: float,
 ) -> None:
     """Child: one serving run at CGX_KV_BITS=`bits`; one JSON line."""
+    import tempfile
     import threading
     import zlib
+
+    # Span telemetry for the run (ISSUE 17): the critical-path engine
+    # decomposes the measured TTFT post-hoc from these — set before any
+    # serving object records a span.
+    mdir = tempfile.mkdtemp(prefix="cgx-serve-bench-")
+    os.environ["CGX_METRICS_DIR"] = mdir
 
     from torch_cgx_tpu.models.gpt2 import GPT2, GPT2Config
     from torch_cgx_tpu.serving.prefill import PrefillWorker
@@ -1838,17 +1884,62 @@ def _serve_child(
             np.asarray(r.output, np.int32).tobytes() for r in reqs
         )
     )
+    # Post-hoc TTFT decomposition over the run's own span files: mean
+    # per-request admission/prefill/ship/decode ms (the warm-up request
+    # is excluded — its spans predate the timed window), plus the total
+    # kv.ship wall time the pred-ratio contrast below needs.
+    from torch_cgx_tpu.observability import critpath as critpath_mod
+    from torch_cgx_tpu.observability import timeline as timeline_mod
+
+    timeline_mod.flush()
+    timed_ids = {r.id for r in reqs}
+    ttft_components = {}
+    ship_wall_s = 0.0
+    try:
+        rep = critpath_mod.analyze(mdir, use_cache=False)
+        sums: dict = {}
+        n_req = 0
+        for rid, rr in rep["requests"].items():
+            if rid not in timed_ids or rr["ttft_s"] is None:
+                continue
+            n_req += 1
+            for k, v in rr["components"].items():
+                sums[k] = sums.get(k, 0.0) + v
+        if n_req:
+            ttft_components = {
+                k: round(v / n_req * 1e3, 3) for k, v in sorted(sums.items())
+            }
+        for tr in critpath_mod.load_tracks(mdir).values():
+            for ev in tr["events"]:
+                if ev.get("name") == "kv.ship" and ev.get("req") in timed_ids:
+                    ship_wall_s += float(ev.get("dur_s", 0.0))
+    except Exception:
+        pass  # a breakdown failure must not kill the bench row
     print(json.dumps({
         "tok_s": tokens / wall,
         "wall_s": wall,
         "tokens": tokens,
         "ttft_p50_ms": ttft.get("p50", 0.0),
         "ttft_mean_ms": ttft.get("mean", 0.0),
+        "ttft_components": ttft_components,
+        "ship_wall_s": round(ship_wall_s, 6),
         "tokens_crc": crc,
         "kv_bytes_wire": metrics.get("cgx.serve.kv_bytes_wire"),
         "backend": jax.default_backend(),
         "chip": jax.devices()[0].device_kind,
     }))
+
+
+def _serve_pred_components(rec: dict, throttle_mbps: float) -> dict:
+    """{"ship": predicted/measured} for a serve child record: the
+    modeled link makes the ship prediction exact arithmetic
+    (bytes / rate), so the ratio gates transport efficiency itself."""
+    ship_wall = float(rec.get("ship_wall_s") or 0.0)
+    wire_bytes = float(rec.get("kv_bytes_wire") or 0.0)
+    if ship_wall <= 1e-9 or wire_bytes <= 0 or throttle_mbps <= 0:
+        return {}
+    predicted_s = wire_bytes / (throttle_mbps / 1e3 * 1e9)
+    return {"ship": round(predicted_s / ship_wall, 4)}
 
 
 def bench_serve(
@@ -1924,10 +2015,23 @@ def bench_serve(
             "vs_baseline": round(
                 f16["ttft_p50_ms"] / quant["ttft_p50_ms"], 3
             ) if quant["ttft_p50_ms"] else 0.0,
+            # Critical-path TTFT decomposition of the quantized arm
+            # (mean ms per request) + the wire-model prediction ratio
+            # for the ship stage: the modeled link rate is exact by
+            # construction, so predicted ship time is bytes/rate — the
+            # trajectory catches a transport regression that inflates
+            # ship wall time beyond what the bytes explain.
+            "ttft_components": quant.get("ttft_components") or {},
+            "pred_components": _serve_pred_components(
+                quant, throttle_mbps
+            ),
             "backend": f16["backend"],
             "chip": f16["chip"],
-            "detail": dict(shared_detail,
-                           ttft_p50_ms_f16=round(f16["ttft_p50_ms"], 3)),
+            "detail": dict(
+                shared_detail,
+                ttft_p50_ms_f16=round(f16["ttft_p50_ms"], 3),
+                ttft_components_f16=f16.get("ttft_components") or {},
+            ),
         },
     ]
 

@@ -1,0 +1,485 @@
+"""Distributed critical-path engine tests (ISSUE 17).
+
+Covers the acceptance set:
+
+* oracle tests on synthetic span sets — a hand-built 2-rank DAG with a
+  known straggler must yield the known path decomposition (components,
+  per-rank attribution, the msg edge, the ``wait:r<rank>`` dominator)
+  and a known serving flow must yield the exact TTFT decomposition;
+* generation-split track loading (elastic membership: spans from
+  different generations must not conflate rank ids) + bounded
+  tail-biased reads;
+* plan-side decomposition: ``predict_slice_components`` sums exactly to
+  ``predict_slice`` (the pinned formula, untouched) and solved plans
+  carry ``pred_components``;
+* the drift loop: a falsified CostModel triggers exactly ONE
+  ``plan_drift`` HealthEvent (engine cooldown) plus exactly one adopted
+  re-plan (idempotent poke);
+* chaos acceptance: a 2-rank bridge run with an injected ``slow_rank``
+  fault — ``tools/cgx_critpath.py --json`` must name the faulted rank
+  as the dominator on >= 80% of the faulted step windows.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib.util
+import json
+import multiprocessing as mp
+import os
+import subprocess
+import sys
+import tempfile
+import traceback
+from unittest import mock
+
+import pytest
+
+from torch_cgx_tpu.observability import critpath, health, timeline
+from torch_cgx_tpu.parallel import planner
+from torch_cgx_tpu.robustness import faults
+from torch_cgx_tpu.utils.logging import metrics
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_CGX_CRITPATH = os.path.join(_REPO, "tools", "cgx_critpath.py")
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    faults.reset_injectors()
+    metrics.reset()
+    timeline.reset()
+    critpath.invalidate_critpath_cache()
+    planner.set_cost_model(None)
+    yield
+    health.stop()
+    faults.reset_injectors()
+    metrics.reset()
+    timeline.reset()
+    critpath.invalidate_critpath_cache()
+    planner.set_cost_model(None)
+
+
+# ---------------------------------------------------------------------------
+# Synthetic span-file builders.
+# ---------------------------------------------------------------------------
+
+
+def _meta(rank, gen=0, delta=1000.0):
+    return {
+        "kind": "meta", "rank": rank, "generation": gen, "pid": 1,
+        "t_mono": 0.0, "t_wall": delta, "mono_wall_delta": delta,
+    }
+
+
+def _span(name, cat, t, dur, **kw):
+    return dict(
+        {"kind": "span", "name": name, "cat": cat, "t_mono": t,
+         "dur_s": dur}, **kw,
+    )
+
+
+def _inst(name, t, **kw):
+    return dict({"kind": "instant", "name": name, "t_mono": t}, **kw)
+
+
+def _write(path, rows):
+    with open(path, "w") as f:
+        for r in rows:
+            f.write(json.dumps(r) + "\n")
+
+
+# ---------------------------------------------------------------------------
+# Oracle: known DAG -> known path.
+# ---------------------------------------------------------------------------
+
+
+def test_straggler_dag_oracle(tmp_path):
+    """2 ranks, one message edge: rank 1 computes fast, sits idle
+    un-spanned 0.6s, quantizes, then publishes; rank 0's collective
+    waits on that key. The walk must jump the msg edge and charge the
+    idle gap as straggler_wait on rank 1 — ``wait:r1`` dominates."""
+    _write(str(tmp_path / "spans-rank0.jsonl"), [
+        _meta(0),
+        _span("fwd", "span", 0.00, 0.15),
+        _span("all_reduce", "collective", 0.15, 0.65, seq=1, group=0),
+        _span("shm.take.wait", "wait", 0.16, 0.64, key="g0/ar/1"),
+        _span("opt", "span", 0.80, 0.10),
+    ])
+    _write(str(tmp_path / "spans-rank1.jsonl"), [
+        _meta(1),
+        _span("fwd", "span", 0.00, 0.10),
+        # 0.10 - 0.70: the un-spanned straggle.
+        _span("codec.compress", "quantize", 0.70, 0.05),
+        _span("shm.put", "wire", 0.75, 0.05, key="g0/ar/1"),
+    ])
+    report = critpath.analyze(str(tmp_path), use_cache=False)
+    assert [t["rank"] for t in report["tracks"]] == [0, 1]
+    (step,) = report["steps"]
+    c = step["components"]
+    assert c["straggler_wait"] == pytest.approx(0.60, abs=1e-6)
+    assert c["compute"] == pytest.approx(0.20, abs=1e-6)
+    assert c["quantize"] == pytest.approx(0.05, abs=1e-6)
+    assert c["wire"] == pytest.approx(0.05, abs=1e-6)
+    assert step["by_rank"][1] == pytest.approx(0.80, abs=1e-6)
+    assert step["by_rank"][0] == pytest.approx(0.10, abs=1e-6)
+    assert step["dominant"] == "wait:r1"
+    assert step["dominant_rank"] == 1
+    assert report["dominators"] == {"wait:r1": 1}
+    # the message edge: rank 1's late publish exposed on rank 0's wait
+    (edge,) = step["edges"]
+    assert edge["kind"] == "msg" and (edge["src"], edge["dst"]) == (1, 0)
+    assert edge["exposed_s"] == pytest.approx(0.64, abs=1e-6)
+    # the walk accounts the full window
+    assert step["path_s"] == pytest.approx(step["total_s"], abs=1e-6)
+    # engine gauges mirror the last step
+    assert metrics.get("cgx.critpath.component.straggler_wait") == (
+        pytest.approx(0.60, abs=1e-6)
+    )
+    assert metrics.get("cgx.critpath.dominant_rank") == 1.0
+
+
+def test_step_instants_bound_windows_and_compute_dominates(tmp_path):
+    """With >= 2 trainer ``step`` instants the windows follow the
+    grad_sync cadence markers; a plain compute-bound track attributes
+    to compute with no phantom edges."""
+    _write(str(tmp_path / "spans-rank0.jsonl"), [
+        _meta(0),
+        _span("fwd", "span", 0.0, 1.0),
+        _inst("step", 1.0),
+        _span("fwd", "span", 1.0, 1.0),
+        _inst("step", 2.0),
+        _span("fwd", "span", 2.0, 0.5),
+    ])
+    steps = critpath.analyze_steps(critpath.load_tracks(str(tmp_path)))
+    assert len(steps) == 3
+    for s in steps:
+        assert s["dominant"] == "compute" and not s["edges"]
+    assert [s["total_s"] for s in steps] == [
+        pytest.approx(1.0), pytest.approx(1.0), pytest.approx(0.5)
+    ]
+
+
+def test_ttft_decomposition_oracle(tmp_path):
+    """Serving flow: submit -> prefill -> ship (partially hidden under
+    prefill) -> admit. Exact decomposition; kv.recv instants and
+    failover markers counted."""
+    _write(str(tmp_path / "spans-rank0.jsonl"), [
+        _meta(0),
+        _inst("serve.submit", 0.05, req="q1"),
+        _span("serve.prefill", "span", 0.10, 0.20, req="q1"),
+        _span("kv.ship", "wire", 0.25, 0.18, req="q1", key="cgxkv/q1/0"),
+        _inst("kv.recv", 0.43, req="q1", key="cgxkv/q1/0"),
+        _inst("serve.failover", 0.44, req="q1"),
+        _inst("serve.admit", 0.55, req="q1"),
+    ])
+    reqs = critpath.analyze_requests(critpath.load_tracks(str(tmp_path)))
+    q = reqs["q1"]
+    assert q["ttft_s"] == pytest.approx(0.50, abs=1e-6)
+    c = q["components"]
+    assert c["admission"] == pytest.approx(0.05, abs=1e-6)
+    assert c["prefill"] == pytest.approx(0.20, abs=1e-6)
+    # ship 0.25-0.43 minus the 0.25-0.30 slice hidden under prefill
+    assert c["ship"] == pytest.approx(0.13, abs=1e-6)
+    assert c["decode"] == pytest.approx(0.12, abs=1e-6)
+    assert c["other"] == pytest.approx(0.0, abs=1e-6)
+    assert q["failovers"] == 1
+
+
+def test_generation_split_tracks_and_bounded_reads(tmp_path):
+    """Elastic membership: one rank file with a bumped-generation meta
+    re-header splits into per-(rank, generation) tracks instead of
+    conflating the dead generation's spans; a single-generation file
+    keeps its bare rank key. Over-cap files read tail-biased."""
+    _write(str(tmp_path / "spans-rank0.jsonl"), [
+        _meta(0, gen=0),
+        _span("fwd", "span", 0.0, 0.1),
+        _meta(0, gen=2),
+        _span("fwd", "span", 10.0, 0.1),
+        _span("opt", "span", 10.1, 0.1),
+    ])
+    _write(str(tmp_path / "spans-rank1.jsonl"), [
+        _meta(1, gen=2), _span("fwd", "span", 10.0, 0.2),
+    ])
+    tracks = critpath.load_tracks(str(tmp_path))
+    assert sorted(tracks) == [0, 1, 0 + 2 * critpath.GEN_STRIDE]
+    assert tracks[0]["generation"] == 0 and len(tracks[0]["events"]) == 1
+    g2 = tracks[2 * critpath.GEN_STRIDE]
+    assert (g2["rank"], g2["generation"]) == (0, 2)
+    assert len(g2["events"]) == 2
+    assert tracks[1]["generation"] == 2  # single-gen file: bare key
+    # the merger uses the same convention
+    spec = importlib.util.spec_from_file_location(
+        "cgx_trace", os.path.join(_REPO, "tools", "cgx_trace.py")
+    )
+    cgx_trace = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cgx_trace)
+    merged = cgx_trace.load_spans(str(tmp_path))
+    assert sorted(merged) == sorted(tracks)
+    # bounded read: a tiny cap keeps the newest spans, flags truncation
+    tracks = critpath.load_tracks(str(tmp_path), max_bytes_per_file=200)
+    assert any(t["truncated"] for t in tracks.values())
+    rec = critpath.analyze(str(tmp_path), use_cache=False)
+    assert any(t["truncated"] is False for t in rec["tracks"])
+    # knob hygiene: garbage cap raises naming the variable
+    with mock.patch.dict(os.environ, {"CGX_CRITPATH_MAX_MB": "junk"}):
+        with pytest.raises(ValueError, match="CGX_CRITPATH_MAX_MB"):
+            critpath.analyze(str(tmp_path), use_cache=False)
+
+
+def test_analysis_memo_hits_and_invalidation(tmp_path):
+    _write(str(tmp_path / "spans-rank0.jsonl"), [
+        _meta(0), _span("fwd", "span", 0.0, 1.0),
+    ])
+    r1 = critpath.analyze(str(tmp_path))
+    r2 = critpath.analyze(str(tmp_path))
+    assert r2 is r1  # stat-signature memo hit
+    assert metrics.get("cgx.critpath.cache_hits") == 1
+    # a grown file is a new signature, not a stale hit
+    with open(str(tmp_path / "spans-rank0.jsonl"), "a") as f:
+        f.write(json.dumps(_span("opt", "span", 1.0, 0.5)) + "\n")
+    r3 = critpath.analyze(str(tmp_path))
+    assert r3 is not r1
+    # recovery reconfiguration empties the memo outright
+    from torch_cgx_tpu.robustness import supervisor as sup_mod
+
+    sup_mod.invalidate_trace_caches()
+    assert critpath._ANALYSIS_CACHE == {}
+    assert metrics.get("cgx.critpath.cache_invalidations") >= 1
+
+
+# ---------------------------------------------------------------------------
+# Plan-side decomposition + the drift loop.
+# ---------------------------------------------------------------------------
+
+
+def test_predict_slice_components_sums_to_predict_slice():
+    """The decomposition is exact: per-component terms sum to the
+    pinned predict_slice total (same formula, untouched numerics)."""
+    model = planner.CostModel.default()
+    for n, ws, bits, chunks in [
+        (1 << 20, 4, 4, 1), (1 << 22, 8, 8, 4), (1 << 16, 2, 4, 2),
+        (1 << 20, 4, 32, 1),  # raw: no codec term
+    ]:
+        comp = model.predict_slice_components(n, ws, bits, 512, chunks)
+        total = model.predict_slice(n, ws, bits, 512, chunks)
+        assert sum(comp.values()) == pytest.approx(total, abs=1e-12)
+        assert set(comp) == {"quantize", "wire", "overhead"}
+
+
+def test_solved_plan_carries_pred_components(monkeypatch):
+    from torch_cgx_tpu.config import CompressionConfig
+
+    monkeypatch.setenv("CGX_PLANNER", "on")
+    planner.plan_cache_clear()
+    groups = [planner._OneGroup(
+        cc=CompressionConfig(bits=4, bucket_size=512), slices=((0, 1 << 20),)
+    )]
+    plan = planner.plan_for_layout(groups, 4, route="staged",
+                                   reduction="SRA")
+    assert plan is not None and plan.pred_components, (
+        "solve must record the breakdown"
+    )
+    pc = plan.components()
+    assert set(pc) >= {"compute", "quantize", "wire", "overhead"}
+    assert all(v >= 0.0 for v in pc.values())
+    for k in ("quantize", "wire"):
+        assert metrics.get(f"cgx.plan.pred_component.{k}") == (
+            pytest.approx(pc[k], abs=1e-9)
+        )
+
+
+def test_falsified_cost_model_one_plan_drift_one_replan(
+    tmp_path, monkeypatch
+):
+    """The feedback loop: a CostModel whose wire rate is falsified 3x
+    against measurement trips the sustained drift monitor ONCE (engine
+    cooldown keeps the event stream to one), and the re-calibration
+    poke adopts the corrected model exactly once — the second trip's
+    poke is a counted no-op, not a retrace storm."""
+    monkeypatch.setenv("CGX_HEALTH", "1")
+    eng = health.maybe_start(0)
+    # the "corrected" calibration the group-consistency file channel
+    # would deliver; the in-process model is the falsified one
+    corrected = dataclasses.replace(
+        planner.CostModel.default(), wire_gbps=2.5, source="cal"
+    )
+    path = tmp_path / "model.json"
+    corrected.save(str(path))
+    monkeypatch.setenv("CGX_PLANNER_MODEL", str(path))
+    planner.set_cost_model(planner.CostModel.default())  # falsified
+    plr = planner.StepPlanner(every=0)
+    mon = health.PlanDriftMonitor(planner=plr, factor=1.5, sustain=2)
+    predicted = {"wire": 0.010, "quantize": 0.004}
+    measured = {"wire": 0.030, "quantize": 0.004}
+    evs = [mon.observe(predicted, measured) for _ in range(4)]
+    # trips on observations 2 and 4; only the first emits (cooldown)
+    assert evs[0] is None and evs[2] is None
+    assert evs[1] is not None and evs[1].kind == health.PLAN_DRIFT
+    assert evs[1].value == pytest.approx(3.0, abs=1e-6)
+    assert evs[3] is None
+    ring = [e for e in eng.status()["events_recent"]
+            if e["kind"] == health.PLAN_DRIFT]
+    assert len(ring) == 1, "exactly one plan_drift event"
+    assert dict(evs[1].detail)["component"] == "wire"
+    # exactly one adopted re-plan: the first poke swaps in the
+    # corrected model, the second finds it already right
+    assert mon.replans == 1
+    assert metrics.get("cgx.plan.replans") == 1
+    assert metrics.get("cgx.plan.replan_noops") == 1
+    assert planner.cost_model().wire_gbps == 2.5
+    assert metrics.get("cgx.critpath.drift_trips") == 2
+    assert metrics.get("cgx.critpath.drift.wire") == (
+        pytest.approx(3.0, abs=1e-4)
+    )
+    # post-adoption the prediction matches measurement: the ratio is
+    # back under the gate slack and the monitor stays quiet
+    assert mon.observe({"wire": 0.030}, measured) is None
+    assert mon.observe({"wire": 0.030}, measured) is None
+    assert metrics.get("cgx.critpath.drift.wire") == pytest.approx(1.0)
+    assert mon.replans == 1 and metrics.get("cgx.plan.replans") == 1
+
+
+def test_drift_loop_runs_without_health_engine(monkeypatch):
+    """Engine-independence: with CGX_HEALTH unset the event is skipped
+    but the gauges and the re-calibration poke still run."""
+    monkeypatch.delenv("CGX_HEALTH", raising=False)
+    calls = []
+
+    class FakePlanner:
+        def update(self):
+            calls.append(1)
+            return True
+
+    mon = health.PlanDriftMonitor(planner=FakePlanner(), factor=1.5,
+                                  sustain=1)
+    ev = mon.observe({"wire": 0.01}, {"wire": 0.05})
+    assert ev is None and mon.events == []
+    assert calls == [1] and mon.replans == 1
+    assert metrics.get("cgx.critpath.drift.wire") == pytest.approx(5.0)
+
+
+# ---------------------------------------------------------------------------
+# Chaos acceptance: slow_rank names the faulted rank.
+# ---------------------------------------------------------------------------
+
+
+def _critpath_rank_main(rank, ws, initfile, mdir, q):
+    try:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, _REPO)
+        os.environ["CGX_METRICS_DIR"] = mdir
+        os.environ["CGX_COMPRESSION_QUANTIZATION_BITS"] = "4"
+        os.environ["CGX_BRIDGE_TIMEOUT_MS"] = "60000"
+        if rank == 1:
+            os.environ["CGX_FAULTS"] = "slow_rank:150ms@rank=1"
+        import torch
+        import torch.distributed as dist
+        import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+
+        dist.init_process_group(
+            "cgx", init_method=f"file://{initfile}", rank=rank,
+            world_size=ws,
+        )
+        t = torch.full((8192,), float(rank + 1))
+        for _ in range(5):
+            dist.all_reduce(t)
+        dist.barrier()
+        dist.destroy_process_group()
+        q.put((rank, None))
+    except Exception:
+        q.put((rank, traceback.format_exc()))
+
+
+@pytest.mark.torch_bridge
+def test_slow_rank_chaos_names_faulted_rank_as_dominator(tmp_path):
+    """Acceptance: 2-rank bridge run, rank 1 injected 150ms slower at
+    every collective — the engine must attribute >= 80% of the faulted
+    step windows to rank 1."""
+    mdir = str(tmp_path / "metrics")
+    initfile = tempfile.mktemp(prefix="cgx_critpath_store_")
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [
+        ctx.Process(target=_critpath_rank_main, args=(r, 2, initfile, mdir, q))
+        for r in range(2)
+    ]
+    for p in procs:
+        p.start()
+    errs = [q.get(timeout=180) for _ in procs]
+    for p in procs:
+        p.join(timeout=30)
+        if p.is_alive():
+            p.terminate()
+    if os.path.exists(initfile):
+        os.unlink(initfile)
+    for rank, err in errs:
+        assert err is None, f"rank {rank}: {err}"
+    proc = subprocess.run(
+        [sys.executable, _CGX_CRITPATH, mdir, "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    report = json.loads(proc.stdout)
+    assert {t["rank"] for t in report["tracks"]} == {0, 1}
+    # faulted windows: the 150ms injection dwarfs the real work
+    faulted = [s for s in report["steps"] if s["total_s"] >= 0.1]
+    assert len(faulted) >= 3, report["steps"]
+    named = [s for s in faulted if s["dominant_rank"] == 1]
+    assert len(named) >= 0.8 * len(faulted), (
+        [(s["label"], s["dominant"], s["dominant_rank"]) for s in faulted]
+    )
+    # and the human rendering names it too
+    proc = subprocess.run(
+        [sys.executable, _CGX_CRITPATH, mdir],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "critical path" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# bench_gate: per-component pred-ratio trajectories.
+# ---------------------------------------------------------------------------
+
+
+def _load_gate():
+    spec = importlib.util.spec_from_file_location(
+        "bench_gate", os.path.join(_REPO, "tools", "bench_gate.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_pred_components_gate_as_trajectories():
+    gate = _load_gate()
+    rec = {
+        "tool": "bench", "metric": "planner_vs_static_4bit_32MB_x4",
+        "value": 1.2, "backend": "host", "chip": "host",
+        "pred_components": {"wire": 2.0, "quantize": 0.8,
+                            "bogus": "nan", "zero": 0.0},
+    }
+    keys = dict(gate.normalize_pred_components(rec))
+    # accuracy form min(r, 1/r): over- and under-prediction both gate
+    assert keys == {
+        "planner_vs_static_4bit_32MB_x4:pred_ratio:wire": 0.5,
+        "planner_vs_static_4bit_32MB_x4:pred_ratio:quantize": 0.8,
+    }
+    # normalize_all carries them next to the aggregate trajectory
+    allk = dict(gate.normalize_all(rec))
+    assert "planner_vs_static_4bit_32MB_x4:pred_ratio:wire" in allk
+    # @cpu separation rides along
+    cpu = dict(rec, backend="cpu", chip="cpu")
+    assert "planner_vs_static_4bit_32MB_x4:pred_ratio:wire@cpu" in dict(
+        gate.normalize_pred_components(cpu)
+    )
+    # a drifted component FAILS the gate against a healthy history
+    healthy = dict(rec, pred_components={"wire": 1.05})
+    baselines = gate.build_baselines([healthy, healthy, healthy])
+    regressions, _ = gate.gate([rec], baselines, 30.0)
+    assert any(
+        r["metric"].endswith(":pred_ratio:wire") for r in regressions
+    )

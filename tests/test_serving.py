@@ -384,6 +384,66 @@ def test_prefill_death_mid_stream_degrades_not_wedges(
     assert wall < DEADLINE_S / 2
 
 
+def test_request_id_flow_survives_prefill_death(
+    model_setup, monkeypatch, tmp_path
+):
+    """ISSUE 17: the request_id thread survives the failover rung. A
+    prefill worker that dies after meta + one frame forces the local
+    re-prefill; the span stream must still carry ONE coherent flow for
+    the request (submit -> failover -> local prefill -> admit), and the
+    critical-path engine must decompose its TTFT with the failover
+    counted — traceability must not die with the worker."""
+    from torch_cgx_tpu.observability import critpath, timeline
+
+    cfg, _model, params = model_setup
+    monkeypatch.setenv("CGX_SERVE_PREFILL_TIMEOUT_MS", "500")
+    monkeypatch.setenv("CGX_METRICS_DIR", str(tmp_path))
+    timeline.reset()
+    try:
+        store = FakeStore()
+        recv = KvPageReceiver(store)
+        server = GPT2Server(cfg, params, _serve_cfg())
+        sched = ContinuousBatchScheduler(server, receiver=recv)
+        (prompt,) = _prompts(cfg, 1, lens=[24])
+        req = Request(id="r0", tokens=list(prompt), max_new_tokens=6)
+        sched.submit(req, remote=True)
+        sender = KvPageSender(store, "r0", depth=2)
+        sender.post_meta({
+            "frames": 99, "pages": 2, "prompt_tokens": 24,
+            "page_tokens": PAGE, "tail_tokens": 0, "first_token": 1,
+        })
+        # the dead worker's META frame already stamped the request id:
+        # the wire stream joins back to the request without the
+        # scheduler's stream registry
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                meta_frame_bytes = store.get("cgxkv/r0/1")
+                break
+            except KeyError:
+                time.sleep(0.01)
+        assert b'"request_id": "r0"' in meta_frame_bytes
+        sender.post_page(0, tp.K_PAGE, 0, 8, 512, 16, b"z" * 16)
+        assert sched.run(deadline_s=DEADLINE_S)
+        sender.stop()
+        assert len(req.output) == 6
+        timeline.flush()
+        flow = critpath.analyze(str(tmp_path), use_cache=False)["requests"]
+        assert "r0" in flow, flow
+        r0 = flow["r0"]
+        assert r0["failovers"] >= 1
+        assert r0["events"] >= 3  # submit + failover + prefill + admit
+        assert r0["ttft_s"] is not None and r0["ttft_s"] > 0.0
+        c = r0["components"]
+        # the local re-prefill is attributed as prefill, and the stall
+        # window that preceded the failover shows up (other/admission),
+        # the decomposition summing to the TTFT
+        assert c["prefill"] > 0.0
+        assert sum(c.values()) == pytest.approx(r0["ttft_s"], abs=0.01)
+    finally:
+        timeline.reset()
+
+
 def test_continuous_batching_admits_midstream(model_setup):
     """More requests than lanes: later requests admit as earlier lanes
     complete (the batch never drains), and every output matches the

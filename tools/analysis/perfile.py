@@ -263,6 +263,28 @@ def check_unbounded_waits(path: Path, tree: ast.Module) -> List[str]:
     if not any(d in path.parts for d in _POLL_SCOPED_DIRS):
         return []
     findings = []
+    # Critical-path engine branch (ISSUE 17): the span analyzer reads
+    # whole JSONL files other processes are still appending to — every
+    # file read there must carry an explicit byte cap (``f.read(n)``;
+    # an argless ``read``/``readlines``/``readline`` scales the
+    # analysis with run length and the unbounded-read is the analyzer's
+    # version of an unbounded wait). Same rule family, same register.
+    if path.name == "critpath.py":
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            if fn.attr in ("read", "readlines", "readline") and not (
+                node.args or node.keywords
+            ):
+                findings.append(
+                    f"{path}:{node.lineno}: unbounded span-file read: "
+                    f"'.{fn.attr}()' without a byte cap — pass an "
+                    "explicit size (CGX_CRITPATH_MAX_MB bounds the "
+                    "analysis, not the run)"
+                )
     for node in ast.walk(tree):
         if not isinstance(node, ast.While) or not _const_true(node.test):
             continue
@@ -416,9 +438,14 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # triggers / admissions, snapshot-page ship/receive/re-request
     # counters, the last_join_ms gauge and reaped-key counts —
     # docs/OBSERVABILITY.md.
-    "async", "codec", "collective", "elastic", "faults", "flightrec",
-    "health", "heartbeat", "plan", "qerr", "recovery", "ring", "runtime",
-    "sched", "serve", "shm", "sra", "step", "trace", "wire", "xla",
+    # "critpath" is the distributed critical-path engine (PR 17):
+    # analysis/cache counters, per-component seconds of the last step
+    # window, the dominant-rank gauge and the drift-loop trip counter —
+    # docs/OBSERVABILITY.md "Critical path & drift".
+    "async", "codec", "collective", "critpath", "elastic", "faults",
+    "flightrec", "health", "heartbeat", "plan", "qerr", "recovery",
+    "ring", "runtime", "sched", "serve", "shm", "sra", "step", "trace",
+    "wire", "xla",
 })
 
 
@@ -1151,6 +1178,101 @@ def check_worker_timeline_coverage(path: Path, tree: ast.Module) -> List[str]:
     ]
 
 
+def _health_event_kinds(health_path: Path):
+    """The ``EVENT_KINDS`` registry declared in observability/health.py
+    (parsed through the shared parse cache, never imported), with the
+    tuple's Name references resolved against the module's own
+    ``KIND = "string"`` constants. None = file missing or no registry."""
+    src = get_source(health_path)
+    if src.tree is None:
+        return None
+    consts: Dict[str, str] = {}
+    kinds_node = None
+    for node in src.tree.body:
+        if not (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+        ):
+            continue
+        name = node.targets[0].id
+        if isinstance(node.value, ast.Constant) and isinstance(
+            node.value.value, str
+        ):
+            consts[name] = node.value.value
+        elif name == "EVENT_KINDS":
+            kinds_node = node.value
+    if kinds_node is None:
+        return None
+    out = set()
+    for n in ast.walk(kinds_node):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            out.add(n.value)
+        elif isinstance(n, ast.Name) and n.id in consts:
+            out.add(consts[n.id])
+    return out or None
+
+
+def check_health_event_kinds(path: Path, tree: ast.Module) -> List[str]:
+    """HealthEvent-kind registry gate (ISSUE 17): every ``kind=`` a
+    ``HealthEvent(...)`` construction site passes — a string literal or
+    a Name resolvable against the file's own module-level string
+    constants — must appear in observability/health.py's
+    ``EVENT_KINDS`` tuple. The docs event table, cgx_top's event pane
+    and the flight recorder's rename all key off that registry; an
+    event emitted under an unregistered kind is invisible to all of
+    them (same cross-check style as timeline-coverage)."""
+    if _LIB_DIR not in path.parts:
+        return []
+    consts: Dict[str, str] = {}
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and isinstance(node.value, ast.Constant)
+            and isinstance(node.value.value, str)
+        ):
+            consts[node.targets[0].id] = node.value.value
+    sites: List[Tuple[int, str]] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.attr if isinstance(fn, ast.Attribute)
+            else fn.id if isinstance(fn, ast.Name) else ""
+        )
+        if name != "HealthEvent":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "kind":
+                continue
+            if isinstance(kw.value, ast.Constant) and isinstance(
+                kw.value.value, str
+            ):
+                sites.append((node.lineno, kw.value.value))
+            elif isinstance(kw.value, ast.Name) and kw.value.id in consts:
+                sites.append((node.lineno, consts[kw.value.id]))
+    if not sites:
+        return []
+    idx = path.parts.index(_LIB_DIR)
+    health_path = Path(*path.parts[: idx + 1]) / "observability" / "health.py"
+    declared = _health_event_kinds(health_path)
+    if declared is None:
+        return [
+            f"{path}:1: HealthEvent kinds cannot be cross-checked: "
+            f"{health_path} missing or lacks an EVENT_KINDS registry"
+        ]
+    return [
+        f"{path}:{line}: HealthEvent kind {kind!r} missing from "
+        "observability/health.py EVENT_KINDS — the docs table, cgx_top "
+        "event pane and flightrec rename key off that registry"
+        for line, kind in sorted(sites)
+        if kind not in declared
+    ]
+
+
 # ---------------------------------------------------------------------------
 # The registry + driver.
 # ---------------------------------------------------------------------------
@@ -1163,6 +1285,7 @@ RULES: "OrderedDict[str, RuleFn]" = OrderedDict([
     ("exception-hygiene", check_exception_hygiene),
     ("library-hygiene", check_library_hygiene),
     ("timeline-coverage", check_worker_timeline_coverage),
+    ("health-event-kinds", check_health_event_kinds),
     ("reducer-routing", check_reducer_reduce_routing),
     ("epilogue-f32", check_epilogue_f32_intermediates),
     ("staged-purity", check_staged_purity),

@@ -258,6 +258,38 @@ def check_pred_slack(
     return out
 
 
+# Component-decomposed prediction accuracy (ISSUE 17): records carrying
+# a ``pred_components`` dict ({component: predicted/measured ratio})
+# gate one ``<metric>:pred_ratio:<component>`` trajectory per component,
+# each symmetric around 1.0 exactly like the whole-step ratio above — a
+# drift confined to one stage (say the wire model after an interconnect
+# change) fails ITS trajectory instead of averaging away inside the
+# whole-step number. ``@cpu`` placeholder separation applies unchanged.
+
+
+def normalize_pred_components(rec: dict) -> List[Tuple[str, float]]:
+    """[(``<metric>:pred_ratio:<component>`` key, ``min(r, 1/r)``)] for
+    records carrying per-component prediction ratios; [] otherwise."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return []
+    metric = rec.get("metric")
+    comps = rec.get("pred_components")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return []
+    if not isinstance(comps, dict):
+        return []
+    suffix = _PLACEHOLDER_SUFFIX if is_placeholder(rec) else ""
+    out: List[Tuple[str, float]] = []
+    for comp, r in sorted(comps.items()):
+        if not isinstance(r, (int, float)) or isinstance(r, bool) or r <= 0:
+            continue
+        out.append((
+            f"{metric}{_PRED_SUFFIX}:{comp}{suffix}",
+            min(float(r), 1.0 / float(r)),
+        ))
+    return out
+
+
 # Serving latency floor (ISSUE 15): serve bench records carry the
 # measured time-to-first-token next to the tokens/s throughput. Lower is
 # better for a latency, so the gated trajectory value is its INVERSE
@@ -320,6 +352,7 @@ def normalize_all(rec: dict) -> List[Tuple[str, float]]:
         norm = fn(rec)
         if norm is not None:
             out.append(norm)
+    out.extend(normalize_pred_components(rec))
     return out
 
 

@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Distributed critical-path report over a run's span files.
+
+Loads the per-rank ``spans-rank*.jsonl`` files the timeline layer
+leaves in ``CGX_METRICS_DIR`` and runs the critical-path engine
+(``torch_cgx_tpu/observability/critpath.py``) over them:
+
+* per train step: the backward-walked cross-rank critical path,
+  decomposed into ``compute / quantize / wire / queue_wait /
+  straggler_wait`` — the dominator column names the step's bottleneck
+  (``wait:r<rank>`` when a straggling rank held the cluster),
+* the dominator histogram across steps and the top slowest cross-rank
+  edges (message publishes and collective gates the path crossed),
+* per serving request: the TTFT decomposition
+  (``admission / prefill / ship / decode / other``).
+
+    python tools/cgx_critpath.py <dir>          # default: $CGX_METRICS_DIR
+    python tools/cgx_critpath.py <dir> --json   # machine-readable report
+    python tools/cgx_critpath.py <dir> --steps 5  # only the last 5 steps
+
+Stdlib only: the engine file is loaded by path, so this tool never
+imports the (jax-heavy) package.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+from typing import List, Optional
+
+_ENGINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "torch_cgx_tpu", "observability", "critpath.py",
+)
+
+
+def _load_engine():
+    spec = importlib.util.spec_from_file_location("cgx_critpath_engine",
+                                                  _ENGINE_PATH)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)  # type: ignore[union-attr]
+    return mod
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return f"{v * 1e3:8.2f}" if v is not None else "       -"
+
+
+def render_report(report: dict, n_steps: Optional[int] = None) -> str:
+    lines: List[str] = []
+    tracks = report["tracks"]
+    lines.append(
+        f"critical path over {len(tracks)} track(s) "
+        f"({sum(t['events'] for t in tracks)} events) in "
+        f"{report['directory']}"
+    )
+    for t in tracks:
+        gen = f" gen {t['generation']}" if t["generation"] else ""
+        trunc = " [truncated read]" if t["truncated"] else ""
+        lines.append(
+            f"  rank {t['rank']}{gen}: {t['events']} events{trunc}"
+        )
+    steps = report["steps"]
+    if n_steps is not None and n_steps > 0:
+        steps = steps[-n_steps:]
+    if steps:
+        lines.append("")
+        lines.append(
+            "  step       total_ms  compute  quantize     wire  "
+            "queue_w  straggl  dominant"
+        )
+        for s in steps:
+            c = s["components"]
+            lines.append(
+                f"  {s['label'][:10]:<10} {_fmt_ms(s['total_s'])}"
+                f" {_fmt_ms(c['compute'])} {_fmt_ms(c['quantize'])}"
+                f" {_fmt_ms(c['wire'])} {_fmt_ms(c['queue_wait'])}"
+                f" {_fmt_ms(c['straggler_wait'])}"
+                f"  {s['dominant'] or '-'}"
+                + (f" (r{s['dominant_rank']})"
+                   if s["dominant_rank"] is not None else "")
+            )
+    if report["dominators"]:
+        lines.append("")
+        lines.append("  dominators:")
+        total = sum(report["dominators"].values())
+        for name, n in sorted(
+            report["dominators"].items(), key=lambda kv: -kv[1]
+        ):
+            lines.append(
+                f"    {name:<12} {n:4d} step(s)  {100.0 * n / total:5.1f}%"
+            )
+    if report["edges"]:
+        lines.append("")
+        lines.append("  slowest cross-rank edges:")
+        for e in report["edges"][:3]:
+            lines.append(
+                f"    {e['kind']:<10} r{e['src']} -> r{e['dst']}  "
+                f"exposed {_fmt_ms(e['exposed_s']).strip()} ms  "
+                f"({e['key']})"
+            )
+    if report["requests"]:
+        lines.append("")
+        lines.append(
+            "  request          ttft_ms    admit  prefill     ship   "
+            "decode    other  failovers"
+        )
+        for rid, r in report["requests"].items():
+            c = r["components"]
+            lines.append(
+                f"  {rid[:16]:<16} {_fmt_ms(r['ttft_s'])}"
+                f" {_fmt_ms(c['admission'])} {_fmt_ms(c['prefill'])}"
+                f" {_fmt_ms(c['ship'])} {_fmt_ms(c['decode'])}"
+                f" {_fmt_ms(c['other'])}  {r['failovers']}"
+            )
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "directory", nargs="?", default=os.environ.get("CGX_METRICS_DIR"),
+        help="metrics dir holding spans-rank*.jsonl (default: "
+             "$CGX_METRICS_DIR)",
+    )
+    ap.add_argument(
+        "--json", action="store_true",
+        help="print the full report as JSON",
+    )
+    ap.add_argument(
+        "--steps", type=int, default=None,
+        help="only render the last N step rows (the JSON report always "
+             "carries all of them)",
+    )
+    args = ap.parse_args(argv)
+    if not args.directory:
+        print("cgx_critpath: no directory given and CGX_METRICS_DIR unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.directory):
+        print(f"cgx_critpath: {args.directory!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    engine = _load_engine()
+    report = engine.analyze(args.directory, use_cache=False)
+    if not report["tracks"]:
+        print(
+            "cgx_critpath: no spans-rank*.jsonl in "
+            f"{args.directory!r} — was CGX_METRICS_DIR set during the run?",
+            file=sys.stderr,
+        )
+        return 1
+    if args.json:
+        print(json.dumps(report, indent=2))
+    else:
+        print(render_report(report, args.steps))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

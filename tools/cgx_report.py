@@ -743,9 +743,74 @@ def render(summary: dict) -> str:
             )
             for rule, n in sorted((a.get("by_rule") or {}).items()):
                 parts.append(f"  {rule}: {n}")
+    if summary.get("critpath"):
+        cp = summary["critpath"]
+        parts.append("\n== critpath (distributed critical path) ==")
+        parts.append(f"  steps analyzed: {cp['steps']}")
+        total = sum(cp["dominators"].values()) or 1
+        for name, n in sorted(
+            cp["dominators"].items(), key=lambda kv: -kv[1]
+        ):
+            parts.append(
+                f"  dominator {name}: {n} step(s) ({100.0 * n / total:.0f}%)"
+            )
+        for e in cp["edges"]:
+            parts.append(
+                f"  edge {e['kind']} r{e['src']}->r{e['dst']}: "
+                f"exposed {e['exposed_s'] * 1e3:.2f} ms ({e['key']})"
+            )
+        if cp["ttft_mean_ms"]:
+            t = cp["ttft_mean_ms"]
+            parts.append(
+                f"  ttft decomposition (mean over {cp['requests']} "
+                "request(s), ms): "
+                + " ".join(f"{k}={v:.2f}" for k, v in t.items())
+            )
     if len(parts) == 1:
         parts.append("(no events recorded — was CGX_METRICS_DIR set?)")
     return "\n".join(parts)
+
+
+def _critpath_summary(directory: str) -> Optional[dict]:
+    """Condensed critical-path block (ISSUE 17): dominator histogram,
+    top slowest cross-rank edges, and the mean TTFT decomposition —
+    None (section omitted) when no span files exist or the engine file
+    is missing/broken. Loaded by path: this tool stays stdlib-only."""
+    import importlib.util
+
+    if not glob.glob(os.path.join(directory, "spans-rank*.jsonl")):
+        return None
+    try:
+        p = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "torch_cgx_tpu", "observability", "critpath.py",
+        )
+        spec = importlib.util.spec_from_file_location(
+            "cgx_report_critpath", p
+        )
+        eng = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(eng)  # type: ignore[union-attr]
+        report = eng.analyze(directory, use_cache=False)
+    except Exception:
+        return None
+    ttft: Dict[str, float] = defaultdict(float)
+    n_req = 0
+    for r in report["requests"].values():
+        if r["ttft_s"] is None:
+            continue
+        n_req += 1
+        for k, v in r["components"].items():
+            ttft[k] += v
+    return {
+        "steps": len(report["steps"]),
+        "dominators": report["dominators"],
+        "edges": report["edges"][:3],
+        "ttft_mean_ms": (
+            {k: round(v / n_req * 1e3, 3) for k, v in sorted(ttft.items())}
+            if n_req else {}
+        ),
+        "requests": n_req,
+    }
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -770,6 +835,7 @@ def main(argv: Optional[List[str]] = None) -> int:
               file=sys.stderr)
         return 2
     summary = summarize(load_dir(args.directory))
+    summary["critpath"] = _critpath_summary(args.directory)
     if args.analysis:
         try:
             sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

@@ -279,6 +279,36 @@ def _pred(m: Dict[str, float]) -> str:
     return f"{v:.2f}" if v else "-"
 
 
+def _crit(directory: str, state: dict) -> str:
+    """Last step window's critical-path dominator (``compute`` /
+    ``wire`` / ``wait:r<rank>`` / ``-``) — the engine file is loaded by
+    path once per process (guarded: a missing/broken engine renders
+    ``-``, never kills the dashboard) and polls tail-bounded reads."""
+    eng = state.get("_critpath_engine", False)
+    if eng is False:
+        try:
+            import importlib.util
+
+            p = os.path.join(
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                "torch_cgx_tpu", "observability", "critpath.py",
+            )
+            spec = importlib.util.spec_from_file_location(
+                "cgx_top_critpath", p
+            )
+            eng = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(eng)  # type: ignore[union-attr]
+        except Exception:
+            eng = None
+        state["_critpath_engine"] = eng
+    if eng is None:
+        return "-"
+    try:
+        return eng.live_dominator(directory) or "-"
+    except Exception:
+        return "-"
+
+
 def _autotune_cache(m: Dict[str, float]) -> str:
     """Codec autotune cache hit rate (``cgx.codec.autotune_*``) — a
     hardware session watches this climb as the persisted per-chip cache
@@ -359,11 +389,14 @@ def render(directory: str, state: dict) -> str:
         f"{time.strftime('%H:%M:%S')}   ranks: {len(view)}"
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
-               "edges", "overlap", "sched$", "plan$", "pred", "atune$",
-               "roofl", "lag", "async$", "tok/s", "ttft",
+               "edges", "overlap", "sched$", "plan$", "pred", "crit",
+               "atune$", "roofl", "lag", "async$", "tok/s", "ttft",
                "straggler", "gen", "ws", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
+    # Cluster-wide (the critical path crosses ranks): one poll per
+    # frame, the same cell on every row.
+    crit = _crit(directory, state)
     for rank, d in sorted(view.items()):
         m = d["metrics"]
         rows.append((
@@ -377,6 +410,7 @@ def render(directory: str, state: dict) -> str:
             _sched_cache(m),
             _plan_cache(m),
             _pred(m),
+            crit,
             _autotune_cache(m),
             _roofline(m),
             _async_lag(m),

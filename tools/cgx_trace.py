@@ -60,8 +60,19 @@ def _read_jsonl(path: str) -> List[dict]:
     return out
 
 
+# Track-key stride for non-zero generations: after an elastic recovery
+# the writer re-emits a generation-tagged meta header mid-file, and the
+# merge splits the file into one track per (rank, generation) — keyed
+# ``rank + generation * _GEN_STRIDE`` so keys stay sortable ints (and
+# Chrome pids). A single-generation file keeps its bare rank key.
+_GEN_STRIDE = 100000
+
+
 def load_spans(directory: str) -> Dict[int, dict]:
-    """{rank: {"meta": header-or-None, "events": [span dicts]}}."""
+    """{track key: {"meta": header-or-None, "events": [span dicts],
+    "rank": int, "generation": int}} — one track per (rank, generation)
+    segment (see ``_GEN_STRIDE``); bare-rank keys when a file holds a
+    single generation."""
     per_rank: Dict[int, dict] = {}
     for p in sorted(glob.glob(os.path.join(directory, "spans-rank*.jsonl"))):
         name = os.path.basename(p)
@@ -70,13 +81,38 @@ def load_spans(directory: str) -> Dict[int, dict]:
         except (ValueError, IndexError):
             continue
         rows = _read_jsonl(p)
-        meta = next((r for r in rows if r.get("kind") == "meta"), None)
-        events = [
-            r for r in rows
-            if r.get("kind") in ("span", "instant")
-            and isinstance(r.get("t_mono"), (int, float))
-        ]
-        per_rank[rank] = {"meta": meta, "events": events}
+        segs: List[Tuple[int, Optional[dict], List[dict]]] = []
+        cur_gen, cur_meta, cur_events = 0, None, []  # type: ignore[var-annotated]
+        for r in rows:
+            if r.get("kind") == "meta":
+                g = int(r.get("generation") or 0)
+                if cur_meta is None and not cur_events:
+                    cur_gen, cur_meta = g, r
+                elif g != cur_gen:
+                    segs.append((cur_gen, cur_meta, cur_events))
+                    cur_gen, cur_meta, cur_events = g, r, []
+            elif r.get("kind") in ("span", "instant") and isinstance(
+                r.get("t_mono"), (int, float)
+            ):
+                cur_events.append(r)
+        segs.append((cur_gen, cur_meta, cur_events))
+        segs = [s for s in segs if s[1] is not None or s[2]]
+        if not segs:
+            per_rank[rank] = {
+                "meta": None, "events": [], "rank": rank, "generation": 0,
+            }
+            continue
+        multi = len(segs) > 1
+        for gen, meta, events in segs:
+            key = rank + gen * _GEN_STRIDE if multi and gen else rank
+            ent = per_rank.get(key)
+            if ent is not None:  # same (rank, gen) re-headed: merge
+                ent["events"].extend(events)
+                continue
+            per_rank[key] = {
+                "meta": meta, "events": events,
+                "rank": rank, "generation": gen,
+            }
     return per_rank
 
 
@@ -202,9 +238,12 @@ def build_chrome_trace(
     xfer_src: Dict[str, Tuple[int, int, float]] = {}
     xfer_dst: Dict[str, List[Tuple[int, int, float]]] = defaultdict(list)
     for rank in sorted(per_rank):
+        base_rank = per_rank[rank].get("rank", rank)
+        gen = per_rank[rank].get("generation", 0)
+        label = f"rank {base_rank}" + (f" (gen {gen})" if gen else "")
         events.append({
             "name": "process_name", "ph": "M", "pid": rank,
-            "args": {"name": f"rank {rank}"},
+            "args": {"name": label},
         })
         events.append({
             "name": "process_sort_index", "ph": "M", "pid": rank,

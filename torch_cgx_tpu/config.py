@@ -134,6 +134,7 @@ HEALTH = "CGX_HEALTH"  # master enable for the streaming health engine
 HEALTH_INTERVAL_S = "CGX_HEALTH_INTERVAL_S"  # evaluator sample interval
 HEALTH_STRAGGLER_FACTOR = "CGX_HEALTH_STRAGGLER_FACTOR"  # skew score gate
 HEALTH_STEP_FACTOR = "CGX_HEALTH_STEP_FACTOR"  # step-time regression gate
+HEALTH_PLAN_DRIFT_FACTOR = "CGX_HEALTH_PLAN_DRIFT_FACTOR"  # drift-loop gate
 HEALTH_QERR_SLO = "CGX_HEALTH_QERR_SLO"  # compression-quality SLO (rel-L2)
 PROM_PORT = "CGX_PROM_PORT"  # Prometheus text exposition endpoint
 
@@ -775,6 +776,16 @@ def health_step_factor() -> float:
     a ``step_regression`` event."""
     v = _env.get_float_env_or_default(HEALTH_STEP_FACTOR, 2.0)
     return v if v > 0 else 2.0
+
+
+def health_plan_drift_factor() -> float:
+    """CGX_HEALTH_PLAN_DRIFT_FACTOR: plan-drift gate — a measured
+    critical-path component (``cgx.critpath.component.*``) exceeding the
+    plan's solve-time prediction (``cgx.plan.pred_component.*``) by this
+    factor, sustained, raises a ``plan_drift`` event and pokes the
+    planner's re-calibration (``observability.health.PlanDriftMonitor``)."""
+    v = _env.get_float_env_or_default(HEALTH_PLAN_DRIFT_FACTOR, 1.5)
+    return v if v > 0 else 1.5
 
 
 def health_qerr_slo() -> Optional[float]:

@@ -1,0 +1,772 @@
+"""Distributed critical-path engine (ISSUE 17).
+
+Reconstructs the cross-rank dependency DAG from the span streams the
+timeline layer already leaves in ``CGX_METRICS_DIR`` — collective
+``(group, op, seq)`` rounds, ``put → take`` happens-before message keys,
+sched chunk spans, and the serving plane's request-tagged frames — and
+walks the **distributed critical path** backward through it:
+
+* per train step (``step`` instants when the trainer emits them,
+  collective rounds otherwise): which rank/edge/phase the step's wall
+  time actually sat on, decomposed into the dominator taxonomy
+  ``compute / quantize / wire / queue_wait / straggler_wait`` (the last
+  carrying the suspect rank — the cluster was idle waiting on it);
+* per serving request (``req``-tagged spans threaded prefill → ship →
+  decode): a TTFT decomposition into
+  ``admission / prefill / ship / decode / other``.
+
+The walk is a single backward chain: start at the window's latest span
+end, attribute the segment under the cursor to its most-specific
+covering span's category, and *jump tracks* at happens-before edges —
+a take-wait whose matching put published late jumps to the sender, a
+collective exit gated by the last entrant jumps to the straggler.
+Un-spanned gaps on the critical track are ``straggler_wait`` charged to
+that rank: the cluster waited on it doing nothing recorded.
+
+Every span-file read is **bounded** (``CGX_CRITPATH_MAX_MB`` per file,
+tail-biased — lint's unbounded-wait rule forbids argless reads in this
+file), and the per-directory analysis memo is reset-reachable from
+``robustness.supervisor.invalidate_trace_caches`` via
+:func:`invalidate_critpath_cache` (the analyzer's orphan-memo pass
+proves it).
+
+Loadable standalone (``tools/cgx_critpath.py`` / ``cgx_top`` load this
+file by path, stdlib only): package imports are guarded — outside the
+package the metric hooks become no-ops.
+
+Metrics: ``cgx.critpath.*`` (docs/OBSERVABILITY.md "Metric namespaces").
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+from collections import OrderedDict, defaultdict
+from typing import Any, Dict, List, Optional, Tuple
+
+if __package__:
+    from ..utils.logging import metrics
+else:  # standalone load (tools/): metric hooks are no-ops
+
+    class _NullMetrics:
+        def add(self, *a, **k):
+            return 0.0
+
+        def set(self, *a, **k):
+            return None
+
+        def get(self, *a, **k):
+            return 0.0
+
+    metrics = _NullMetrics()  # type: ignore[assignment]
+
+# Category string literals (== observability.timeline CAT_*; literal so
+# the module loads standalone).
+_CAT_COLLECTIVE = "collective"
+_CAT_PHASE = "phase"
+_CAT_QUANTIZE = "quantize"
+_CAT_WIRE = "wire"
+_CAT_WAIT = "wait"
+_CAT_SPAN = "span"
+
+_PUT_NAMES = ("shm.put", "store.put")
+_TAKE_WAIT_NAMES = ("shm.take.wait", "store.take.wait")
+
+#: Dominator taxonomy (docs/OBSERVABILITY.md "Critical path & drift").
+COMPONENTS = ("compute", "quantize", "wire", "queue_wait", "straggler_wait")
+
+_CAT_TO_COMPONENT = {
+    _CAT_QUANTIZE: "quantize",
+    _CAT_WIRE: "wire",
+    _CAT_WAIT: "queue_wait",
+    _CAT_SPAN: "compute",
+    _CAT_COLLECTIVE: "compute",
+    _CAT_PHASE: "compute",
+}
+
+# Track keys: rank for generation 0, rank + gen * stride otherwise —
+# the same convention tools/cgx_trace.py uses for per-(rank, generation)
+# tracks after an elastic membership change.
+GEN_STRIDE = 100000
+
+_EPS = 1e-9
+_WALK_CAP = 200000  # backward-walk iteration bound (reads are bounded too)
+
+
+def _max_read_bytes() -> int:
+    """Per-file read cap: ``CGX_CRITPATH_MAX_MB`` (default 64)."""
+    raw = os.environ.get("CGX_CRITPATH_MAX_MB", "")
+    if not raw:
+        mb = 64.0
+    else:
+        try:
+            mb = float(raw)
+        except ValueError:
+            raise ValueError(
+                f"env var CGX_CRITPATH_MAX_MB must be a float, got {raw!r}"
+            ) from None
+    return max(1 << 16, int(mb * (1 << 20)))
+
+
+def _read_jsonl_bounded(
+    path: str, max_bytes: int
+) -> Tuple[List[dict], bool]:
+    """Parse up to ``max_bytes`` of a span JSONL file, tail-biased: an
+    over-cap file keeps its newest spans (the window being analyzed) and
+    drops the head. Torn lines (killed writer, seek landing mid-line)
+    are skipped. Returns (rows, truncated)."""
+    truncated = False
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > max_bytes:
+                truncated = True
+                f.seek(size - max_bytes)
+            data = f.read(max_bytes)
+    except OSError:
+        return [], False
+    lines = data.decode("utf-8", "replace").split("\n")
+    if truncated and lines:
+        lines = lines[1:]  # the seek's partial first line
+    rows: List[dict] = []
+    for line in lines:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            row = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if isinstance(row, dict):
+            rows.append(row)
+    return rows, truncated
+
+
+def load_tracks(
+    directory: str, max_bytes_per_file: Optional[int] = None
+) -> Dict[int, dict]:
+    """{track key: {"rank", "generation", "meta", "events",
+    "truncated"}} — one track per (rank, generation) segment, split at
+    generation-tagged ``meta`` headers (elastic membership: a rejoined
+    rank's spans must not conflate with the dead generation's)."""
+    cap = max_bytes_per_file or _max_read_bytes()
+    tracks: Dict[int, dict] = {}
+    for p in sorted(glob.glob(os.path.join(directory, "spans-rank*.jsonl"))):
+        name = os.path.basename(p)
+        try:
+            rank = int(name[len("spans-rank"):].split(".")[0])
+        except (ValueError, IndexError):
+            continue
+        rows, truncated = _read_jsonl_bounded(p, cap)
+        segs: List[Tuple[int, Optional[dict], List[dict]]] = []
+        cur_gen, cur_meta, cur_events = 0, None, []  # type: ignore[var-annotated]
+        for r in rows:
+            kind = r.get("kind")
+            if kind == "meta":
+                g = int(r.get("generation") or 0)
+                if cur_meta is None and not cur_events:
+                    cur_gen, cur_meta = g, r
+                elif g != cur_gen:
+                    segs.append((cur_gen, cur_meta, cur_events))
+                    cur_gen, cur_meta, cur_events = g, r, []
+            elif kind in ("span", "instant") and isinstance(
+                r.get("t_mono"), (int, float)
+            ):
+                cur_events.append(r)
+        segs.append((cur_gen, cur_meta, cur_events))
+        segs = [s for s in segs if s[1] is not None or s[2]]
+        if not segs:
+            tracks[rank] = {
+                "rank": rank, "generation": 0, "meta": None,
+                "events": [], "truncated": truncated,
+            }
+            continue
+        multi = len(segs) > 1
+        for gen, meta, events in segs:
+            key = rank + gen * GEN_STRIDE if multi and gen else rank
+            ent = tracks.get(key)
+            if ent is not None:  # same (rank, gen) re-headed: merge
+                ent["events"].extend(events)
+                continue
+            tracks[key] = {
+                "rank": rank, "generation": gen, "meta": meta,
+                "events": events, "truncated": truncated,
+            }
+    return tracks
+
+
+def estimate_offsets(tracks: Dict[int, dict]) -> Dict[int, float]:
+    """Per-track additive mono-clock correction (reference = lowest
+    track key): put-end happens-before take-wait-end bounds per message
+    key, NTP midpoint when both directions exist, wall-clock meta delta
+    for disconnected tracks. Compact mirror of the cgx_trace estimator."""
+    keys = sorted(tracks)
+    if not keys:
+        return {}
+    puts: Dict[str, List[Tuple[int, float]]] = defaultdict(list)
+    takes: Dict[str, List[Tuple[int, float]]] = defaultdict(list)
+    for tk, data in tracks.items():
+        for ev in data["events"]:
+            mk = ev.get("key")
+            if not mk:
+                continue
+            if ev.get("name") in _PUT_NAMES:
+                puts[mk].append((tk, ev["t_mono"] + ev.get("dur_s", 0.0)))
+            elif ev.get("name") in _TAKE_WAIT_NAMES:
+                takes[mk].append((tk, ev["t_mono"] + ev.get("dur_s", 0.0)))
+    lo: Dict[Tuple[int, int], float] = {}
+    for mk, senders in puts.items():
+        if len(senders) != 1:
+            continue
+        a, t_pub = senders[0]
+        for b, t_hdr in takes.get(mk, []):
+            if a == b:
+                continue
+            bound = t_pub - t_hdr
+            cur = lo.get((a, b))
+            if cur is None or bound > cur:
+                lo[(a, b)] = bound
+    est: Dict[Tuple[int, int], float] = {}
+    for (a, b), lob in lo.items():
+        est[(a, b)] = (lob + -lo[(b, a)]) / 2.0 if (b, a) in lo else lob
+    offsets: Dict[int, float] = {keys[0]: 0.0}
+    frontier = [keys[0]]
+    while frontier:
+        a = frontier.pop()
+        for b in keys:
+            if b in offsets:
+                continue
+            if (a, b) in est:
+                offsets[b] = offsets[a] + est[(a, b)]
+                frontier.append(b)
+            elif (b, a) in est:
+                offsets[b] = offsets[a] - est[(b, a)]
+                frontier.append(b)
+    ref_meta = tracks[keys[0]].get("meta") or {}
+    ref_delta = ref_meta.get("mono_wall_delta")
+    for k in keys:
+        if k in offsets:
+            continue
+        delta = (tracks[k].get("meta") or {}).get("mono_wall_delta")
+        if ref_delta is not None and delta is not None:
+            offsets[k] = delta - ref_delta
+        else:
+            offsets[k] = 0.0
+    return offsets
+
+
+# ---------------------------------------------------------------------------
+# DAG assembly: aligned spans, message edges, collective gates.
+# ---------------------------------------------------------------------------
+
+
+def _aligned(tracks: Dict[int, dict], offsets: Dict[int, float]) -> dict:
+    """One pass over every track: aligned span/instant lists plus the
+    cross-track edge indexes (unique put senders per message key, last
+    entrant per collective round)."""
+    spans: Dict[int, List[dict]] = {}
+    instants: Dict[int, List[dict]] = {}
+    put_src: Dict[str, Tuple[int, float]] = {}
+    put_multi: set = set()
+    rounds: Dict[Tuple[int, str, int], List[Tuple[int, float, float]]] = (
+        defaultdict(list)
+    )
+    for tk, data in tracks.items():
+        off = offsets.get(tk, 0.0)
+        ss: List[dict] = []
+        ii: List[dict] = []
+        for ev in data["events"]:
+            t0 = float(ev["t_mono"]) + off
+            if ev.get("kind") == "instant":
+                ii.append({
+                    "name": ev.get("name"), "cat": ev.get("cat"),
+                    "t": t0, "req": ev.get("req"), "ev": ev,
+                })
+                continue
+            t1 = t0 + float(ev.get("dur_s", 0.0))
+            s = {
+                "name": ev.get("name"), "cat": ev.get("cat"),
+                "t0": t0, "t1": t1, "key": ev.get("key"),
+                "seq": ev.get("seq"), "group": ev.get("group"),
+                "req": ev.get("req"), "track": tk,
+            }
+            ss.append(s)
+            if s["key"] and s["name"] in _PUT_NAMES:
+                if s["key"] in put_src and put_src[s["key"]][0] != tk:
+                    put_multi.add(s["key"])
+                else:
+                    put_src[s["key"]] = (tk, t1)
+            if s["cat"] == _CAT_COLLECTIVE and s["seq"] is not None:
+                rounds[(int(ev.get("group", 0)), s["name"], int(s["seq"]))
+                       ].append((tk, t0, t1))
+        ss.sort(key=lambda s: (s["t1"], s["t0"]))
+        spans[tk] = ss
+        instants[tk] = sorted(ii, key=lambda i: i["t"])
+    for mk in put_multi:
+        put_src.pop(mk, None)
+    # Last entrant per round: the participant whose START gates everyone
+    # else's exit (the straggler edge of the collective barrier).
+    gates: Dict[Tuple[int, str, int], Tuple[int, float]] = {}
+    for rk, parts in rounds.items():
+        if len(parts) < 2:
+            continue
+        tk, t0, _t1 = max(parts, key=lambda p: p[1])
+        gates[rk] = (tk, t0)
+    return {
+        "spans": spans, "instants": instants,
+        "puts": put_src, "rounds": rounds, "gates": gates,
+    }
+
+
+def _step_windows(dag: dict) -> List[Tuple[float, float, str]]:
+    """Step window boundaries: trainer ``step`` instants when present
+    (the grad_sync cadence marker), else collective rounds — each
+    round's cluster-wide exit closes a window that opened at the
+    previous round's exit. Returns [(t0, t1, label)]."""
+    step_ts: List[float] = sorted(
+        i["t"]
+        for ii in dag["instants"].values()
+        for i in ii
+        if i["name"] == "step"
+    )
+    all_t0 = [s["t0"] for ss in dag["spans"].values() for s in ss]
+    if not all_t0:
+        return []
+    t_min = min(all_t0)
+    t_max = max(s["t1"] for ss in dag["spans"].values() for s in ss)
+    if len(step_ts) >= 2:
+        bounds = [t_min] + step_ts + [t_max]
+        return [
+            (bounds[i], bounds[i + 1], f"step{i}")
+            for i in range(len(bounds) - 1)
+            if bounds[i + 1] - bounds[i] > _EPS
+        ]
+    # Collective-round segmentation: one window per multi-rank round.
+    ends = sorted(
+        (max(t1 for _tk, _t0, t1 in parts), rk)
+        for rk, parts in dag["rounds"].items()
+        if len(parts) >= 2
+    )
+    if not ends:
+        return [(t_min, t_max, "window0")]
+    out: List[Tuple[float, float, str]] = []
+    prev = t_min
+    for i, (t_end, rk) in enumerate(ends):
+        if t_end - prev > _EPS:
+            out.append((prev, t_end, f"{rk[1]}#{rk[2]}"))
+            prev = t_end
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The backward walk.
+# ---------------------------------------------------------------------------
+
+
+def _covering(spans: List[dict], t: float) -> List[dict]:
+    return [s for s in spans if s["t0"] < t - _EPS and s["t1"] >= t - _EPS]
+
+
+def _prev_end(spans: List[dict], t: float) -> Optional[float]:
+    best = None
+    for s in spans:
+        if s["t1"] <= t - _EPS and (best is None or s["t1"] > best):
+            best = s["t1"]
+    return best
+
+
+def _walk_window(dag: dict, tracks: Dict[int, dict], w0: float, w1: float) -> dict:
+    """One window's critical path: backward chain from the latest span
+    end, segment attribution per the dominator taxonomy, cross-track
+    jumps at message keys and collective gates."""
+    spans = dag["spans"]
+    comp = {c: 0.0 for c in COMPONENTS}
+    by_rank: Dict[int, float] = defaultdict(float)
+    suspects: Dict[int, float] = defaultdict(float)
+    edges: List[dict] = []
+
+    def rank_of(tk: int) -> int:
+        return int(tracks[tk]["rank"]) if tk in tracks else int(tk % GEN_STRIDE)
+
+    # Window event index per track + the terminal (latest end).
+    win: Dict[int, List[dict]] = {}
+    term_tk, term_t = None, None
+    for tk, ss in spans.items():
+        sel = [s for s in ss if s["t1"] > w0 + _EPS and s["t0"] < w1 - _EPS]
+        if not sel:
+            continue
+        win[tk] = sel
+        end = min(max(s["t1"] for s in sel), w1)
+        if term_t is None or end > term_t:
+            term_tk, term_t = tk, end
+    if term_tk is None:
+        return {
+            "components": comp, "by_rank": {}, "suspects": {},
+            "edges": [], "path_s": 0.0,
+        }
+
+    def charge(tk: int, component: str, lo: float, hi: float,
+               suspect: Optional[int] = None) -> None:
+        d = hi - lo
+        if d <= _EPS:
+            return
+        comp[component] += d
+        r = suspect if suspect is not None else rank_of(tk)
+        by_rank[r] += d
+        if component == "straggler_wait":
+            suspects[r] += d
+
+    # Per-track segment boundaries: every span edge. A covering leaf is
+    # only charged down to the nearest boundary below the cursor — the
+    # walk must re-classify at each edge so sub-spans nested inside a
+    # collective (the quantize/wire/wait breakdown) each get their own
+    # segment instead of the enclosing span swallowing them.
+    bnds: Dict[int, List[float]] = {
+        k: sorted({b for s in ss for b in (s["t0"], s["t1"])})
+        for k, ss in win.items()
+    }
+
+    def below(tk: int, t: float) -> float:
+        best = w0
+        for b in bnds.get(tk, ()):
+            if b >= t - _EPS:
+                break
+            if b > best:
+                best = b
+        return best
+
+    tk, t = term_tk, term_t
+    for _ in range(_WALK_CAP):
+        if t <= w0 + _EPS:
+            break
+        cover = _covering(win.get(tk, []), t)
+        if not cover:
+            pe = _prev_end(win.get(tk, []), t)
+            lo = max(pe if pe is not None else w0, w0)
+            # Un-spanned gap on the critical track: the cluster waited
+            # on this rank doing nothing recorded.
+            charge(tk, "straggler_wait", lo, t, suspect=rank_of(tk))
+            t = lo
+            continue
+        leaf = min(cover, key=lambda s: s["t1"] - s["t0"])
+        lo = below(tk, t)
+        if leaf["cat"] == _CAT_WAIT:
+            src = dag["puts"].get(leaf["key"]) if leaf["key"] else None
+            if src is not None and src[0] != tk:
+                jump_t = min(src[1], t)
+                if jump_t > lo + _EPS:
+                    # Sender published late: the receiver's wait up to
+                    # the publish is the SENDER's time — jump tracks.
+                    charge(tk, "queue_wait", jump_t, t)
+                    edges.append({
+                        "kind": "msg", "key": leaf["key"],
+                        "src": rank_of(src[0]), "dst": rank_of(tk),
+                        "exposed_s": round(jump_t - max(leaf["t0"], w0), 6),
+                        "t": round(jump_t, 6),
+                    })
+                    tk, t = src[0], jump_t
+                    continue
+            gate = None
+            enclosing = [
+                c for c in cover
+                if c["cat"] == _CAT_COLLECTIVE and c["seq"] is not None
+            ]
+            if enclosing:
+                c0 = enclosing[0]
+                gate = dag["gates"].get(
+                    (int(c0.get("group") or 0), c0["name"], int(c0["seq"]))
+                )
+            if src is None and gate is not None and gate[0] != tk:
+                jump_t = min(gate[1], t)
+                if jump_t > lo + _EPS:
+                    # Keyless wait inside a gated collective: the last
+                    # entrant is the straggler holding this rank.
+                    charge(tk, "straggler_wait", jump_t, t,
+                           suspect=rank_of(gate[0]))
+                    edges.append({
+                        "kind": "collective",
+                        "key": f"{enclosing[0]['name']}"
+                               f"#{enclosing[0]['seq']}",
+                        "src": rank_of(gate[0]), "dst": rank_of(tk),
+                        "exposed_s": round(jump_t - max(leaf["t0"], w0), 6),
+                        "t": round(jump_t, 6),
+                    })
+                    tk, t = gate[0], jump_t
+                    continue
+            charge(tk, "queue_wait", lo, t)
+            t = lo
+            continue
+        charge(tk, _CAT_TO_COMPONENT.get(leaf["cat"], "compute"), lo, t)
+        t = lo
+    return {
+        "components": {c: round(v, 6) for c, v in comp.items()},
+        "by_rank": {r: round(v, 6) for r, v in sorted(by_rank.items())},
+        "suspects": {r: round(v, 6) for r, v in sorted(suspects.items())},
+        "edges": sorted(
+            edges, key=lambda e: e["exposed_s"], reverse=True
+        )[:8],
+        "path_s": round(sum(comp.values()), 6),
+    }
+
+
+def _dominant(step: dict) -> Tuple[str, Optional[int]]:
+    """(dominator label, dominant rank) of one step record: the largest
+    component — rendered ``wait:r<suspect>`` when stragglers dominate —
+    plus the rank carrying the most critical-path time."""
+    comp = step["components"]
+    by_rank = step["by_rank"]
+    if not by_rank or all(v <= 0.0 for v in comp.values()):
+        return "", None
+    dom_rank = max(by_rank, key=lambda r: by_rank[r])
+    name = max(comp, key=lambda c: comp[c])
+    if name == "straggler_wait" and step["suspects"]:
+        sus = max(step["suspects"], key=lambda r: step["suspects"][r])
+        return f"wait:r{sus}", int(dom_rank)
+    return name, int(dom_rank)
+
+
+def analyze_steps(
+    tracks: Dict[int, dict], offsets: Optional[Dict[int, float]] = None
+) -> List[dict]:
+    """Per-step critical-path records over loaded tracks."""
+    offsets = offsets if offsets is not None else estimate_offsets(tracks)
+    dag = _aligned(tracks, offsets)
+    out: List[dict] = []
+    for i, (w0, w1, label) in enumerate(_step_windows(dag)):
+        rec = _walk_window(dag, tracks, w0, w1)
+        rec["step"] = i
+        rec["label"] = label
+        rec["t0"] = round(w0, 6)
+        rec["t1"] = round(w1, 6)
+        rec["total_s"] = round(w1 - w0, 6)
+        dom, dom_rank = _dominant(rec)
+        rec["dominant"] = dom
+        rec["dominant_rank"] = dom_rank
+        out.append(rec)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Serving request flows (TTFT decomposition).
+# ---------------------------------------------------------------------------
+
+_PREFILL_NAMES = ("serve.prefill", "serve.prefill.local")
+_SHIP_NAMES = ("kv.ship", "serve.ingest")
+
+
+def _interval_union(iv: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    out: List[Tuple[float, float]] = []
+    for s, e in sorted(iv):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def _union_len(iv: List[Tuple[float, float]]) -> float:
+    return sum(e - s for s, e in iv)
+
+
+def analyze_requests(
+    tracks: Dict[int, dict], offsets: Optional[Dict[int, float]] = None
+) -> Dict[str, dict]:
+    """Per-request TTFT decomposition from ``req``-tagged spans:
+    ``admission`` (submit → first prefill start), ``prefill`` (prefill
+    span union), ``ship`` (page-stream activity not hidden under
+    prefill), ``decode`` (stream complete → first-token admission) and
+    ``other`` (the remainder — stall/failover windows)."""
+    offsets = offsets if offsets is not None else estimate_offsets(tracks)
+    dag = _aligned(tracks, offsets)
+    reqs: Dict[str, dict] = {}
+
+    def ent(rid: str) -> dict:
+        return reqs.setdefault(rid, {
+            "submit": None, "admit": None, "prefill": [], "ship": [],
+            "failovers": 0, "tracks": set(), "events": 0,
+        })
+
+    for tk, ii in dag["instants"].items():
+        for i in ii:
+            rid = i["req"]
+            if rid is None:
+                continue
+            e = ent(str(rid))
+            e["events"] += 1
+            e["tracks"].add(tk)
+            if i["name"] == "serve.submit":
+                e["submit"] = (
+                    i["t"] if e["submit"] is None else min(e["submit"], i["t"])
+                )
+            elif i["name"] == "serve.admit":
+                e["admit"] = (
+                    i["t"] if e["admit"] is None else min(e["admit"], i["t"])
+                )
+            elif i["name"] == "serve.failover":
+                e["failovers"] += 1
+            elif i["name"] == "kv.recv":
+                e["ship"].append((i["t"], i["t"]))
+    for tk, ss in dag["spans"].items():
+        for s in ss:
+            rid = s["req"]
+            if rid is None:
+                continue
+            e = ent(str(rid))
+            e["events"] += 1
+            e["tracks"].add(tk)
+            if s["name"] in _PREFILL_NAMES:
+                e["prefill"].append((s["t0"], s["t1"]))
+            elif s["name"] in _SHIP_NAMES:
+                e["ship"].append((s["t0"], s["t1"]))
+    out: Dict[str, dict] = {}
+    for rid, e in sorted(reqs.items()):
+        pf = _interval_union(e["prefill"])
+        sh = _interval_union(e["ship"])
+        submit, admit = e["submit"], e["admit"]
+        p0 = pf[0][0] if pf else None
+        stream_end = max(
+            [iv[1] for iv in pf] + [iv[1] for iv in sh], default=None
+        )
+        comp = {
+            "admission": 0.0, "prefill": 0.0, "ship": 0.0,
+            "decode": 0.0, "other": 0.0,
+        }
+        comp["prefill"] = round(_union_len(pf), 6)
+        # Exposed ship: page-stream activity not hidden under prefill.
+        exposed = 0.0
+        for s0, s1 in sh:
+            covered = 0.0
+            for q0, q1 in pf:
+                covered += max(0.0, min(s1, q1) - max(s0, q0))
+            exposed += max(0.0, (s1 - s0) - covered)
+        comp["ship"] = round(exposed, 6)
+        ttft = None
+        if submit is not None and admit is not None:
+            ttft = max(0.0, admit - submit)
+            if p0 is not None:
+                comp["admission"] = round(max(0.0, p0 - submit), 6)
+            if stream_end is not None:
+                comp["decode"] = round(max(0.0, admit - stream_end), 6)
+            comp["other"] = round(max(
+                0.0, ttft - sum(v for k, v in comp.items() if k != "other")
+            ), 6)
+        out[rid] = {
+            "ttft_s": round(ttft, 6) if ttft is not None else None,
+            "components": comp,
+            "failovers": e["failovers"],
+            "tracks": sorted(e["tracks"]),
+            "events": e["events"],
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The memoized directory entry point.
+# ---------------------------------------------------------------------------
+
+# Per-directory analysis memo keyed by the span files' stat signature —
+# a changed/grown file can never serve a stale analysis; recovery
+# reconfiguration clears it outright via invalidate_critpath_cache
+# (reached from supervisor.invalidate_trace_caches).
+_ANALYSIS_CACHE: "OrderedDict[Tuple[Any, ...], dict]" = OrderedDict()
+_ANALYSIS_CACHE_MAX = 4
+
+
+def _dir_signature(directory: str, cap: int) -> Tuple[Any, ...]:
+    sig: List[Tuple[str, int, int]] = []
+    for p in sorted(glob.glob(os.path.join(directory, "spans-rank*.jsonl"))):
+        try:
+            st = os.stat(p)
+        except OSError:
+            continue
+        sig.append((os.path.basename(p), st.st_mtime_ns, st.st_size))
+    return (os.path.abspath(directory), cap, tuple(sig))
+
+
+def invalidate_critpath_cache(reason: str = "") -> None:
+    """Drop the per-directory analysis memo (recovery reconfiguration:
+    post-recovery spans are a new stream at a bumped generation — a
+    cached DAG would attribute the fresh world against dead tracks)."""
+    _ANALYSIS_CACHE.clear()
+    metrics.add("cgx.critpath.cache_invalidations")
+
+
+def analyze(
+    directory: str,
+    max_bytes_per_file: Optional[int] = None,
+    use_cache: bool = True,
+) -> dict:
+    """The full report for one metrics dir: per-step critical paths,
+    the dominator histogram, the slowest cross-rank edges, and the
+    serving request decompositions. Memoized on the span files' stat
+    signature."""
+    cap = max_bytes_per_file or _max_read_bytes()
+    key = _dir_signature(directory, cap) if use_cache else None
+    if key is not None:
+        hit = _ANALYSIS_CACHE.get(key)
+        if hit is not None:
+            _ANALYSIS_CACHE.move_to_end(key)
+            metrics.add("cgx.critpath.cache_hits")
+            return hit
+    tracks = load_tracks(directory, cap)
+    offsets = estimate_offsets(tracks)
+    steps = analyze_steps(tracks, offsets)
+    requests = analyze_requests(tracks, offsets)
+    hist: Dict[str, int] = defaultdict(int)
+    for s in steps:
+        if s["dominant"]:
+            hist[s["dominant"]] += 1
+    edges = sorted(
+        (e for s in steps for e in s["edges"]),
+        key=lambda e: e["exposed_s"], reverse=True,
+    )[:8]
+    report = {
+        "directory": os.path.abspath(directory),
+        "tracks": [
+            {
+                "key": k, "rank": t["rank"], "generation": t["generation"],
+                "events": len(t["events"]), "truncated": t["truncated"],
+            }
+            for k, t in sorted(tracks.items())
+        ],
+        "clock_offsets_s": {str(k): round(o, 6) for k, o in offsets.items()},
+        "steps": steps,
+        "dominators": dict(sorted(hist.items())),
+        "edges": edges,
+        "requests": requests,
+    }
+    metrics.add("cgx.critpath.analyses")
+    metrics.set("cgx.critpath.steps", float(len(steps)))
+    if steps:
+        last = steps[-1]
+        for c, v in last["components"].items():
+            metrics.set(f"cgx.critpath.component.{c}", float(v))
+        if last["dominant_rank"] is not None:
+            metrics.set(
+                "cgx.critpath.dominant_rank", float(last["dominant_rank"])
+            )
+    if key is not None:
+        _ANALYSIS_CACHE[key] = report
+        while len(_ANALYSIS_CACHE) > _ANALYSIS_CACHE_MAX:
+            _ANALYSIS_CACHE.popitem(last=False)
+    return report
+
+
+def live_dominator(
+    directory: str, max_bytes_per_file: int = 1 << 18
+) -> str:
+    """The last analyzed window's dominator label (``compute`` /
+    ``wire`` / ``wait:r<rank>`` / "") over tail-bounded reads — the
+    cheap form ``cgx_top``'s ``crit`` column polls."""
+    try:
+        tracks = load_tracks(directory, max_bytes_per_file)
+        steps = analyze_steps(tracks)
+    except Exception:
+        return ""
+    for s in reversed(steps):
+        if s["dominant"]:
+            return s["dominant"]
+    return ""

@@ -74,6 +74,19 @@ ASYNC_LAG = "async_lag"
 # cooldown anchor).
 PREEMPT_NOTICE = "preempt_notice"
 MEMBERSHIP = "membership"
+# Planner drift (PR 17): a measured critical-path component (compute /
+# quantize / wire / queue) is sustainedly off the plan's solve-time
+# prediction — the PlanDriftMonitor's signal that the CostModel no
+# longer describes this fabric and the planner must re-calibrate.
+PLAN_DRIFT = "plan_drift"
+
+# The closed kind registry (lint's health-event-kinds rule cross-checks
+# every HealthEvent construction site against this tuple; the
+# docs/OBSERVABILITY.md event table mirrors it).
+EVENT_KINDS = (
+    STRAGGLER, STEP_REGRESSION, QERR_SLO, ARENA_PRESSURE, ASYNC_LAG,
+    PREEMPT_NOTICE, MEMBERSHIP, PLAN_DRIFT,
+)
 
 # Wait-signal floor: peer skew is judged relative to the median peer, but
 # a baseline of ~0 (healthy peers answer in microseconds) would make any
@@ -316,6 +329,25 @@ class HealthEngine:
             kind=ASYNC_LAG, rank=self.rank, value=round(float(lag), 6),
             threshold=float(threshold), suspect=int(suspect),
             detail=(("lag_rounds", float(lag)),),
+            ts=round(time.time(), 6),
+            t_mono=round(time.perf_counter(), 6),
+        )
+        return ev if self._emit(ev) else None
+
+    def note_plan_drift(
+        self, ratio: float, threshold: float, component: str = "",
+        **detail,
+    ) -> Optional[HealthEvent]:
+        """Drift-loop hook: a measured critical-path component is
+        ``ratio``x the plan's solve-time prediction. No sustain window
+        here — the ``PlanDriftMonitor`` already holds its own (it sees
+        every comparison; the engine only sees crossings) — but the
+        per-(kind, suspect) cooldown applies, so a persistently
+        mis-modeled link is one event stream, not one event per step."""
+        ev = HealthEvent(
+            kind=PLAN_DRIFT, rank=self.rank, value=round(float(ratio), 6),
+            threshold=float(threshold), suspect=None,
+            detail=(("component", component),) + tuple(detail.items()),
             ts=round(time.time(), 6),
             t_mono=round(time.perf_counter(), 6),
         )
@@ -857,6 +889,18 @@ def note_async_lag(
     return eng.note_async_lag(suspect, lag, threshold)
 
 
+def note_plan_drift(
+    ratio: float, threshold: float, component: str = "", **detail
+) -> Optional["HealthEvent"]:
+    """Drift-loop hook: report a sustained predicted-vs-measured
+    component gap (no-op when the engine is off — the monitor's
+    re-calibration poke does not depend on the event plane)."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.note_plan_drift(ratio, threshold, component, **detail)
+
+
 def forget_peers() -> None:
     """Drop per-peer wait state on the running engine (no-op when off) —
     called by ``supervisor.invalidate_trace_caches`` on recovery
@@ -864,3 +908,119 @@ def forget_peers() -> None:
     eng = _engine
     if eng is not None:
         eng.forget_peers()
+
+
+# ---------------------------------------------------------------------------
+# Plan-drift monitor (ISSUE 17): the critical-path feedback loop.
+# ---------------------------------------------------------------------------
+
+
+class PlanDriftMonitor:
+    """Compares a plan's solve-time component predictions
+    (``StepPlan.pred_components`` / the ``cgx.plan.pred_component.*``
+    gauges) against measured critical-path components
+    (``observability.critpath`` step analyses / the
+    ``cgx.critpath.component.*`` gauges). Past a sustained
+    ``factor``x gap on any comparable component it emits ONE
+    ``plan_drift`` HealthEvent (engine cooldown keeps the stream to one
+    event per window) and pokes the planner's idempotent re-calibration
+    (``StepPlanner.update`` — adopt-on-change, so a poke that finds the
+    model already right is a no-op).
+
+    Engine-independence: with ``CGX_HEALTH`` unset the event is skipped
+    but the gauges and the re-calibration poke still run — closing the
+    loop must not require the event plane."""
+
+    # Components whose predicted/measured pairing is meaningful; the
+    # measured queue-wait maps onto the predicted per-chunk overhead.
+    COMPONENT_MAP = {
+        "compute": "compute",
+        "quantize": "quantize",
+        "wire": "wire",
+        "overhead": "queue_wait",
+    }
+    # Predictions under this are noise, not a baseline (a ratio against
+    # ~0 would make any measurement an infinite drift).
+    _PRED_FLOOR_S = 1e-6
+
+    def __init__(
+        self,
+        planner=None,
+        *,
+        factor: Optional[float] = None,
+        sustain: int = _SUSTAIN,
+    ):
+        self.planner = planner
+        self.factor = (
+            factor if factor is not None else cfg.health_plan_drift_factor()
+        )
+        self.sustain = max(1, int(sustain))
+        self._n = 0
+        self.events: List[HealthEvent] = []
+        self.replans = 0
+
+    def ratios(
+        self, predicted: Dict[str, float], measured: Dict[str, float]
+    ) -> Dict[str, float]:
+        """measured/predicted per comparable component (gauged under
+        ``cgx.critpath.drift.<component>`` every call)."""
+        out: Dict[str, float] = {}
+        for pred_key, meas_key in self.COMPONENT_MAP.items():
+            p = float(predicted.get(pred_key, 0.0) or 0.0)
+            m = measured.get(meas_key)
+            if p < self._PRED_FLOOR_S or m is None:
+                continue
+            r = float(m) / p
+            out[pred_key] = r
+            metrics.set(f"cgx.critpath.drift.{pred_key}", round(r, 4))
+        return out
+
+    def observe(
+        self,
+        predicted: Dict[str, float],
+        measured: Dict[str, float],
+    ) -> Optional[HealthEvent]:
+        """One comparison (typically once per analyzed step window).
+        Returns the ``plan_drift`` event when this observation crossed
+        the sustained threshold, None otherwise."""
+        ratios = self.ratios(predicted, measured)
+        if not ratios:
+            return None
+        worst_comp, worst = max(ratios.items(), key=lambda kv: kv[1])
+        firing = worst >= self.factor
+        self._n = self._n + 1 if firing else 0
+        if self._n < self.sustain:
+            return None
+        self._n = 0
+        metrics.add("cgx.critpath.drift_trips")
+        ev = note_plan_drift(
+            worst, self.factor, component=worst_comp,
+            ratios=tuple(sorted((k, round(v, 4)) for k, v in ratios.items())),
+        )
+        if ev is not None:
+            self.events.append(ev)
+            del self.events[:-16]
+        if self.planner is not None:
+            try:
+                if self.planner.update():
+                    self.replans += 1
+            except Exception as e:  # the poke must not kill the caller
+                log.warning("plan-drift re-calibration poke failed: %s", e)
+        return ev
+
+    def poll(self) -> Optional[HealthEvent]:
+        """Gauge-driven comparison: read the plan's
+        ``cgx.plan.pred_component.*`` gauges and the engine's
+        ``cgx.critpath.component.*`` gauges (both already maintained by
+        their writers) — the zero-argument form background consumers
+        call."""
+        predicted = {
+            k: float(metrics.get(f"cgx.plan.pred_component.{k}"))
+            for k in self.COMPONENT_MAP
+        }
+        measured = {
+            v: float(metrics.get(f"cgx.critpath.component.{v}"))
+            for v in self.COMPONENT_MAP.values()
+        }
+        measured = {k: v for k, v in measured.items() if v > 0.0}
+        return self.observe(predicted, measured)

@@ -91,6 +91,12 @@ class Timeline:
         # run, so mixed-run files would cross-link unrelated
         # collectives in the merger); later flushes append.
         self._owned_paths: set = set()
+        # Last generation written into each path's meta header: flush
+        # re-emits the header when the recovery generation moved so the
+        # merger can split the file into per-(rank, generation) tracks
+        # (a rejoined rank's spans must not conflate with the dead
+        # generation's on one track).
+        self._meta_gen: Dict[str, int] = {}
 
     # -- gating -----------------------------------------------------------
 
@@ -213,24 +219,42 @@ class Timeline:
             with self._flush_lock:
                 first = path not in self._owned_paths
                 self._owned_paths.add(path)
+                gen = self._generation()
+                reheader = first or self._meta_gen.get(path) != gen
                 # cgx-analysis: allow(lock-blocking) — the flush lock exists precisely to serialize this append (truncate-vs-append races); event writers never take it
                 with open(path, "w" if first else "a") as f:
-                    if first:
-                        f.write(json.dumps(self._meta()) + "\n")
+                    if reheader:
+                        f.write(json.dumps(self._meta(gen)) + "\n")
+                        self._meta_gen[path] = gen
                     for ev in buf:
                         f.write(json.dumps(ev) + "\n")
         except Exception as e:
             log.warning("timeline flush to %s failed: %s", path, e)
 
-    def _meta(self) -> Dict[str, Any]:
-        """File header: the rank's identity and its mono→wall mapping —
-        the merger's *fallback* alignment when no cross-rank message
-        pairs exist (the primary alignment never trusts wall clocks)."""
+    @staticmethod
+    def _generation() -> int:
+        """Current recovery generation (the backend's
+        ``cgx.recovery.generation`` gauge; 0 before any recovery)."""
+        try:
+            from ..utils.logging import metrics
+
+            return int(metrics.get("cgx.recovery.generation"))
+        except Exception:
+            return 0
+
+    def _meta(self, generation: Optional[int] = None) -> Dict[str, Any]:
+        """File header: the rank's identity, recovery generation, and
+        its mono→wall mapping — the merger's *fallback* alignment when
+        no cross-rank message pairs exist (the primary alignment never
+        trusts wall clocks)."""
         t_mono = time.perf_counter()
         t_wall = time.time()
         return {
             "kind": "meta",
             "rank": self._effective_rank(),
+            "generation": (
+                self._generation() if generation is None else int(generation)
+            ),
             "pid": os.getpid(),
             "t_mono": round(t_mono, 7),
             "t_wall": round(t_wall, 6),

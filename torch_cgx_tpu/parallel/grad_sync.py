@@ -882,6 +882,13 @@ def make_train_step(
             dt = t_now - prev
             metrics.observe("cgx.step.time_s", dt)
             health_mod.note_step(dt)
+            # Step boundary marker for the critical-path engine: window
+            # segmentation prefers these over collective-round ends.
+            from ..observability import timeline as timeline_mod
+
+            timeline_mod.instant(
+                "step", cat=timeline_mod.CAT_TRACE, dt_s=round(dt, 6)
+            )
         metrics.add("cgx.step.count")
 
     def _apply_outer(step_idx, params):

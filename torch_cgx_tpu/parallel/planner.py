@@ -329,6 +329,44 @@ class CostModel:
         exposed = (t_codec + t_wire - bottleneck) / c
         return bottleneck + exposed + c * self.chunk_overhead_s
 
+    def predict_slice_components(
+        self,
+        n: int,
+        ws: int,
+        bits: int,
+        bucket: int,
+        chunks: int = 1,
+        route: str = "staged",
+    ) -> Dict[str, float]:
+        """:meth:`predict_slice`'s decomposition, for the critical-path
+        drift loop (ISSUE 17): ``{"quantize", "wire", "overhead"}``
+        seconds summing exactly to the scalar prediction. The pipelined
+        exposure is charged to the NON-bottleneck stage (that is the
+        stage whose time amortizes as ``1/chunks``); the bottleneck
+        stage keeps its full cost. ``PlanDriftMonitor`` compares these
+        against the measured critical-path components, so a mis-modeled
+        rate names the component that drifted, not just "the step"."""
+        del route
+        n = int(n)
+        ws = max(1, int(ws))
+        if n <= 0 or ws == 1:
+            return {"quantize": 0.0, "wire": 0.0, "overhead": 0.0}
+        compressed = 1 <= bits <= cfg_mod.MAX_BITS
+        t_codec = 0.0
+        if compressed:
+            t_codec = (
+                4.0 * n * (1 + 1 / ws) / (self.quantize_gbps * 1e9)
+                + 4.0 * n * (2 - 1 / ws) / (self.dequantize_gbps * 1e9)
+            )
+        factor = 2.0 * (ws - 1) / ws
+        t_wire = factor * self.wire_bytes(n, bits, bucket) / (self.wire_gbps * 1e9)
+        c = max(1, int(chunks))
+        if t_codec >= t_wire:
+            q, w = t_codec, t_wire / c
+        else:
+            q, w = t_codec / c, t_wire
+        return {"quantize": q, "wire": w, "overhead": c * self.chunk_overhead_s}
+
     # -- persistence (the CGX_PLANNER_MODEL group-consistency channel) --
 
     def as_dict(self) -> Dict:
@@ -625,12 +663,22 @@ class SliceDecision:
 class StepPlan:
     """One train step's compiled plan: per-(group, fusion-slice)
     decisions in layout order, the group emission order, and the model's
-    step-time prediction (collective portion)."""
+    step-time prediction (collective portion).
+
+    ``pred_components`` is the prediction's decomposition recorded at
+    solve time — ``(("compute", s), ("overhead", s), ("quantize", s),
+    ("wire", s))`` — the per-phase baseline the critical-path drift
+    loop (``health.PlanDriftMonitor``) compares measured components
+    against."""
 
     decisions: Tuple[Tuple[SliceDecision, ...], ...]
     order: Tuple[int, ...]
     predicted_s: float
     version: int
+    pred_components: Tuple[Tuple[str, float], ...] = ()
+
+    def components(self) -> Dict[str, float]:
+        return dict(self.pred_components)
 
 
 def chunk_candidates(n: int, ws: int, bucket: int) -> Tuple[int, ...]:
@@ -880,17 +928,31 @@ def plan_for_layout(
     predicted = model.predict_step(
         [d.predicted_s for d in decs], reverse_order=True
     )
+    # Per-phase decomposition at solve time: the predicted baseline the
+    # PlanDriftMonitor holds measured critical-path components against.
+    comp_tot = {"quantize": 0.0, "wire": 0.0, "overhead": 0.0}
+    for (n_el, cc), d in zip(flat, decs):
+        parts = model.predict_slice_components(
+            d.n, ws, d.bits, cc.bucket_size, chunks=d.chunks, route=route
+        )
+        for k, v in parts.items():
+            comp_tot[k] += v
+    comp_tot["compute"] = float(model.compute_s)
+    pred_components = tuple(sorted(comp_tot.items()))
     plan = StepPlan(
         decisions=tuple(per_group),
         order=order,
         predicted_s=predicted,
         version=_PLAN_VERSION,
+        pred_components=pred_components,
     )
     _PLAN_CACHE[key] = plan
     if len(_PLAN_CACHE) > _PLAN_CACHE_MAX:
         _PLAN_CACHE.popitem(last=False)
     metrics.add("cgx.plan.compiled")
     metrics.set("cgx.plan.predicted_step_s", float(predicted))
+    for comp, secs in pred_components:
+        metrics.set(f"cgx.plan.pred_component.{comp}", float(secs))
     for gi, gdecs in enumerate(per_group):
         for si, d in enumerate(gdecs):
             label = f"g{gi}s{si}"
@@ -904,6 +966,9 @@ def plan_for_layout(
         ws=int(ws),
         route=route,
         predicted_ms=round(predicted * 1e3, 3),
+        pred_components={
+            k: round(v * 1e3, 4) for k, v in pred_components
+        },
         version=_PLAN_VERSION,
         model=cost_model().source,
         decisions=[

@@ -187,6 +187,12 @@ def invalidate_trace_caches() -> None:
     async_plane = sys.modules.get("torch_cgx_tpu.parallel.async_plane")
     if async_plane is not None:
         async_plane.reset_planes("recovery reconfigure")
+    # Critical-path analysis memo (ISSUE 17): a cached DAG attributes
+    # against the dead generation's tracks — post-recovery spans land at
+    # a bumped generation tag and must re-analyze from scratch.
+    critpath = sys.modules.get("torch_cgx_tpu.observability.critpath")
+    if critpath is not None:
+        critpath.invalidate_critpath_cache("recovery reconfigure")
     metrics.add("cgx.recovery.trace_cache_invalidations")
 
 

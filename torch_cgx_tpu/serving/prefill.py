@@ -119,8 +119,16 @@ class PrefillWorker:
                     vals.tobytes(),
                 )
         metrics.add("cgx.serve.prefills_shipped")
-        metrics.observe(
-            "cgx.serve.prefill_s", time.perf_counter() - t0
+        t1 = time.perf_counter()
+        metrics.observe("cgx.serve.prefill_s", t1 - t0)
+        # Request-tagged prefill span (ISSUE 17): the critical-path
+        # engine's TTFT decomposition joins it to the kv.ship stream
+        # and the scheduler's submit/admit instants by ``req``.
+        from ..observability import timeline
+
+        timeline.record(
+            "serve.prefill", timeline.CAT_SPAN, t0, t1 - t0,
+            req=str(request_id), frames=frames, prompt_tokens=int(s),
         )
         return frames
 

@@ -49,6 +49,7 @@ from ..models.attention import decode_attention, dense_attention
 from ..models.gpt2 import GPT2Config
 from ..ops import codec_host
 from ..ops import paged_kv
+from ..observability import timeline
 from ..utils.logging import get_logger, metrics
 from ..wire import dispatch as wire_dispatch
 from . import kv_cache as kv_mod
@@ -646,6 +647,13 @@ class ContinuousBatchScheduler:
         otherwise the scheduler prefills locally at admission."""
         req.submitted_at = time.monotonic()
         metrics.add("cgx.serve.requests_submitted")
+        # Request attribution anchor (ISSUE 17): the critical-path
+        # engine's TTFT decomposition starts every request at this
+        # instant and joins the rest of the flow by ``req``.
+        timeline.instant(
+            "serve.submit", cat=timeline.CAT_TRACE, req=req.id,
+            remote=bool(remote),
+        )
         if remote:
             if self._receiver is None:
                 raise ValueError(
@@ -709,7 +717,11 @@ class ContinuousBatchScheduler:
             meta = self._receiver.meta(stream) or {}
             self._receiver.drop_stream(stream)
             try:
-                self._ingest_stream(req, meta, frames)
+                with timeline.span(
+                    "serve.ingest", cat=timeline.CAT_SPAN, req=req.id,
+                    frames=len(frames),
+                ):
+                    self._ingest_stream(req, meta, frames)
             except Exception as e:
                 metrics.add("cgx.serve.ingest_errors")
                 log.warning(
@@ -735,6 +747,9 @@ class ContinuousBatchScheduler:
             self._frames.pop(stream, None)
             self._receiver.drop_stream(stream)
             metrics.add("cgx.serve.prefill_failovers")
+            timeline.instant(
+                "serve.failover", cat=timeline.CAT_TRACE, req=stream,
+            )
             from ..observability import flightrec
 
             flightrec.record(
@@ -917,10 +932,13 @@ class ContinuousBatchScheduler:
                 tail_v[layer, :tail_len] = np.asarray(
                     vs[layer][0, n_full * pt: s]
                 )
-        metrics.observe(
-            "cgx.serve.prefill_s", time.perf_counter() - t0
-        )
+        t1 = time.perf_counter()
+        metrics.observe("cgx.serve.prefill_s", t1 - t0)
         metrics.add("cgx.serve.local_prefills")
+        timeline.record(
+            "serve.prefill.local", timeline.CAT_SPAN, t0, t1 - t0,
+            req=req.id, prompt_tokens=int(s),
+        )
         return _Ready(
             req=req, page_ids=pids, tail_k=tail_k, tail_v=tail_v,
             tail_len=tail_len, first_token=int(first[0]), pos=s,
@@ -991,10 +1009,13 @@ class ContinuousBatchScheduler:
         now = time.monotonic()
         req.output.append(ready.first_token)
         req.first_token_at = now
-        metrics.observe(
-            "cgx.serve.ttft_ms", (now - req.submitted_at) * 1e3
-        )
+        ttft_ms = (now - req.submitted_at) * 1e3
+        metrics.observe("cgx.serve.ttft_ms", ttft_ms)
         metrics.add("cgx.serve.requests_admitted")
+        timeline.instant(
+            "serve.admit", cat=timeline.CAT_TRACE, req=req.id,
+            lane=int(lane), ttft_ms=round(ttft_ms, 3),
+        )
         self._note_tokens(1)
         if len(req.output) >= req.max_new_tokens or (
             sv.eos_token is not None and ready.first_token == sv.eos_token

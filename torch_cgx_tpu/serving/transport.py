@@ -43,6 +43,7 @@ import zlib
 from typing import Dict, List, Optional
 
 from .. import config as cfg_mod
+from ..observability import timeline
 from ..utils.logging import get_logger, metrics
 
 log = get_logger()
@@ -215,6 +216,13 @@ class KvPageSender:
     # -- producer side -----------------------------------------------------
 
     def post_meta(self, meta: Dict) -> None:
+        # End-to-end request attribution (ISSUE 17): the stream name IS
+        # the request id scheduler-side — stamp it into the META frame
+        # so the decode side (and the critical-path engine) can join
+        # the wire stream back to the request without the scheduler's
+        # stream registry.
+        if "request_id" not in meta:
+            meta = dict(meta, request_id=self.stream)
         self._post(meta_frame(meta, checksum=self._checksum))
 
     def post_page(
@@ -296,6 +304,16 @@ class KvPageSender:
                     self._stop.wait(_SHIP_BACKOFF_S * (1 << attempt))
 
     def _ship(self, seq: int, buf: bytes) -> None:
+        t0 = time.perf_counter()
+        self._ship_inner(seq, buf)
+        # Request-tagged wire span: the critical-path engine's TTFT
+        # decomposition reads page-ship exposure from these.
+        timeline.record(
+            "kv.ship", timeline.CAT_WIRE, t0, time.perf_counter() - t0,
+            key=self._payload_key(seq), req=self.stream, bytes=len(buf),
+        )
+
+    def _ship_inner(self, seq: int, buf: bytes) -> None:
         if self._throttle is not None:
             # Modeled link bandwidth (bench.py --serve): a frame costs
             # its own bytes' worth of wall time ON THE SHARED LINK
@@ -467,6 +485,11 @@ class KvPageReceiver:
                     )
                     break
                 st.received += 1
+                timeline.instant(
+                    "kv.recv", cat=timeline.CAT_WIRE,
+                    key=f"cgxkv/{stream}/{seq}", req=stream,
+                    bytes=len(buf),
+                )
                 metrics.add("cgx.serve.frames_received")
                 if st.expected is not None and st.received >= st.expected:
                     st.done = True
