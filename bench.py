@@ -82,6 +82,18 @@ def log_jsonl(record: dict) -> None:
             rec["metrics"] = snap
     except Exception:
         pass
+    # Memory trajectory (ISSUE 18): the ledger's peak-bytes high-water
+    # rides on every record when CGX_MEMLEDGER is on, so bench_gate can
+    # fail a memory regression exactly like a throughput regression
+    # (the <metric>:peak_mb trajectory). None/off = no key, no gate.
+    try:
+        from torch_cgx_tpu.observability import memledger as _memledger
+
+        pk = _memledger.peak_mb()
+        if pk is not None and pk > 0 and "peak_mb" not in rec:
+            rec["peak_mb"] = pk
+    except Exception:
+        pass
     # NOT setdefault: its default argument evaluates eagerly, which would
     # probe jax.devices() even when the caller pre-filled the keys (the
     # watchdog must never touch the backend).

@@ -33,6 +33,7 @@ from tools import analysis  # noqa: E402
 from tools.analysis import caches as caches_pass  # noqa: E402
 from tools.analysis import knobs as knobs_pass  # noqa: E402
 from tools.analysis import locks as locks_pass  # noqa: E402
+from tools.analysis import mempairs as mempairs_pass  # noqa: E402
 from tools.analysis.graph import Project, get_source  # noqa: E402
 
 
@@ -811,3 +812,123 @@ def test_xla_trace_fingerprint_covers_pr11_kernel_knobs(monkeypatch):
     monkeypatch.delenv("CGX_PALLAS_DB")
     monkeypatch.setenv("CGX_PALLAS_TILE_CHUNKS", "2")
     assert xr._trace_env_fingerprint() != base
+
+
+# ---------------------------------------------------------------------------
+# mem-ledger-pairing: alloc/release hook pairing (ISSUE 18).
+# ---------------------------------------------------------------------------
+
+
+def _mem_findings(tmp_path, files):
+    return mempairs_pass.check(Project(make_pkg(tmp_path, files)))
+
+
+def test_mem_pairing_flags_unpaired_and_nonliteral_sites(tmp_path):
+    found = _mem_findings(tmp_path, {
+        "pool.py": (
+            "from obs import memledger\n\n\n"
+            "def grab():\n"
+            "    memledger.note_alloc('pool.orphan', 1, nbytes=4096)\n\n\n"
+            "def drop():\n"
+            "    memledger.note_release('pool.ghost', 1)\n\n\n"
+            "def tagged(owner):\n"
+            "    memledger.note_alloc(owner, 1)\n"
+        ),
+    })
+    rules = sorted(f.rule for f in found)
+    assert rules == ["mem-ledger-pairing"] * 3, [f.render() for f in found]
+    msgs = " | ".join(f.message for f in found)
+    assert "'pool.orphan'" in msgs and "never released" in msgs
+    assert "'pool.ghost'" in msgs and "never allocated" in msgs
+    assert "not a string literal" in msgs
+
+
+def test_mem_pairing_clean_twins(tmp_path):
+    # Three legitimate shapes: a label paired across modules, an
+    # alloc-only label whose module tears down through reset_ledger,
+    # and a pragma'd deliberately one-sided site.
+    found = _mem_findings(tmp_path, {
+        "writer.py": (
+            "from obs import memledger\n\n\n"
+            "def grab():\n"
+            "    memledger.note_alloc('ring.page', 1)\n"
+        ),
+        "reaper.py": (
+            "from obs import memledger\n\n\n"
+            "def reap():\n"
+            "    memledger.note_release('ring.page', 1)\n"
+        ),
+        "cachemod.py": (
+            "from obs import memledger\n\n\n"
+            "def fill():\n"
+            "    memledger.note_alloc('cache.slot', 1)\n\n\n"
+            "def invalidate():\n"
+            "    memledger.reset_ledger('cachemod invalidate')\n"
+        ),
+        "bridge.py": (
+            "from obs import memledger\n\n\n"
+            "def handoff():\n"
+            "    # cgx-analysis: allow(mem-ledger-pairing) — released by "
+            "the peer package's reaper\n"
+            "    memledger.note_alloc('bridge.slab', 1)\n"
+        ),
+    })
+    assert found == [], [f.render() for f in found]
+
+
+def test_mem_pairing_one_mutation_away_fires(tmp_path):
+    # The acceptance mutation: delete the release and the clean twin
+    # produces exactly one finding, at the alloc site.
+    files = {
+        "pool.py": (
+            "from obs import memledger\n\n\n"
+            "def grab():\n"
+            "    memledger.note_alloc('kv.page', 1)\n\n\n"
+            "def drop():\n"
+            "    memledger.note_release('kv.page', 1)\n"
+        ),
+    }
+    assert _mem_findings(tmp_path, files) == []
+    files["pool.py"] = files["pool.py"].replace(
+        "    memledger.note_release('kv.page', 1)\n", "    pass\n")
+    found = _mem_findings(tmp_path, files)
+    assert len(found) == 1 and found[0].rule == "mem-ledger-pairing"
+    assert found[0].line == 5 and "'kv.page'" in found[0].message
+
+
+def test_mem_pairing_ledger_module_and_method_forms(tmp_path):
+    # memledger.py itself is exempt (its shims forward parameter
+    # labels); direct register_alloc/register_release method calls and
+    # a ledger-ish ``.reset()`` receiver participate like the shims.
+    found = _mem_findings(tmp_path, {
+        "memledger.py": (
+            "def note_alloc(owner, n=1, nbytes=0):\n"
+            "    _ledger.register_alloc(owner, n, nbytes)\n"
+        ),
+        "direct.py": (
+            "def grab(led):\n"
+            "    led.register_alloc('direct.buf', 1)\n\n\n"
+            "def settle(led):\n"
+            "    led.register_release('direct.buf', 1)\n"
+        ),
+        "resetter.py": (
+            "def fill(mem_ledger):\n"
+            "    mem_ledger.register_alloc('reset.paired', 1)\n\n\n"
+            "def teardown(mem_ledger):\n"
+            "    mem_ledger.reset('teardown')\n"
+        ),
+    })
+    assert found == [], [f.render() for f in found]
+
+
+def test_mem_pairing_registered_in_default_sweep(tmp_path):
+    assert "mem-ledger-pairing" in analysis.WHOLE_PROGRAM_PASSES
+    root = make_pkg(tmp_path, {
+        "leaky.py": (
+            "from obs import memledger\n\n\n"
+            "def grab():\n"
+            "    memledger.note_alloc('sweep.orphan', 1)\n"
+        ),
+    })
+    found = analysis.run_project(root, passes=["mem-ledger-pairing"])
+    assert [f.rule for f in found] == ["mem-ledger-pairing"]

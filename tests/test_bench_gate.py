@@ -371,3 +371,50 @@ def test_pred_ratio_regression_fails_the_gate():
              "backend": "host", "chip": "host"}]
     regressions, _checks = bg.gate(cand, baselines, 30.0)
     assert len(regressions) == 1
+
+
+def test_peak_mb_normalizes_inverse_and_gates_lower_better():
+    # ISSUE 18 satellite: records carrying the memory ledger's peak_mb
+    # gate an INVERSE (1/MB) trajectory, so a footprint growth fails
+    # exactly like a throughput cliff.
+    bg = _load_gate()
+    rec = {"metric": "bench_4bit_512MB", "value": 10.0, "peak_mb": 256.0,
+           "backend": "tpu", "chip": "v5e"}
+    key, v = bg.normalize_peak_mb(rec)
+    assert key == "bench_4bit_512MB:peak_mb"
+    assert v == pytest.approx(1.0 / 256.0)
+    # present in the full normalization fan-out
+    assert (key, v) in bg.normalize_all(rec)
+    # ledger off (no key), bogus values, unresolved rows: no trajectory
+    assert bg.normalize_peak_mb({"metric": "m", "value": 1.0}) is None
+    assert bg.normalize_peak_mb({"metric": "m", "peak_mb": 0}) is None
+    assert bg.normalize_peak_mb({"metric": "m", "peak_mb": True}) is None
+    assert bg.normalize_peak_mb(
+        {"metric": "m", "peak_mb": 9.0, "unresolved": True}) is None
+    # placeholder rows stay in their own @cpu trajectory
+    ph = {"metric": "bench_4bit_512MB", "peak_mb": 256.0,
+          "backend": "tpu", "chip": "cpu"}
+    key_ph, _ = bg.normalize_peak_mb(ph)
+    assert key_ph.endswith("@cpu")
+
+
+def test_peak_mb_growth_fails_the_gate():
+    bg = _load_gate()
+    history = [
+        {"metric": "bench_4bit_512MB", "value": 10.0, "peak_mb": mb,
+         "backend": "host", "chip": "host"}
+        for mb in (250.0, 256.0, 260.0)
+    ]
+    baselines = bg.build_baselines(history)
+    assert baselines["bench_4bit_512MB:peak_mb"] == \
+        pytest.approx(1.0 / 256.0)
+    # a 2x memory growth (inverse halves) fails, named
+    cand = [{"metric": "bench_4bit_512MB", "value": 10.0, "peak_mb": 512.0,
+             "backend": "host", "chip": "host"}]
+    regressions, _checks = bg.gate(cand, baselines, 30.0)
+    assert [r["metric"] for r in regressions] == \
+        ["bench_4bit_512MB:peak_mb"]
+    # a shrink (inverse grows) passes
+    cand[0]["peak_mb"] = 128.0
+    regressions, _checks = bg.gate(cand, baselines, 30.0)
+    assert regressions == []

@@ -99,6 +99,20 @@ def test_flap_delay_helper_fires_on_its_step():
     assert metrics.get("cgx.faults.flap") == 1
 
 
+def test_fault_grammar_leak_page():
+    # ISSUE 18 satellite: the memory plane's chaos fault — a KV page
+    # whose last reference drops never reaches the free list. Prob and
+    # step gates both parse; no extra fields are required (the fault IS
+    # the suppressed release).
+    (s,) = parse_faults("leak_page:1.0")
+    assert s.mode == "leak_page" and s.prob == 1.0
+    (s,) = parse_faults("leak_page:step=4")
+    assert s.step == 4
+    inj = faults.FaultInjector(parse_faults("leak_page:1.0"), seed=0, rank=0)
+    assert inj.fire("leak_page")
+    assert metrics.get("cgx.faults.leak_page") == 1
+
+
 def test_fault_grammar_rejects_junk():
     with pytest.raises(ValueError):
         parse_faults("explode_randomly:1.0")  # unknown mode

@@ -952,6 +952,32 @@ def test_linter_serve_gate_allows_bounded_and_out_of_scope(tmp_path):
     assert proc.returncode == 0, proc.stdout
 
 
+def test_linter_accepts_mem_metric_namespace(tmp_path):
+    # `cgx.mem.*` is a documented sub-namespace (the ISSUE 18 memory
+    # plane); a typo'd family still fails.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f(pool, mb):\n"
+        "    metrics.add('cgx.mem.samples')\n"
+        "    metrics.set('cgx.mem.peak_mb', mb)\n"
+        "    metrics.set(f'cgx.mem.pool_used_mb.{pool}', mb)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.mme.samples')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "mme" in proc.stdout
+
+
 def test_linter_accepts_serve_metric_namespace(tmp_path):
     # `cgx.serve.*` is a documented sub-namespace (the ISSUE 15 family);
     # a typo'd family still fails.

@@ -13,7 +13,10 @@ graph (:mod:`.graph`):
   (``orphan-memo``);
 * :mod:`.locks` — lock-order cycles, blocking calls under a lock, and
   cross-thread unlocked writes (``lock-order`` / ``lock-blocking`` /
-  ``thread-shared-write``).
+  ``thread-shared-write``);
+* :mod:`.mempairs` — memory-ledger hook pairing: every
+  ``note_alloc``/``register_alloc`` owner label needs a reachable
+  matching release or ledger-reset hook (``mem-ledger-pairing``).
 
 Run ``python -m tools.analysis`` (add ``--json`` for the machine
 surface ``tools/cgx_report.py`` embeds); ``python tools/lint.py`` stays
@@ -26,14 +29,14 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence
 
-from . import caches, generations, knobs, locks
+from . import caches, generations, knobs, locks, mempairs
 from .graph import Project, get_source
 from .report import Finding
 
 WHOLE_PROGRAM_PASSES = (
     "knob-key", "stale-allowlist", "orphan-memo",
     "lock-order", "lock-blocking", "thread-shared-write",
-    "pragma-format", "generation-hygiene",
+    "pragma-format", "generation-hygiene", "mem-ledger-pairing",
 )
 
 
@@ -89,6 +92,8 @@ def run_project(
         findings.extend(locks.check(proj))
     if on("generation-hygiene"):
         findings.extend(generations.check(proj))
+    if on("mem-ledger-pairing"):
+        findings.extend(mempairs.check(proj))
     if on("pragma-format"):
         findings.extend(check_pragma_format(proj))
     if want is not None:

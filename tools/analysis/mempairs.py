@@ -1,0 +1,159 @@
+"""Mem-ledger pairing pass: every alloc hook needs a release path.
+
+The memory ledger (ISSUE 18) counts per-owner outstanding units from
+``note_alloc``/``note_release`` pairs (module shims) or
+``register_alloc``/``register_release`` (direct ledger methods). An
+owner label that is only ever allocated is not a leak in the pool — it
+is a leak in the INSTRUMENTATION: the detector will flag that owner as
+strictly-growing forever, and the operator chases a phantom. The
+inverse bug is quieter but just as wrong: a release-only label drives
+outstanding negative and masks a real leak of the same magnitude.
+
+The ``mem-ledger-pairing`` rule collects every ledger hook call in the
+package and checks, per owner label:
+
+* an **alloc** label is paired when the same label appears at a
+  ``note_release``/``register_release`` site anywhere in the package,
+  OR the allocating module calls ``reset_ledger`` (the bulk-settle
+  path ``supervisor.invalidate_trace_caches`` cascades into — a pool
+  whose teardown is "invalidate everything" pairs through reset);
+* a **release-only** label is flagged at its release site;
+* a **non-constant** label (a variable first argument) cannot be
+  paired statically and is flagged as unanalyzable — hoist the label
+  to a string literal or pragma the site.
+
+Deliberately one-sided sites (an alloc whose release lives in a
+different package, generated code) carry a
+``# cgx-analysis: allow(mem-ledger-pairing) — <why>`` pragma
+(docs/ANALYSIS.md).
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Tuple
+
+from .graph import Project
+from .report import Finding
+
+RULE = "mem-ledger-pairing"
+
+_ALLOC_FNS = ("note_alloc", "register_alloc")
+_RELEASE_FNS = ("note_release", "register_release")
+
+
+def _callee_name(call: ast.Call) -> str:
+    fn = call.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _const_label(call: ast.Call) -> Tuple[str, bool]:
+    """(owner label, is_constant) of a ledger hook call's first arg."""
+    if not call.args:
+        return "", False
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, str):
+        return a.value, True
+    return "", False
+
+
+def _is_ledger_reset(call: ast.Call) -> bool:
+    """``memledger.reset_ledger(...)`` or ``<ledger>.reset(...)`` — the
+    receiver must look ledger-ish so ordinary ``x.reset()`` calls on
+    unrelated objects don't count as a pairing."""
+    name = _callee_name(call)
+    if name == "reset_ledger":
+        return True
+    if name != "reset":
+        return False
+    fn = call.func
+    if isinstance(fn, ast.Attribute):
+        base = fn.value
+        recv = (
+            base.id if isinstance(base, ast.Name)
+            else base.attr if isinstance(base, ast.Attribute)
+            else ""
+        )
+        return "ledger" in recv.lower() or "mem" in recv.lower()
+    return False
+
+
+def check(proj: Project) -> List[Finding]:
+    # owner -> [(path, line), ...] per side; modules with a reset call.
+    allocs: Dict[str, List[Tuple[Path, int]]] = {}
+    releases: Dict[str, List[Tuple[Path, int]]] = {}
+    reset_modules: set = set()
+    unanalyzable: List[Tuple[Path, int, str]] = []
+
+    for mod in proj.modules.values():
+        if mod.path.name == "memledger.py":
+            # The ledger's own module: its shims forward a parameter
+            # label into register_alloc/register_release — definitional
+            # plumbing, not an instrumentation site.
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _callee_name(node)
+            if name in _ALLOC_FNS or name in _RELEASE_FNS:
+                label, const = _const_label(node)
+                if not const:
+                    unanalyzable.append((mod.path, node.lineno, name))
+                    continue
+                side = allocs if name in _ALLOC_FNS else releases
+                side.setdefault(label, []).append((mod.path, node.lineno))
+            elif _is_ledger_reset(node):
+                reset_modules.add(mod.path)
+
+    out: List[Finding] = []
+    for path, line, name in unanalyzable:
+        if proj.suppressed(path, line, RULE):
+            continue
+        out.append(Finding(
+            path=str(path), line=line, rule=RULE,
+            message=(
+                f"[{RULE}] {name}() owner label is not a string literal "
+                "— the pairing check cannot see it; hoist the label to a "
+                "literal or pragma this site"
+            ),
+        ))
+    for label, sites in sorted(allocs.items()):
+        if label in releases:
+            continue
+        for path, line in sites:
+            if path in reset_modules:
+                continue  # pairs through the bulk-settle reset path
+            if proj.suppressed(path, line, RULE):
+                continue
+            out.append(Finding(
+                path=str(path), line=line, rule=RULE,
+                message=(
+                    f"[{RULE}] owner {label!r} is allocated here but "
+                    "never released and its module has no ledger reset "
+                    "— the leak detector will flag this owner forever; "
+                    "add the matching note_release/register_release (or "
+                    "a reset_ledger teardown), or pragma the site"
+                ),
+            ))
+    for label, sites in sorted(releases.items()):
+        if label in allocs:
+            continue
+        for path, line in sites:
+            if proj.suppressed(path, line, RULE):
+                continue
+            out.append(Finding(
+                path=str(path), line=line, rule=RULE,
+                message=(
+                    f"[{RULE}] owner {label!r} is released here but "
+                    "never allocated — outstanding goes negative and "
+                    "masks a real leak of the same size; add the "
+                    "matching note_alloc/register_alloc or drop this "
+                    "release"
+                ),
+            ))
+    return out

@@ -442,10 +442,14 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # analysis/cache counters, per-component seconds of the last step
     # window, the dominant-rank gauge and the drift-loop trip counter —
     # docs/OBSERVABILITY.md "Critical path & drift".
+    # "mem" is the memory observability plane (PR 18): per-pool
+    # used/free/tte/frag gauges, the total/peak high-water gauges,
+    # leak-suspect and sample counters, and the mem_leak/mem_pressure
+    # event counters — docs/OBSERVABILITY.md "Memory plane".
     "async", "codec", "collective", "critpath", "elastic", "faults",
-    "flightrec", "health", "heartbeat", "plan", "qerr", "recovery",
-    "ring", "runtime", "sched", "serve", "shm", "sra", "step", "trace",
-    "wire", "xla",
+    "flightrec", "health", "heartbeat", "mem", "plan", "qerr",
+    "recovery", "ring", "runtime", "sched", "serve", "shm", "sra",
+    "step", "trace", "wire", "xla",
 })
 
 

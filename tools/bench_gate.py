@@ -342,13 +342,42 @@ def normalize_rejoin(rec: dict) -> Optional[Tuple[str, float]]:
     return key, 1000.0 / float(v)
 
 
+# Memory footprint floor (ISSUE 18): when the memory ledger is on,
+# bench.py stamps every record with the run's ``peak_mb`` high-water
+# mark. Lower is better for a footprint, so the gated trajectory is the
+# INVERSE (1/MB), same machinery as the TTFT floor above: a memory
+# regression — a cache that stopped evicting, a staging buffer that
+# doubled — shows as the inverse dropping past the threshold. Records
+# without the key (ledger off) gate nothing; ``@cpu`` separation
+# applies unchanged.
+_PEAK_MB_SUFFIX = ":peak_mb"
+
+
+def normalize_peak_mb(rec: dict) -> Optional[Tuple[str, float]]:
+    """(``<metric>:peak_mb`` key, 1/peak_mb) for records carrying a
+    top-level ``peak_mb``, or None."""
+    if not isinstance(rec, dict) or rec.get("unresolved"):
+        return None
+    metric = rec.get("metric")
+    v = rec.get("peak_mb")
+    if not metric or metric in _EXCLUDED_METRICS:
+        return None
+    if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+        return None
+    key = f"{metric}{_PEAK_MB_SUFFIX}"
+    if is_placeholder(rec):
+        key += _PLACEHOLDER_SUFFIX
+    return key, 1.0 / float(v)
+
+
 def normalize_all(rec: dict) -> List[Tuple[str, float]]:
     """Every gated (key, higher-is-better value) pair one record yields:
     its throughput trajectory and, when present, its overlap-fraction,
-    prediction-ratio, TTFT-inverse and rejoin-inverse trajectories."""
+    prediction-ratio, TTFT-inverse, rejoin-inverse and peak-memory-
+    inverse trajectories."""
     out = []
     for fn in (normalize, normalize_overlap, normalize_pred,
-               normalize_serve_ttft, normalize_rejoin):
+               normalize_serve_ttft, normalize_rejoin, normalize_peak_mb):
         norm = fn(rec)
         if norm is not None:
             out.append(norm)

@@ -1,0 +1,284 @@
+#!/usr/bin/env python3
+"""Memory-plane report: where do the bytes live right now?
+
+Reads the memory ledger's ``mem-rank<N>.jsonl`` snapshots (written
+every ``CGX_MEM_FLUSH_S`` seconds per rank when ``CGX_MEMLEDGER`` and
+``CGX_METRICS_DIR`` are set) plus the leader's ``cluster-mem.jsonl``
+merge, and renders the operator's three questions:
+
+* **owner tree** — per-rank pool table grouped by owner family
+  (``shm.arena.*``, ``serve.kv_pool``, ``cache.*``, ``snap.ring``,
+  ``hbm.jax_live``): used MB, capacity, occupancy, dedup savings.
+* **fragmentation map** — per arena: free bytes vs largest free
+  extent, the frag score (1 − largest/total), and the pending-region
+  owner/age table when the snapshot carries one.
+* **leak suspects** — owners whose alloc−release delta grew strictly
+  monotonically across the detector window, plus forecaster findings
+  (pool, trend time-to-exhaustion vs the lead window).
+
+Stdlib only; tolerant of partial/missing files (same contract as
+cgx_report).
+
+    python tools/cgx_mem.py [dir]            # default: $CGX_METRICS_DIR
+    python tools/cgx_mem.py [dir] --json     # machine-readable
+    python tools/cgx_mem.py [dir] --rank 1   # one rank only
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    out: List[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    out.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue  # torn tail of a killed writer
+    except OSError:
+        pass
+    return out
+
+
+def load_dir(directory: str) -> Dict[str, object]:
+    """Latest ledger snapshot per rank + the cluster merge tail."""
+    snaps: Dict[int, dict] = {}
+    history: Dict[int, List[dict]] = {}
+    for path in sorted(glob.glob(os.path.join(directory, "mem-rank*.jsonl"))):
+        name = os.path.basename(path)
+        try:
+            rank = int(name[len("mem-rank"):].split(".")[0])
+        except (ValueError, IndexError):
+            continue
+        recs = _read_jsonl(path)
+        if recs:
+            snaps[rank] = recs[-1]
+            history[rank] = recs
+    cluster = _read_jsonl(os.path.join(directory, "cluster-mem.jsonl"))
+    return {
+        "snapshots": snaps,
+        "history": history,
+        "cluster": cluster[-1] if cluster else None,
+    }
+
+
+def _family(pool: str) -> str:
+    """Owner-tree grouping key: ``shm.arena.cgx-shm-...`` →
+    ``shm.arena``; everything else groups on its first two dotted
+    components."""
+    parts = pool.split(".")
+    return ".".join(parts[:2]) if len(parts) >= 2 else pool
+
+
+def summarize(data: Dict[str, object], rank: Optional[int] = None) -> dict:
+    snaps: Dict[int, dict] = dict(data.get("snapshots") or {})
+    if rank is not None:
+        snaps = {r: s for r, s in snaps.items() if r == rank}
+    tree: Dict[str, dict] = {}
+    frag_rows: List[dict] = []
+    findings: List[dict] = []
+    suspects: set = set()
+    for r, snap in sorted(snaps.items()):
+        for row in snap.get("pools") or ():
+            pool = row.get("pool", "?")
+            fam = _family(pool)
+            node = tree.setdefault(
+                fam, {"family": fam, "used_mb": 0.0, "pools": {}},
+            )
+            used_mb = (row.get("used_bytes") or 0) / (1 << 20)
+            node["used_mb"] += used_mb
+            p = node["pools"].setdefault(pool, {
+                "pool": pool, "used_mb": 0.0, "capacity_units": 0.0,
+                "free_units": 0.0, "detail": {},
+            })
+            p["used_mb"] += used_mb
+            p["capacity_units"] += row.get("capacity_units") or 0.0
+            p["free_units"] += row.get("free_units") or 0.0
+            for k, v in (row.get("detail") or {}).items():
+                if isinstance(v, (int, float)):
+                    p["detail"][k] = p["detail"].get(k, 0) + v
+            if row.get("tte_s") is not None:
+                p["tte_s"] = min(
+                    row["tte_s"], p.get("tte_s", float("inf"))
+                )
+            if row.get("kind") == "arena":
+                d = row.get("detail") or {}
+                frag_rows.append({
+                    "rank": r,
+                    "pool": pool,
+                    "frag": row.get("frag") or 0.0,
+                    "free_mb": (
+                        (row.get("capacity_units") or 0.0)
+                        - (row.get("used_bytes") or 0)
+                    ) / (1 << 20),
+                    "largest_free_mb":
+                        (d.get("largest_free_bytes") or 0) / (1 << 20),
+                    "mapped_mb": (d.get("mapped_bytes") or 0) / (1 << 20),
+                    "gens": d.get("gens", 0),
+                    "pending_regions": d.get("pending_regions", 0),
+                })
+        for f in snap.get("findings") or ():
+            findings.append({**f, "rank": r})
+            if f.get("kind") == "mem_leak" and f.get("owner"):
+                suspects.add(f["owner"])
+        for owner, site in (snap.get("sites") or {}).items():
+            fam = _family(owner)
+            node = tree.setdefault(
+                fam, {"family": fam, "used_mb": 0.0, "pools": {}},
+            )
+            node.setdefault("sites", {})[owner] = site
+    return {
+        "ranks": sorted(snaps),
+        "total_mb": sum(s.get("total_mb") or 0.0 for s in snaps.values()),
+        "peak_mb": max(
+            (s.get("peak_mb") or 0.0 for s in snaps.values()), default=0.0
+        ),
+        "tree": sorted(tree.values(), key=lambda n: -n["used_mb"]),
+        "frag": sorted(frag_rows, key=lambda x: -x["frag"]),
+        "leak_suspects": sorted(suspects),
+        "findings": findings,
+        "cluster": data.get("cluster"),
+    }
+
+
+def _fmt_table(rows: List[Tuple], headers: Tuple[str, ...]) -> str:
+    widths = [
+        max(len(h), *(len(str(r[i])) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+
+    def line(cells):
+        return "  " + "  ".join(
+            str(c).ljust(w) for c, w in zip(cells, widths)
+        )
+
+    return "\n".join([line(headers)] + [line(r) for r in rows])
+
+
+def render(summary: dict) -> str:
+    parts = [
+        f"cgx_mem — ranks {summary['ranks'] or 'none'}   "
+        f"total {summary['total_mb']:.1f} MB   "
+        f"peak {summary['peak_mb']:.1f} MB"
+    ]
+    if not summary["ranks"]:
+        parts.append(
+            "(no mem-rank*.jsonl found — is the job running with "
+            "CGX_MEMLEDGER=1 and CGX_METRICS_DIR set?)"
+        )
+        return "\n".join(parts)
+    parts.append("\n== owner tree ==")
+    for node in summary["tree"]:
+        parts.append(f"  {node['family']}  {node['used_mb']:.2f} MB")
+        for pool, p in sorted(node["pools"].items()):
+            cap = p["capacity_units"]
+            occ = ""
+            if cap:
+                occ = (
+                    f"  occupancy {(cap - p['free_units']) / cap:.0%}"
+                    f" ({cap - p['free_units']:.0f}/{cap:.0f} units)"
+                )
+            tte = (
+                f"  tte {p['tte_s']:.0f}s" if p.get("tte_s") is not None
+                else ""
+            )
+            detail = p["detail"]
+            extra = "".join(
+                f"  {k}={detail[k]:g}"
+                for k in ("dedup_pages", "leaked_pages", "entries",
+                          "snapshots", "arrays")
+                if k in detail
+            )
+            parts.append(
+                f"    {pool}  {p['used_mb']:.2f} MB{occ}{tte}{extra}"
+            )
+        for owner, site in sorted((node.get("sites") or {}).items()):
+            parts.append(
+                f"    [site] {owner}: allocs={site.get('allocs'):g} "
+                f"releases={site.get('releases'):g} "
+                f"outstanding={site.get('outstanding'):g}"
+            )
+    if summary["frag"]:
+        parts.append("\n== fragmentation map (arenas) ==")
+        rows = [
+            (
+                f"r{x['rank']}", x["pool"], f"{x['frag']:.2f}",
+                f"{x['largest_free_mb']:.1f}", f"{x['mapped_mb']:.1f}",
+                x["gens"], x["pending_regions"],
+            )
+            for x in summary["frag"]
+        ]
+        parts.append(_fmt_table(
+            rows,
+            ("rank", "arena", "frag", "largest_free_mb", "mapped_mb",
+             "gens", "pending"),
+        ))
+    parts.append("\n== leak suspects ==")
+    if summary["leak_suspects"]:
+        for owner in summary["leak_suspects"]:
+            parts.append(f"  {owner}  (alloc−release grew all window)")
+    else:
+        parts.append("  none")
+    if summary["findings"]:
+        parts.append("\n== findings ==")
+        for f in summary["findings"][-8:]:
+            parts.append(
+                f"  r{f.get('rank')}: {f.get('kind')} "
+                f"owner={f.get('owner')} value={f.get('value')} "
+                f"threshold={f.get('threshold')}"
+            )
+    cluster = summary.get("cluster")
+    if cluster:
+        parts.append("\n== cluster (leader merge) ==")
+        parts.append(
+            f"  total {cluster.get('total_mb')} MB, "
+            f"peak-of-peaks {cluster.get('peak_mb_max')} MB, "
+            f"missing ranks {cluster.get('missing_ranks')}"
+        )
+        worst = cluster.get("nearest_exhaustion")
+        if worst:
+            parts.append(
+                f"  nearest exhaustion: {worst.get('pool')} on "
+                f"r{worst.get('rank')} in ~{worst.get('tte_s')}s"
+            )
+    return "\n".join(parts)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "directory", nargs="?", default=os.environ.get("CGX_METRICS_DIR"),
+        help="metrics dir (default: $CGX_METRICS_DIR)",
+    )
+    ap.add_argument("--json", action="store_true", help="print JSON summary")
+    ap.add_argument("--rank", type=int, default=None, help="one rank only")
+    args = ap.parse_args(argv)
+    if not args.directory:
+        print("cgx_mem: no directory given and CGX_METRICS_DIR unset",
+              file=sys.stderr)
+        return 2
+    if not os.path.isdir(args.directory):
+        print(f"cgx_mem: {args.directory!r} is not a directory",
+              file=sys.stderr)
+        return 2
+    summary = summarize(load_dir(args.directory), rank=args.rank)
+    if args.json:
+        print(json.dumps(summary, indent=2, default=str))
+    else:
+        print(render(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

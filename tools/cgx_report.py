@@ -766,6 +766,34 @@ def render(summary: dict) -> str:
                 "request(s), ms): "
                 + " ".join(f"{k}={v:.2f}" for k, v in t.items())
             )
+    if summary.get("memory"):
+        mm = summary["memory"]
+        parts.append("\n== memory (per-rank byte ledger) ==")
+        parts.append(
+            f"  ranks with ledger data: {mm['ranks']}   "
+            f"total {mm['total_mb']:.1f} MB   peak {mm['peak_mb']:.1f} MB"
+        )
+        rows = [
+            (
+                p["pool"],
+                f"{p['used_mb']:.2f}",
+                f"{p['frag']:.2f}" if p.get("frag") is not None else "-",
+                f"{p['tte_s']:.0f}s" if p.get("tte_s") is not None else "-",
+            )
+            for p in mm["pools"]
+        ]
+        if rows:
+            parts.append(_fmt_table(rows, ("pool", "used_mb", "frag", "tte")))
+        if mm["leak_suspects"]:
+            parts.append(
+                "  LEAK suspects (alloc−release grew all window): "
+                + ", ".join(mm["leak_suspects"])
+            )
+        for f in mm["findings"][-4:]:
+            parts.append(
+                f"  {f.get('kind')} owner={f.get('owner')} "
+                f"value={f.get('value')} threshold={f.get('threshold')}"
+            )
     if len(parts) == 1:
         parts.append("(no events recorded — was CGX_METRICS_DIR set?)")
     return "\n".join(parts)
@@ -813,6 +841,54 @@ def _critpath_summary(directory: str) -> Optional[dict]:
     }
 
 
+def _memory_summary(directory: str) -> Optional[dict]:
+    """Condensed memory-plane block (ISSUE 18): each rank's LAST
+    ``mem-rank<N>.jsonl`` snapshot folded into cluster totals, a pool
+    table (used MB / fragmentation / forecast time-to-exhaustion), leak
+    suspects, and the most recent findings — None (section omitted)
+    when no ledger files exist (CGX_MEMLEDGER off)."""
+    last_by_rank: Dict[int, dict] = {}
+    for path in glob.glob(os.path.join(directory, "mem-rank*.jsonl")):
+        rank = _rank_of(path, "mem-rank")
+        recs = _read_jsonl(path)
+        if rank is None or not recs:
+            continue
+        last_by_rank[rank] = recs[-1]
+    if not last_by_rank:
+        return None
+    pools: Dict[str, dict] = {}
+    findings: List[dict] = []
+    suspects: set = set()
+    for rank, snap in sorted(last_by_rank.items()):
+        for row in snap.get("pools") or ():
+            name = row.get("pool", "?")
+            p = pools.setdefault(
+                name, {"pool": name, "used_mb": 0.0, "frag": None,
+                       "tte_s": None},
+            )
+            p["used_mb"] += (row.get("used_bytes") or 0) / (1 << 20)
+            frag = row.get("frag")
+            if frag is not None:
+                p["frag"] = max(p["frag"] or 0.0, frag)
+            tte = row.get("tte_s")
+            if tte is not None and (p["tte_s"] is None or tte < p["tte_s"]):
+                p["tte_s"] = tte
+        for f in snap.get("findings") or ():
+            findings.append({**f, "rank": rank})
+            if f.get("kind") == "mem_leak" and f.get("owner"):
+                suspects.add(f["owner"])
+    return {
+        "ranks": sorted(last_by_rank),
+        "total_mb": sum(s.get("total_mb") or 0.0
+                        for s in last_by_rank.values()),
+        "peak_mb": max(s.get("peak_mb") or 0.0
+                       for s in last_by_rank.values()),
+        "pools": sorted(pools.values(), key=lambda p: -p["used_mb"]),
+        "leak_suspects": sorted(suspects),
+        "findings": findings,
+    }
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -836,6 +912,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     summary = summarize(load_dir(args.directory))
     summary["critpath"] = _critpath_summary(args.directory)
+    summary["memory"] = _memory_summary(args.directory)
     if args.analysis:
         try:
             sys.path.insert(0, str(Path(__file__).resolve().parent.parent))

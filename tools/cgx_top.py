@@ -364,6 +364,28 @@ def _serve_ttft(m: Dict[str, float]) -> str:
     return f"{v:.0f}"
 
 
+def _mem_mb(m: Dict[str, float]) -> str:
+    """Ledger total across pools in MiB (``cgx.mem.total_mb``, with the
+    peak high-water beside it) — ``-`` until the memory ledger
+    (CGX_MEMLEDGER) has sampled."""
+    if not m.get("cgx.mem.samples"):
+        return "-"
+    total = m.get("cgx.mem.total_mb", 0.0)
+    peak = m.get("cgx.mem.peak_mb", 0.0)
+    return f"{total:.0f}/{peak:.0f}"
+
+
+def _mem_frag(m: Dict[str, float]) -> str:
+    """Worst arena fragmentation (``cgx.mem.arena_frag``: 1 − largest
+    free extent / total free; high = free bytes shattered) plus a ``!``
+    marker when the ledger currently names leak suspects."""
+    if not m.get("cgx.mem.samples"):
+        return "-"
+    frag = m.get("cgx.mem.arena_frag", 0.0)
+    mark = "!" if m.get("cgx.mem.leak_suspects", 0.0) else ""
+    return f"{frag:.2f}{mark}"
+
+
 def _straggler(status: Optional[dict]) -> str:
     scores = (status or {}).get("straggler_scores") or {}
     if not scores:
@@ -391,7 +413,7 @@ def render(directory: str, state: dict) -> str:
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
                "edges", "overlap", "sched$", "plan$", "pred", "crit",
                "atune$", "roofl", "lag", "async$", "tok/s", "ttft",
-               "straggler", "gen", "ws", "last_fault")
+               "mem", "frag", "straggler", "gen", "ws", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
     # Cluster-wide (the critical path crosses ranks): one poll per
@@ -417,6 +439,8 @@ def render(directory: str, state: dict) -> str:
             _async_rate(m),
             _serve_tps(m),
             _serve_ttft(m),
+            _mem_mb(m),
+            _mem_frag(m),
             _straggler(d["status"]),
             str(int(m.get("cgx.recovery.generation", 0))),
             str(int(m.get("cgx.recovery.ws", 0)) or "?"),

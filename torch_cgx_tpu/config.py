@@ -136,6 +136,9 @@ HEALTH_STRAGGLER_FACTOR = "CGX_HEALTH_STRAGGLER_FACTOR"  # skew score gate
 HEALTH_STEP_FACTOR = "CGX_HEALTH_STEP_FACTOR"  # step-time regression gate
 HEALTH_PLAN_DRIFT_FACTOR = "CGX_HEALTH_PLAN_DRIFT_FACTOR"  # drift-loop gate
 HEALTH_QERR_SLO = "CGX_HEALTH_QERR_SLO"  # compression-quality SLO (rel-L2)
+MEMLEDGER = "CGX_MEMLEDGER"  # master enable for the per-rank memory ledger
+MEM_FLUSH_S = "CGX_MEM_FLUSH_S"  # ledger sample/flush interval (seconds)
+MEM_LEAK_WINDOW = "CGX_MEM_LEAK_WINDOW"  # sliding-window samples for leak/OOM calls
 PROM_PORT = "CGX_PROM_PORT"  # Prometheus text exposition endpoint
 
 # Defaults — reference values (common.h:24-41, compressor.h:32,
@@ -795,6 +798,42 @@ def health_qerr_slo() -> Optional[float]:
     no quality SLO."""
     v = _env.get_float_env_or_default(HEALTH_QERR_SLO, 0.0)
     return v if v > 0 else None
+
+
+def memledger_enabled() -> bool:
+    """CGX_MEMLEDGER: run the per-rank memory ledger — a unified byte
+    accountant over every byte-owning surface (shm arena regions, the
+    paged KV pool, snapshot rings, the staged-program caches, wire
+    staging) with a sliding-window leak detector and a linear-trend
+    OOM forecaster on top. Off by default: unset means zero hooks fire
+    on any hot path, the planner's staging-budget filter stays out of
+    the plan key, and staged programs / store keys / wire bytes are
+    bit-identical to the ledger never having existed. Host-side
+    observability only — deliberately NOT part of
+    trace_knob_fingerprint()."""
+    return _env.get_bool_env_or_default(MEMLEDGER, False)
+
+
+def mem_flush_s() -> float:
+    """CGX_MEM_FLUSH_S: sample/flush interval of the memory ledger —
+    each tick samples every registered pool, refreshes the
+    ``cgx.mem.*`` gauges, advances the leak/forecast windows, and
+    (when CGX_METRICS_DIR is set) appends a ``mem-rank<N>.jsonl``
+    snapshot line."""
+    v = _env.get_float_env_or_default(MEM_FLUSH_S, 5.0)
+    return v if v > 0 else 5.0
+
+
+def mem_leak_window() -> int:
+    """CGX_MEM_LEAK_WINDOW: sliding-window length in ledger samples
+    for the leak detector (an owner whose alloc−release delta grows
+    strictly monotonically across the full window is named in a
+    ``mem_leak`` event) and the OOM forecaster's lead horizon (a pool
+    whose linear-trend time-to-exhaustion drops inside
+    window × CGX_MEM_FLUSH_S raises ``mem_pressure``). Floor of 3:
+    two points cannot distinguish a trend from noise."""
+    v = _env.get_int_env_or_default(MEM_LEAK_WINDOW, 5)
+    return v if v >= 3 else 3
 
 
 def prom_port() -> Optional[int]:

@@ -79,13 +79,20 @@ MEMBERSHIP = "membership"
 # prediction — the PlanDriftMonitor's signal that the CostModel no
 # longer describes this fabric and the planner must re-calibrate.
 PLAN_DRIFT = "plan_drift"
+# Memory plane (PR 18): the memledger's sliding-window leak detector
+# names an owner whose alloc−release delta grows strictly monotonically
+# across the full window; the OOM forecaster warns when a pool's
+# linear-trend time-to-exhaustion drops inside the configured lead
+# window — before the hard wall, not at it.
+MEM_LEAK = "mem_leak"
+MEM_PRESSURE = "mem_pressure"
 
 # The closed kind registry (lint's health-event-kinds rule cross-checks
 # every HealthEvent construction site against this tuple; the
 # docs/OBSERVABILITY.md event table mirrors it).
 EVENT_KINDS = (
     STRAGGLER, STEP_REGRESSION, QERR_SLO, ARENA_PRESSURE, ASYNC_LAG,
-    PREEMPT_NOTICE, MEMBERSHIP, PLAN_DRIFT,
+    PREEMPT_NOTICE, MEMBERSHIP, PLAN_DRIFT, MEM_LEAK, MEM_PRESSURE,
 )
 
 # Wait-signal floor: peer skew is judged relative to the median peer, but
@@ -348,6 +355,28 @@ class HealthEngine:
             kind=PLAN_DRIFT, rank=self.rank, value=round(float(ratio), 6),
             threshold=float(threshold), suspect=None,
             detail=(("component", component),) + tuple(detail.items()),
+            ts=round(time.time(), 6),
+            t_mono=round(time.perf_counter(), 6),
+        )
+        return ev if self._emit(ev) else None
+
+    def note_mem(
+        self, kind: str, value: float, threshold: float, owner: str = "",
+        **detail,
+    ) -> Optional[HealthEvent]:
+        """Memory-ledger hook: a ``mem_leak`` (value = outstanding
+        alloc−release delta, threshold = window length) or
+        ``mem_pressure`` (value = forecast time-to-exhaustion seconds,
+        threshold = lead window seconds) finding. The ledger holds its
+        own sustain window — the leak detector *is* a sustain window —
+        so the engine only applies the per-(kind, suspect) cooldown;
+        ``owner`` rides in detail because suspect is a rank slot."""
+        if kind not in (MEM_LEAK, MEM_PRESSURE):
+            raise ValueError(f"not a memory event kind: {kind!r}")
+        ev = HealthEvent(
+            kind=kind, rank=self.rank, value=round(float(value), 6),
+            threshold=float(threshold), suspect=None,
+            detail=(("owner", owner),) + tuple(detail.items()),
             ts=round(time.time(), 6),
             t_mono=round(time.perf_counter(), 6),
         )
@@ -899,6 +928,18 @@ def note_plan_drift(
     if eng is None:
         return None
     return eng.note_plan_drift(ratio, threshold, component, **detail)
+
+
+def note_mem_event(
+    kind: str, value: float, threshold: float, owner: str = "", **detail
+) -> Optional["HealthEvent"]:
+    """Memory-ledger hook: report a leak/pressure finding (no-op when
+    the engine is off — the ledger's gauges, flight-recorder records
+    and jsonl snapshots do not depend on the event plane)."""
+    eng = _engine
+    if eng is None:
+        return None
+    return eng.note_mem(kind, value, threshold, owner, **detail)
 
 
 def forget_peers() -> None:

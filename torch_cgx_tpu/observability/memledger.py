@@ -1,0 +1,701 @@
+"""Per-rank memory ledger: one byte accountant over every owning surface.
+
+The repo's byte accounting was scattered one-off gauges — the KV pool
+set ``cgx.serve.pool_free`` inside its own mutators, arena pressure left
+a counter trend, the staged-program caches reported nothing — so nobody
+could answer "where do the bytes live right now", let alone "when do we
+hit the wall". This module is the unified answer, in the spirit of
+GC3's buffer-footprint-as-compiler-input (arxiv 2201.11840): memory is
+a first-class *planned* quantity, not a post-mortem surprise.
+
+One :class:`MemLedger` per process (module singleton, same zero-cost
+shim discipline as :mod:`.health`) tracks two complementary truths:
+
+* **Site deltas** (push): instrumented alloc/release sites call
+  :func:`note_alloc` / :func:`note_release` with a stable *owner label*
+  (``shm.arena``, ``serve.kv_pool``, ...). The sliding-window leak
+  detector watches each owner's alloc−release delta: strictly monotone
+  growth across the full ``CGX_MEM_LEAK_WINDOW`` samples names the
+  owner in a ``mem_leak`` HealthEvent — the classic slow leak caught by
+  its *shape*, not by exhaustion. The analyzer's ``mem-ledger-pairing``
+  pass proves every alloc site has a reachable release/reset partner.
+* **Pool occupancy** (pull): every sample tick the ledger discovers the
+  live byte-owning surfaces through weak liveness sets and
+  ``sys.modules`` probes — shm arena rings (occupancy + fragmentation =
+  1 − largest-free-extent / total-free), paged KV pools (occupancy +
+  fork-dedup savings), supervisor snapshot rings, the five
+  staged-program caches (per-entry footprint estimated from buffer
+  shapes), and ``jax.live_arrays()`` as the HBM cross-check when jax is
+  already in the process. Pull means registration order can't be wrong:
+  a pool created before the ledger starts is still found.
+
+On top of occupancy sits the **OOM forecaster**: a least-squares linear
+trend over each bounded pool's free-level history extrapolates
+time-to-exhaustion; a pool forecast to exhaust within the lead window
+(``CGX_MEM_LEAK_WINDOW × CGX_MEM_FLUSH_S`` seconds) raises
+``mem_pressure`` *before* the hard wall so admission/supervision can
+shed load while there is still headroom to act. The planner consumes
+the same idea at solve time through ``CostModel.memory_envelope()``.
+
+Surfaces: ``cgx.mem.*`` gauges (Prometheus via watch), periodic
+``mem-rank<N>.jsonl`` snapshots (merged leader-side like
+cluster-health by ``watch.aggregate_mem_over_store``), the
+``tools/cgx_mem.py`` CLI, cgx_top's mem/frag columns, and a
+``cgx_report == memory ==`` section.
+
+Inert by default: ``CGX_MEMLEDGER`` unset means :func:`maybe_start`
+returns None, every hot-path hook is a single global load, the planner
+keeps its staging-budget filter out of the plan key, and staged
+programs / store keys / wire bytes are bit-identical to the ledger not
+existing. All ledger state is reset-reachable from
+``supervisor.invalidate_trace_caches`` (the recovery cascade calls
+:func:`reset_ledger`).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import config as cfg
+from ..utils.logging import get_logger
+from . import flightrec
+from .instruments import metrics
+
+log = get_logger()
+
+# Slope quieter than this (units/second) is flat, not a trend — free
+# levels dithering by rounding noise must not forecast an exhaustion.
+_SLOPE_EPS = 1e-9
+
+# Deep-size walk guards: the estimator is a bounded *estimate*, never a
+# full heap traversal (a pathological cache entry must cost microseconds,
+# not a GC pause).
+_SIZE_DEPTH = 5
+_SIZE_MAX_ITEMS = 4096
+
+# The five staged-program caches (mirrors tools/analysis/knobs.py's
+# default_surfaces; the train-step build cache is closure-held and
+# covered by the jax.live_arrays cross-check instead).
+_CACHE_SURFACES: Tuple[Tuple[str, str, str], ...] = (
+    ("cache.layout", "torch_cgx_tpu.parallel.allreduce", "_LAYOUT_CACHE"),
+    ("cache.schedule", "torch_cgx_tpu.parallel.schedule", "_SCHED_CACHE"),
+    ("cache.plan", "torch_cgx_tpu.parallel.planner", "_PLAN_CACHE"),
+    (
+        "cache.xla_program",
+        "torch_cgx_tpu.parallel.xla_allreduce",
+        "_PROGRAM_CACHE",
+    ),
+    (
+        "cache.serve_program",
+        "torch_cgx_tpu.serving.scheduler",
+        "_PROGRAM_CACHE",
+    ),
+)
+
+
+def deep_nbytes(obj: Any, depth: int = _SIZE_DEPTH) -> int:
+    """Bounded byte-footprint estimate of a cache entry / snapshot.
+
+    Leaves with ``.nbytes`` (numpy/jax arrays, torch tensors expose it
+    too) report themselves; objects with ``.shape``+``.dtype`` but no
+    nbytes are computed from the product; containers recurse
+    depth-limited with an identity seen-set. Everything else counts 0 —
+    an under-estimate by design (Python object overhead is noise next
+    to the buffers this ledger exists to find)."""
+    seen: set = set()
+    budget = [_SIZE_MAX_ITEMS]
+
+    def walk(o: Any, d: int) -> int:
+        if d < 0 or budget[0] <= 0:
+            return 0
+        budget[0] -= 1
+        oid = id(o)
+        if oid in seen:
+            return 0
+        seen.add(oid)
+        nb = getattr(o, "nbytes", None)
+        if isinstance(nb, int) and nb >= 0:
+            return nb
+        shape = getattr(o, "shape", None)
+        dtype = getattr(o, "dtype", None)
+        if shape is not None and dtype is not None:
+            try:
+                n = 1
+                for dim in shape:
+                    n *= int(dim)
+                return n * int(getattr(dtype, "itemsize", 0) or 0)
+            except (TypeError, ValueError):
+                return 0
+        if isinstance(o, dict):
+            return sum(walk(v, d - 1) for v in list(o.values()))
+        if isinstance(o, (list, tuple, set, frozenset)):
+            return sum(walk(v, d - 1) for v in list(o))
+        inner = getattr(o, "__dict__", None)
+        if isinstance(inner, dict):
+            return sum(walk(v, d - 1) for v in list(inner.values()))
+        return 0
+
+    try:
+        return walk(obj, depth)
+    except (RuntimeError, ReferenceError):
+        # A container mutated mid-walk (the ledger samples live state
+        # without the owners' locks — by contract, see sample()).
+        return 0
+
+
+def _trend_tte_s(hist: "deque") -> Optional[float]:
+    """Least-squares time-to-exhaustion over a (t_s, free_units)
+    history. None = no downward trend (flat, rising, or under 3 points
+    — two points cannot distinguish a trend from noise). 0.0 = already
+    exhausted. The math the docs chapter states: slope b from the
+    normal equations, tte = −free_now / b for b < 0."""
+    if len(hist) < 3:
+        return None
+    t0 = hist[0][0]
+    xs = [t - t0 for t, _ in hist]
+    ys = [f for _, f in hist]
+    n = float(len(xs))
+    sx = sum(xs)
+    sy = sum(ys)
+    sxx = sum(x * x for x in xs)
+    sxy = sum(x * y for x, y in zip(xs, ys))
+    denom = n * sxx - sx * sx
+    if denom <= 0:
+        return None
+    slope = (n * sxy - sx * sy) / denom
+    if slope >= -_SLOPE_EPS:
+        return None
+    free_now = ys[-1]
+    if free_now <= 0:
+        return 0.0
+    return free_now / -slope
+
+
+# ---------------------------------------------------------------------------
+# Pull-model pool samplers (sys.modules probes: the ledger never imports
+# a data plane — a serving-only process must not pay for the training
+# stack, and vice versa).
+# ---------------------------------------------------------------------------
+
+
+def _arena_rows() -> List[Dict[str, Any]]:
+    shm = sys.modules.get("torch_cgx_tpu.torch_backend.shm")
+    if shm is None:
+        return []
+    rows: List[Dict[str, Any]] = []
+    for arena in list(getattr(shm, "_LIVE_ARENAS", ())):
+        try:
+            st = arena.mem_stats()
+        except (RuntimeError, OSError):
+            continue  # an arena mid-close is not a sample worth fighting
+        rows.append({
+            "pool": f"shm.arena.{st['name']}",
+            "kind": "arena",
+            "used_bytes": int(st["live_bytes"]),
+            "capacity_bytes": int(st["cap_bytes"]),
+            "free_units": float(st["cap_bytes"] - st["live_bytes"]),
+            "capacity_units": float(st["cap_bytes"]),
+            "frag": float(st["frag"]),
+            "detail": {
+                "gens": st["gens"],
+                "mapped_bytes": st["capacity_bytes"],
+                "largest_free_bytes": st["largest_free_bytes"],
+                "pending_regions": st["pending_regions"],
+            },
+        })
+    return rows
+
+
+def _kv_rows() -> List[Dict[str, Any]]:
+    kv = sys.modules.get("torch_cgx_tpu.serving.kv_cache")
+    if kv is None:
+        return []
+    rows: List[Dict[str, Any]] = []
+    for i, cache in enumerate(list(getattr(kv, "_LIVE", ()))):
+        try:
+            # publish_pool_gauges IS the satellite fix: the ledger tick
+            # refreshes cgx.serve.pool_free/pool_dedup_pages between
+            # decode steps, so scrapes see live truth, not the value as
+            # of the last mutator.
+            st = cache.publish_pool_gauges()
+        except (RuntimeError, ReferenceError):
+            continue
+        used = st["max_pages"] - st["free_pages"]
+        rows.append({
+            "pool": "serve.kv_pool" if i == 0 else f"serve.kv_pool.{i}",
+            "kind": "kv_pool",
+            "used_bytes": 0,  # byte size lives with the device pool arrays
+            "capacity_bytes": 0,
+            "free_units": float(st["free_pages"]),
+            "capacity_units": float(st["max_pages"]),
+            "frag": None,
+            "detail": {
+                "live_pages": st["live_pages"],
+                "dedup_pages": st["dedup_pages"],
+                "leaked_pages": st["leaked_pages"],
+                "seqs": st["seqs"],
+                "page_tokens": st["page_tokens"],
+            },
+        })
+    return rows
+
+
+def _snapshot_rows() -> List[Dict[str, Any]]:
+    sup = sys.modules.get("torch_cgx_tpu.robustness.supervisor")
+    if sup is None:
+        return []
+    rows: List[Dict[str, Any]] = []
+    for i, s in enumerate(list(getattr(sup, "_LIVE_SUPERVISORS", ()))):
+        snaps = getattr(s, "_snapshots", None)
+        if not isinstance(snaps, dict):
+            continue
+        try:
+            items = list(snaps.items())
+        except RuntimeError:
+            continue  # resized mid-copy; next tick sees it
+        rows.append({
+            "pool": "snap.ring" if i == 0 else f"snap.ring.{i}",
+            "kind": "snap_ring",
+            "used_bytes": sum(deep_nbytes(v) for _, v in items),
+            "capacity_bytes": 0,
+            "free_units": 0.0,
+            "capacity_units": 0.0,
+            "frag": None,
+            "detail": {"snapshots": len(items),
+                       "steps": sorted(k for k, _ in items)[-4:]},
+        })
+    return rows
+
+
+def _cache_rows() -> List[Dict[str, Any]]:
+    rows: List[Dict[str, Any]] = []
+    for pool, modname, attr in _CACHE_SURFACES:
+        mod = sys.modules.get(modname)
+        if mod is None:
+            continue
+        cache = getattr(mod, attr, None)
+        if not isinstance(cache, dict):
+            continue
+        try:
+            values = list(cache.values())
+        except RuntimeError:
+            continue
+        rows.append({
+            "pool": pool,
+            "kind": "staged_cache",
+            "used_bytes": sum(deep_nbytes(v) for v in values),
+            "capacity_bytes": 0,
+            "free_units": 0.0,
+            "capacity_units": 0.0,
+            "frag": None,
+            "detail": {"entries": len(values)},
+        })
+    return rows
+
+
+def _jax_rows() -> List[Dict[str, Any]]:
+    """HBM cross-check: total live jax array bytes, when jax is already
+    imported (the ledger itself must never pull the jax runtime in)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    live = getattr(jax, "live_arrays", None)
+    if not callable(live):
+        return []
+    try:
+        arrays = live()
+        total = sum(int(getattr(a, "nbytes", 0) or 0) for a in arrays)
+        count = len(arrays)
+    except (RuntimeError, TypeError, ValueError):
+        return []
+    return [{
+        "pool": "hbm.jax_live",
+        "kind": "hbm",
+        "used_bytes": total,
+        "capacity_bytes": 0,
+        "free_units": 0.0,
+        "capacity_units": 0.0,
+        "frag": None,
+        "detail": {"arrays": count},
+    }]
+
+
+_BUILTIN_SAMPLERS: Tuple[Callable[[], List[Dict[str, Any]]], ...] = (
+    _arena_rows, _kv_rows, _snapshot_rows, _cache_rows, _jax_rows,
+)
+
+
+class MemLedger:
+    """One rank's byte ledger (use :func:`maybe_start`).
+
+    Lock discipline: instrumented sites call :meth:`register_alloc` /
+    :meth:`register_release` possibly while holding their OWN pool lock
+    (arena lock, KV lock), so those take only the ledger lock — and the
+    sampler collects pool rows (which take pool locks) with the ledger
+    lock NOT held. The only order that ever forms is
+    pool-lock → ledger-lock; the reverse edge does not exist."""
+
+    def __init__(
+        self,
+        rank: int = 0,
+        flush_s: Optional[float] = None,
+        leak_window: Optional[int] = None,
+    ):
+        self.rank = int(rank)
+        self._flush_s = float(flush_s if flush_s else cfg.mem_flush_s())
+        self._window = int(leak_window if leak_window else cfg.mem_leak_window())
+        self._lock = threading.Lock()
+        # owner -> [allocs, releases, bytes_alloc, bytes_release]
+        self._sites: Dict[str, List[float]] = {}
+        # owner -> outstanding-count history (one point per sample)
+        self._site_hist: Dict[str, "deque"] = {}
+        # pool -> (t_mono, free_units) history for the forecaster
+        self._pool_hist: Dict[str, "deque"] = {}
+        # extra sampler callbacks: fn() -> list of pool rows
+        self._samplers: List[Callable[[], List[Dict[str, Any]]]] = []
+        self._cool: Dict[Tuple[str, str], float] = {}
+        self._leaking: set = set()
+        self.peak_bytes = 0
+        self._last_snapshot: Optional[Dict[str, Any]] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- registration API --------------------------------------------------
+
+    def register_alloc(self, owner: str, n: int = 1, nbytes: int = 0) -> None:
+        with self._lock:
+            s = self._sites.setdefault(owner, [0.0, 0.0, 0.0, 0.0])
+            s[0] += n
+            s[2] += nbytes
+
+    def register_release(self, owner: str, n: int = 1, nbytes: int = 0) -> None:
+        with self._lock:
+            s = self._sites.setdefault(owner, [0.0, 0.0, 0.0, 0.0])
+            s[1] += n
+            s[3] += nbytes
+
+    def register_sampler(
+        self, fn: Callable[[], List[Dict[str, Any]]]
+    ) -> None:
+        """Attach an extra pool sampler (returns rows in the builtin
+        schema) — the registration point for surfaces this module does
+        not know about."""
+        with self._lock:
+            self._samplers.append(fn)
+
+    # -- the tick ----------------------------------------------------------
+
+    def pool_table(self) -> List[Dict[str, Any]]:
+        """Current pool rows from every sampler (builtin + registered).
+        Collected WITHOUT the ledger lock — samplers take pool locks."""
+        with self._lock:
+            extra = list(self._samplers)
+        rows: List[Dict[str, Any]] = []
+        for fn in _BUILTIN_SAMPLERS + tuple(extra):
+            try:
+                rows.extend(fn())
+            except Exception as e:
+                # One broken sampler must not blind the whole ledger —
+                # but say so, loudly enough to fix it.
+                log.warning("memledger sampler %r failed: %s", fn, e)
+        return rows
+
+    def sample(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """One ledger tick: sample pools, advance the leak/forecast
+        windows, refresh gauges, emit findings. Returns the snapshot
+        dict (what ``mem-rank<N>.jsonl`` records). ``now`` is a
+        monotonic-clock override for deterministic tests."""
+        t = time.monotonic() if now is None else float(now)
+        rows = self.pool_table()
+        findings: List[Dict[str, Any]] = []
+        lead_s = self._window * self._flush_s
+        with self._lock:
+            for row in rows:
+                cap = row.get("capacity_units") or 0.0
+                if cap > 0:
+                    h = self._pool_hist.setdefault(
+                        row["pool"], deque(maxlen=max(self._window, 4))
+                    )
+                    h.append((t, float(row.get("free_units") or 0.0)))
+                    tte = _trend_tte_s(h)
+                    if tte is not None:
+                        row["tte_s"] = round(tte, 3)
+                        if tte <= lead_s:
+                            findings.append({
+                                "kind": "mem_pressure",
+                                "owner": row["pool"],
+                                "value": round(tte, 3),
+                                "threshold": lead_s,
+                                "free_units": row.get("free_units"),
+                                "capacity_units": cap,
+                            })
+            self._leaking.clear()
+            sites_out: Dict[str, Dict[str, float]] = {}
+            for owner, s in self._sites.items():
+                outstanding = s[0] - s[1]
+                h = self._site_hist.setdefault(
+                    owner, deque(maxlen=self._window)
+                )
+                h.append(outstanding)
+                sites_out[owner] = {
+                    "allocs": s[0], "releases": s[1],
+                    "outstanding": outstanding,
+                    "bytes_outstanding": s[2] - s[3],
+                }
+                grew = len(h) == self._window and all(
+                    h[i] < h[i + 1] for i in range(len(h) - 1)
+                )
+                if grew and h[-1] > 0:
+                    self._leaking.add(owner)
+                    findings.append({
+                        "kind": "mem_leak",
+                        "owner": owner,
+                        "value": outstanding,
+                        "threshold": float(self._window),
+                        "grew_by": h[-1] - h[0],
+                    })
+            total = sum(int(r.get("used_bytes") or 0) for r in rows)
+            self.peak_bytes = max(self.peak_bytes, total)
+            peak = self.peak_bytes
+            # Cooldown: a sustained condition is one event stream, one
+            # emission per lead window per (kind, owner).
+            emit = []
+            for f in findings:
+                key = (f["kind"], f["owner"])
+                last = self._cool.get(key)
+                if last is None or t - last >= max(lead_s, self._flush_s):
+                    self._cool[key] = t
+                    emit.append(f)
+            leak_count = len(self._leaking)
+        self._publish(rows, total, peak, leak_count)
+        for f in emit:
+            self._emit_finding(f)
+        snap = {
+            "ts": round(time.time(), 6),
+            "t_mono": round(t, 6),
+            "rank": self.rank,
+            "total_mb": round(total / (1 << 20), 3),
+            "peak_mb": round(peak / (1 << 20), 3),
+            "pools": rows,
+            "sites": sites_out,
+            "findings": findings,
+            "window": self._window,
+            "flush_s": self._flush_s,
+        }
+        with self._lock:
+            self._last_snapshot = snap
+        return snap
+
+    def _publish(
+        self, rows: List[Dict[str, Any]], total: int, peak: int,
+        leak_count: int,
+    ) -> None:
+        metrics.add("cgx.mem.samples")
+        metrics.set("cgx.mem.total_mb", round(total / (1 << 20), 3))
+        metrics.set("cgx.mem.peak_mb", round(peak / (1 << 20), 3))
+        metrics.set("cgx.mem.pools", float(len(rows)))
+        metrics.set("cgx.mem.leak_suspects", float(leak_count))
+        worst_frag = 0.0
+        for row in rows:
+            name = row["pool"]
+            metrics.set(
+                f"cgx.mem.pool_used_mb.{name}",
+                round(int(row.get("used_bytes") or 0) / (1 << 20), 3),
+            )
+            if row.get("capacity_units"):
+                metrics.set(
+                    f"cgx.mem.pool_free.{name}",
+                    float(row.get("free_units") or 0.0),
+                )
+            if row.get("tte_s") is not None:
+                metrics.set(f"cgx.mem.pool_tte_s.{name}", row["tte_s"])
+            frag = row.get("frag")
+            if frag is not None:
+                metrics.set(f"cgx.mem.pool_frag.{name}", frag)
+                if row.get("kind") == "arena":
+                    worst_frag = max(worst_frag, frag)
+        metrics.set("cgx.mem.arena_frag", round(worst_frag, 4))
+
+    def _emit_finding(self, f: Dict[str, Any]) -> None:
+        metrics.add(f"cgx.mem.events.{f['kind']}")
+        flightrec.record(
+            "mem", event=f["kind"],
+            **{k: v for k, v in f.items() if k != "kind"},
+        )
+        detail = {
+            k: v for k, v in f.items()
+            if k not in ("kind", "owner", "value", "threshold")
+        }
+        # Lazy: the event plane is optional — gauges/flightrec/jsonl
+        # carry the finding even with CGX_HEALTH off.
+        from . import health as health_mod
+
+        health_mod.note_mem_event(
+            f["kind"], f["value"], f["threshold"], owner=f["owner"],
+            **detail,
+        )
+
+    # -- surfaces ----------------------------------------------------------
+
+    def peak_mb(self) -> float:
+        with self._lock:
+            return round(self.peak_bytes / (1 << 20), 3)
+
+    def last_snapshot(self) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            return self._last_snapshot
+
+    def leak_suspects(self) -> List[str]:
+        with self._lock:
+            return sorted(self._leaking)
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def reset(self, reason: str = "reset") -> None:
+        """Recovery cascade entry (``supervisor.invalidate_trace_caches``
+        → :func:`reset_ledger`): every derived window restarts — a
+        reconfigured group's alloc/release streams and free-level trends
+        are a new regime; carrying pre-recovery history across would
+        fabricate leaks out of the epoch bump itself."""
+        with self._lock:
+            self._sites.clear()
+            self._site_hist.clear()
+            self._pool_hist.clear()
+            self._cool.clear()
+            self._leaking.clear()
+            self.peak_bytes = 0
+            self._last_snapshot = None
+        metrics.add("cgx.mem.resets")
+        metrics.set("cgx.mem.leak_suspects", 0.0)
+        log.info("memledger reset (%s)", reason)
+
+    def rebind_rank(self, rank: int) -> None:
+        with self._lock:
+            self.rank = int(rank)
+
+    def _snapshot_path(self) -> Optional[str]:
+        directory = cfg.metrics_dir()
+        if not directory:
+            return None
+        return os.path.join(directory, f"mem-rank{self.rank}.jsonl")
+
+    def flush(self) -> Optional[Dict[str, Any]]:
+        """Sample and (when ``CGX_METRICS_DIR`` is set) append the
+        snapshot line. Never raises — same contract as the exporter."""
+        snap = self.sample()
+        path = self._snapshot_path()
+        if path:
+            try:
+                os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+                with open(path, "a") as f:
+                    f.write(json.dumps(snap) + "\n")
+            except (OSError, TypeError, ValueError) as e:
+                log.warning("memledger snapshot to %s failed: %s", path, e)
+        return snap
+
+    def start(self) -> "MemLedger":
+        self._thread = threading.Thread(
+            target=self._run, name="cgx-memledger", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._flush_s):
+            try:
+                self.flush()
+            except Exception as e:
+                # The accountant must never take down the workload it
+                # is counting for.
+                log.warning("memledger tick failed: %s", e)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+# ---------------------------------------------------------------------------
+# Process singleton + zero-cost hot-path shims (health.py discipline:
+# one global load when off).
+# ---------------------------------------------------------------------------
+
+_ledger: Optional[MemLedger] = None
+_ledger_lock = threading.Lock()
+
+
+def active() -> bool:
+    """True iff the process memory ledger is running."""
+    return _ledger is not None
+
+
+def get_ledger() -> Optional[MemLedger]:
+    return _ledger
+
+
+def maybe_start(rank: Optional[int] = None) -> Optional[MemLedger]:
+    """Start (idempotently) the process ledger iff ``CGX_MEMLEDGER`` is
+    set. Returns None — and starts nothing — otherwise. Late rank bind
+    follows flightrec's first-wins convention: an early caller that
+    doesn't know its rank starts as 0; the first caller with a nonzero
+    rank rebinds, so per-rank ``mem-rank<N>.jsonl`` files never
+    collide."""
+    global _ledger
+    if not cfg.memledger_enabled():
+        return None
+    with _ledger_lock:
+        if _ledger is None:
+            _ledger = MemLedger(rank or 0).start()
+        elif rank and _ledger.rank == 0:
+            _ledger.rebind_rank(rank)
+        return _ledger
+
+
+def stop() -> None:
+    """Stop and drop the process ledger (tests / explicit teardown)."""
+    global _ledger
+    with _ledger_lock:
+        led, _ledger = _ledger, None
+    if led is not None:
+        led.stop()
+
+
+def note_alloc(owner: str, n: int = 1, nbytes: int = 0) -> None:
+    """Hot-path alloc hook (one global load when the ledger is off).
+    Every call site needs a matching :func:`note_release`/reset partner
+    — the analyzer's mem-ledger-pairing pass enforces it."""
+    led = _ledger
+    if led is not None:
+        led.register_alloc(owner, n=n, nbytes=nbytes)
+
+
+def note_release(owner: str, n: int = 1, nbytes: int = 0) -> None:
+    """Hot-path release hook (one global load when the ledger is off)."""
+    led = _ledger
+    if led is not None:
+        led.register_release(owner, n=n, nbytes=nbytes)
+
+
+def reset_ledger(reason: str = "reset") -> None:
+    """Recovery-cascade entry point: reset the running ledger's derived
+    state (no-op when off)."""
+    led = _ledger
+    if led is not None:
+        led.reset(reason)
+
+
+def peak_mb() -> Optional[float]:
+    """The running ledger's peak total (MiB), or None when off — the
+    bench harness attaches this to every BENCH_LOG record. Samples once
+    if the periodic thread hasn't ticked yet (a short bench run must
+    not race the first flush into recording peak 0)."""
+    led = _ledger
+    if led is None:
+        return None
+    if led.last_snapshot() is None:
+        led.sample()
+    return led.peak_mb()

@@ -310,3 +310,95 @@ def aggregate_health_over_store(
         except OSError as e:
             log.warning("cluster health write failed: %s", e)
     return view
+
+
+_MEM_PREFIX = "cgxmem/agg"
+
+
+def aggregate_mem_over_store(
+    store,
+    rank: int,
+    world_size: int,
+    round_id: int = 0,
+    timeout_s: float = 3.0,
+) -> Optional[Dict]:
+    """Merge every rank's memory-ledger snapshot into one cluster view
+    on the leader (same contract as :func:`aggregate_health_over_store`:
+    bounded deadline, missing ranks named, never raises). Returns the
+    merged view on rank 0 — also appended to
+    ``CGX_METRICS_DIR/cluster-mem.jsonl`` when set — None elsewhere or
+    when this rank's ledger is not running."""
+    from .exporter import _bounded_store_get
+    from . import memledger as memledger_mod
+
+    led = memledger_mod.get_ledger()
+    if led is None:
+        return None
+    try:
+        snap = led.last_snapshot() or led.sample()
+        key = f"{_MEM_PREFIX}/{round_id}/r{rank}"
+        store.set(key, json.dumps(snap).encode())
+    except Exception as e:
+        log.warning("mem aggregation publish failed: %s", e)
+        return None
+    if rank != 0:
+        return None
+    per_rank: Dict[int, Dict] = {}
+    missing: List[int] = []
+    deadline = time.monotonic() + timeout_s
+    for r in range(world_size):
+        raw = _bounded_store_get(
+            store, f"{_MEM_PREFIX}/{round_id}/r{r}", deadline
+        )
+        if raw is None:
+            missing.append(r)
+            continue
+        try:
+            per_rank[r] = json.loads(bytes(raw).decode())
+        except (ValueError, UnicodeDecodeError):
+            missing.append(r)
+    # Worst pool by forecast: the rank/pool closest to its wall is the
+    # cluster's memory story in one line.
+    worst: Optional[Dict[str, Any]] = None
+    for r, snap_r in per_rank.items():
+        for row in snap_r.get("pools") or ():
+            tte = row.get("tte_s")
+            if tte is not None and (worst is None or tte < worst["tte_s"]):
+                worst = {"tte_s": tte, "pool": row.get("pool"), "rank": r}
+    view = {
+        "ts": round(time.time(), 6),
+        "round": round_id,
+        "world_size": world_size,
+        "ranks_reporting": sorted(per_rank),
+        "missing_ranks": missing,
+        "total_mb": round(
+            sum(s.get("total_mb") or 0.0 for s in per_rank.values()), 3
+        ),
+        "peak_mb_max": max(
+            (s.get("peak_mb") or 0.0 for s in per_rank.values()),
+            default=0.0,
+        ),
+        "nearest_exhaustion": worst,
+        "leak_suspects": sorted({
+            owner
+            for s in per_rank.values()
+            for f in s.get("findings") or ()
+            if f.get("kind") == "mem_leak"
+            for owner in (f.get("owner"),)
+            if owner
+        }),
+        "per_rank_total_mb": {
+            r: s.get("total_mb") for r, s in per_rank.items()
+        },
+    }
+    directory = cfg.metrics_dir()
+    if directory:
+        try:
+            os.makedirs(directory, exist_ok=True)
+            with open(
+                os.path.join(directory, "cluster-mem.jsonl"), "a"
+            ) as f:
+                f.write(json.dumps(view) + "\n")
+        except OSError as e:
+            log.warning("cluster mem write failed: %s", e)
+    return view

@@ -863,6 +863,7 @@ def make_train_step(
     # The histogram feeds cgx_top's step rate and the health engine's
     # regression detector; pure host bookkeeping, nothing staged changes.
     from ..observability import health as health_mod
+    from ..observability import memledger as memledger_mod
     from ..observability import watch as watch_mod
 
     # process_index, not 0: on the multi-process JAX path this is the
@@ -872,6 +873,7 @@ def make_train_step(
     # still gets rebound when ProcessGroupCGX passes the real rank.
     _rank_hint = jax.process_index()
     health_mod.maybe_start(_rank_hint)
+    memledger_mod.maybe_start(_rank_hint)
     watch_mod.maybe_start_prom(_rank_hint)
     step_clock = {"t": None}
 

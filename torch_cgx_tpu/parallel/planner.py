@@ -367,6 +367,51 @@ class CostModel:
             q, w = t_codec / c, t_wire
         return {"quantize": q, "wire": w, "overhead": c * self.chunk_overhead_s}
 
+    def memory_envelope(
+        self,
+        n: int,
+        ws: int,
+        bits: int,
+        bucket: int,
+        chunks: int = 1,
+    ) -> Dict[str, float]:
+        """Predicted peak staging bytes of one fusion slice's allreduce
+        under a (bits, chunks) decision — the memory-side twin of
+        :meth:`predict_slice` (GC3's footprint-as-compiler-input idea:
+        the planner should reject a plan that won't fit BEFORE the
+        arena's pressure path discovers it at runtime).
+
+        * ``fusion_bytes`` — the 4n f32 fusion buffer the slice
+          reduces (device-resident, chunk-independent).
+        * ``frame_bytes`` — the largest single arena put: one pipeline
+          chunk's wire frame, ``wire_bytes / chunks``.
+        * ``staging_bytes`` — host-arena bytes resident at the pipeline
+          steady state: double-buffered frames on both SRA stages
+          (``2 × frame_bytes`` per stage — one being filled, one
+          awaiting acks), so a deeper pipeline holds the same wire
+          bytes in smaller, sooner-reclaimed frames.
+        * ``total_bytes`` — fusion + staging: what one slice adds to
+          the rank's envelope while its collective is in flight.
+        """
+        n = int(n)
+        ws = max(1, int(ws))
+        if n <= 0 or ws == 1:
+            return {
+                "fusion_bytes": 0.0, "frame_bytes": 0.0,
+                "staging_bytes": 0.0, "total_bytes": 0.0,
+            }
+        c = max(1, int(chunks))
+        wire = self.wire_bytes(n, bits, bucket)
+        frame = wire / c
+        staging = 2.0 * 2.0 * frame
+        fusion = 4.0 * n
+        return {
+            "fusion_bytes": fusion,
+            "frame_bytes": frame,
+            "staging_bytes": staging,
+            "total_bytes": fusion + staging,
+        }
+
     # -- persistence (the CGX_PLANNER_MODEL group-consistency channel) --
 
     def as_dict(self) -> Dict:
@@ -639,6 +684,11 @@ def cache_key_component() -> Tuple:
         _PLAN_VERSION,
         cfg_mod.planner_avg_bits(),
         _model_fingerprint(cost_model()),
+        # Staging budget (ISSUE 18): the memory envelope gate changes
+        # which pipeline depths the solve may pick, so toggling
+        # CGX_MEMLEDGER (or resizing CGX_SHM_MAX_MB under it) must
+        # retrace. None when the ledger is off keeps unset bit-identical.
+        _staging_budget(),
     )
 
 
@@ -710,16 +760,40 @@ def _best_chunks(
     bits: int,
     cc: CompressionConfig,
     route: str,
+    staging_budget: Optional[int] = None,
 ) -> Tuple[int, float]:
     """argmin over feasible depths (ties prefer the shallower pipeline —
-    fewer store keys / smaller programs for the same predicted time)."""
+    fewer store keys / smaller programs for the same predicted time).
+
+    With a ``staging_budget`` (the memory-envelope filter, active only
+    under ``CGX_MEMLEDGER`` — the knob rides in the plan key), depths
+    whose predicted steady-state staging bytes exceed the budget are
+    rejected before the time argmin; when EVERY depth violates it, the
+    depth minimizing staging wins (the deepest pipeline — smallest
+    frames, soonest reclaim) so the solver still returns a plan and the
+    arena's pressure path stays the backstop, not the plan."""
     best_c, best_t = 1, float("inf")
+    fallback_c, fallback_m = 1, float("inf")
+    any_feasible = False
     for c in _slice_candidates(n, ws, cc):
+        if staging_budget is not None:
+            env = model.memory_envelope(
+                n, ws, bits, cc.bucket_size, chunks=c
+            )
+            if env["staging_bytes"] < fallback_m - 1e-9:
+                fallback_c, fallback_m = c, env["staging_bytes"]
+            if env["staging_bytes"] > staging_budget:
+                continue
+        any_feasible = True
         t = model.predict_slice(
             n, ws, bits, cc.bucket_size, chunks=c, route=route
         )
         if t < best_t - 1e-15:
             best_c, best_t = c, t
+    if staging_budget is not None and not any_feasible:
+        return fallback_c, model.predict_slice(
+            n, ws, bits, cc.bucket_size, chunks=fallback_c, route=route
+        )
     return best_c, best_t
 
 
@@ -730,6 +804,7 @@ def solve(
     model: Optional[CostModel] = None,
     route: str = "staged",
     avg_bits: float = 0.0,
+    staging_budget: Optional[int] = None,
 ) -> List[SliceDecision]:
     """The joint solve over all fusion slices of a step: per slice a
     (chunks, bits) pair minimizing the model's predicted step time.
@@ -758,7 +833,9 @@ def solve(
         # raw slices price (and report) as 32-bit — the brute-force
         # solver's convention, pinned equal by test
         bits = bits_by_idx.get(i, cc.bits) if cc.enabled else 32
-        chunks, t = _best_chunks(model, n, ws, bits, cc, route)
+        chunks, t = _best_chunks(
+            model, n, ws, bits, cc, route, staging_budget=staging_budget
+        )
         out.append(
             SliceDecision(
                 n=int(n), ws=int(ws), bits=int(bits), chunks=int(chunks),
@@ -876,8 +953,24 @@ def _plan_key(group_sig, ws, route, reduction) -> Tuple:
         _chip_fingerprint(),
         cfg_mod.registry_version(),
         _model_fingerprint(cost_model()),
+        # The memory-envelope staging budget (ISSUE 18): active only
+        # under CGX_MEMLEDGER, where it can veto pipeline depths — both
+        # the gate and the budget itself must key the cache, or a
+        # budget-filtered plan would be served to an unfiltered config
+        # (and vice versa). None when the ledger is off keeps unset
+        # bit-identical to the pre-ledger key.
+        _staging_budget(),
         _PLAN_VERSION,
     )
+
+
+def _staging_budget() -> Optional[int]:
+    """Per-slice host staging budget for the solver's envelope filter:
+    the arena cap (``CGX_SHM_MAX_MB``), the hard wall the pressure path
+    enforces at runtime. None = filter off (``CGX_MEMLEDGER`` unset)."""
+    if not cfg_mod.memledger_enabled():
+        return None
+    return cfg_mod.shm_max_mb() << 20
 
 
 def plan_for_layout(
@@ -915,7 +1008,10 @@ def plan_for_layout(
         spans.append((gi, len(g.slices)))
         for (_off, ln) in g.slices:
             flat.append((ln, g.cc))
-    decs = solve(flat, ws, model=model, route=route, avg_bits=avg_bits)
+    decs = solve(
+        flat, ws, model=model, route=route, avg_bits=avg_bits,
+        staging_budget=_staging_budget(),
+    )
     per_group: List[Tuple[SliceDecision, ...]] = []
     pos = 0
     for _gi, n_s in spans:

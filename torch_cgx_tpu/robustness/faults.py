@@ -43,6 +43,12 @@ mode                      effect at its injection site
                           its checksum is computed (``step=N`` picks the
                           N-th shipped page) — the joiner must re-request
                           the page, not wedge or silently diverge
+``leak_page``             a KV page whose last reference drops is never
+                          returned to the free list — the classic slow
+                          leak (alloc with suppressed release) that the
+                          memory ledger's sliding-window detector must
+                          name (``mem_leak`` on ``serve.kv_pool``)
+                          before the pool exhausts
 ========================  =====================================================
 
 Spec tokens: a bare float is a per-event probability; ``NNms``/``NNs`` a
@@ -92,6 +98,7 @@ MODES = (
     "flap",
     "preempt",
     "corrupt_join_page",
+    "leak_page",
 )
 
 PREEMPT_RESPAWN_ENV = "CGX_PREEMPT_RESPAWN"

@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import sys
 import time
+import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 from .. import config as cfg
@@ -193,7 +194,22 @@ def invalidate_trace_caches() -> None:
     critpath = sys.modules.get("torch_cgx_tpu.observability.critpath")
     if critpath is not None:
         critpath.invalidate_critpath_cache("recovery reconfigure")
+    # Memory ledger (ISSUE 18): the alloc/release window streams and
+    # pool free-level trends describe the dead generation's regime —
+    # carrying them across the epoch bump would fabricate a leak (the
+    # abandoned arena regions release in a burst) or a phantom
+    # exhaustion trend out of the reconfigure itself.
+    mem = sys.modules.get("torch_cgx_tpu.observability.memledger")
+    if mem is not None:
+        mem.reset_ledger("recovery reconfigure")
     metrics.add("cgx.recovery.trace_cache_invalidations")
+
+
+# Live supervisors, for the memory ledger's snapshot-ring sampler (the
+# ledger never holds a strong ref — a torn-down supervisor must stay
+# collectable). Dead supervisors self-evict.
+# cgx-analysis: allow(orphan-memo) — weak liveness set: each member's snapshot ring is bounded by policy.snapshot_keep and drains with its owner; clearing the set itself would only blind the memory ledger to live rings
+_LIVE_SUPERVISORS: "weakref.WeakSet" = weakref.WeakSet()
 
 
 class RecoverySupervisor:
@@ -238,6 +254,7 @@ class RecoverySupervisor:
         # decisions are step-synchronized across survivors.
         self._elastic = None
         health_mod.add_consumer(self.note_health_event)
+        _LIVE_SUPERVISORS.add(self)
 
     # -- introspection ----------------------------------------------------
 

@@ -33,6 +33,8 @@ from typing import Dict, List, Optional
 
 from .. import config as cfg_mod
 from ..config import CompressionConfig
+from ..observability import memledger
+from ..robustness import faults as faults_mod
 from ..utils.logging import get_logger, metrics
 from ..wire import edges
 
@@ -130,6 +132,44 @@ class PagedKvCache:
         with self._lock:
             return seq_id in self._seqs
 
+    def pool_stats(self) -> Dict[str, int]:
+        """One consistent snapshot of the pool's truth (the memory
+        ledger's sampler and the gauge publisher read this): dedup_pages
+        counts fork-shared page *copies avoided* (sum of refcounts above
+        1 — the shared-prefix economy, bytes that would exist without
+        fork); leaked = pages in neither the free list nor any refcount
+        (reachable only through ``invalidate``)."""
+        with self._lock:
+            return self._pool_stats_locked()
+
+    def _pool_stats_locked(self) -> Dict[str, int]:
+        live = len(self._refs)
+        free = len(self._free)
+        return {
+            "max_pages": self.max_pages,
+            "page_tokens": self.page_tokens,
+            "free_pages": free,
+            "live_pages": live,
+            "dedup_pages": sum(r - 1 for r in self._refs.values() if r > 1),
+            "leaked_pages": self.max_pages - free - live,
+            "seqs": len(self._seqs),
+            "generation": self.generation,
+        }
+
+    def publish_pool_gauges(self) -> Dict[str, int]:
+        """Refresh the ``cgx.serve.pool_*`` gauges from the pool's
+        current truth. Mutators call this inline; the memory ledger
+        calls it every sample tick so Prometheus scrapes BETWEEN decode
+        steps see live occupancy, not the value as of the last alloc."""
+        with self._lock:
+            return self._publish_gauges_locked()
+
+    def _publish_gauges_locked(self) -> Dict[str, int]:
+        st = self._pool_stats_locked()
+        metrics.set("cgx.serve.pool_free", float(st["free_pages"]))
+        metrics.set("cgx.serve.pool_dedup_pages", float(st["dedup_pages"]))
+        return st
+
     # -- allocation --------------------------------------------------------
 
     def alloc(self, seq_id: str) -> Optional[int]:
@@ -147,7 +187,8 @@ class PagedKvCache:
             e.pages.append(pid)
             e.tokens += self.page_tokens
             metrics.add("cgx.serve.pages_allocated")
-            metrics.set("cgx.serve.pool_free", float(len(self._free)))
+            self._publish_gauges_locked()
+            memledger.note_alloc("serve.kv_pool")
             return pid
 
     def fork(self, src_seq: str, dst_seq: str) -> List[int]:
@@ -167,6 +208,9 @@ class PagedKvCache:
                 pages=list(src.pages), tokens=src.tokens
             )
             metrics.add("cgx.serve.seq_forks")
+            # Fork changes dedup truth without touching the free list —
+            # the one mutator the old pool_free-only refresh missed.
+            self._publish_gauges_locked()
             return list(src.pages)
 
     def free_seq(self, seq_id: str) -> int:
@@ -179,6 +223,7 @@ class PagedKvCache:
             if e is None:
                 return 0
             freed = 0
+            injector = faults_mod.get_injector()
             for pid in e.pages:
                 n = self._refs.get(pid)
                 if n is None:
@@ -188,12 +233,22 @@ class PagedKvCache:
                     )
                 if n <= 1:
                     del self._refs[pid]
+                    if injector is not None and injector.fire("leak_page"):
+                        # Chaos leak: the page's last reference drops but
+                        # the page never reaches the free list — lost to
+                        # both the pool and the refcount map until an
+                        # invalidate rebuilds the free list. The ledger's
+                        # alloc−release delta for serve.kv_pool is what
+                        # must catch this (no note_release here — that
+                        # suppression IS the fault).
+                        continue
                     self._free.append(pid)
                     freed += 1
                 else:
                     self._refs[pid] = n - 1
             metrics.add("cgx.serve.pages_freed", float(freed))
-            metrics.set("cgx.serve.pool_free", float(len(self._free)))
+            self._publish_gauges_locked()
+            memledger.note_release("serve.kv_pool", n=freed)
             return freed
 
     # -- recovery ----------------------------------------------------------
@@ -206,12 +261,18 @@ class PagedKvCache:
         the scheduler treats a generation bump as a full eviction)."""
         with self._lock:
             dropped = len(self._seqs)
+            # Everything not on the free list comes back — including
+            # chaos-leaked pages — so the ledger's outstanding delta for
+            # this pool settles to zero here (the reset hook the
+            # mem-ledger-pairing pass pairs with alloc's note_alloc).
+            reclaimed = self.max_pages - len(self._free)
             self._seqs.clear()
             self._refs.clear()
             self._free = list(range(self.max_pages - 1, -1, -1))
             self.generation += 1
             metrics.add("cgx.serve.cache_invalidations")
-            metrics.set("cgx.serve.pool_free", float(self.max_pages))
+            self._publish_gauges_locked()
+            memledger.note_release("serve.kv_pool", n=reclaimed)
         log.info(
             "serving kv-cache invalidated (%s): %d sequence(s) dropped, "
             "generation -> %d", reason, dropped, self.generation,

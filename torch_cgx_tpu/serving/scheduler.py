@@ -49,7 +49,7 @@ from ..models.attention import decode_attention, dense_attention
 from ..models.gpt2 import GPT2Config
 from ..ops import codec_host
 from ..ops import paged_kv
-from ..observability import timeline
+from ..observability import memledger, timeline
 from ..utils.logging import get_logger, metrics
 from ..wire import dispatch as wire_dispatch
 from . import kv_cache as kv_mod
@@ -512,6 +512,10 @@ class ContinuousBatchScheduler:
         self.server = server
         sv = server.serve
         self._receiver = receiver
+        # A pure-serving process never touches the train paths that
+        # start the memory ledger, yet its KV pool is a primary ledger
+        # owner — arm it here too (no-op when CGX_MEMLEDGER is unset).
+        memledger.maybe_start()
         self.cache = kv_mod.PagedKvCache(sv.max_pages, sv.page_tokens)
         self._cache_gen = self.cache.generation
         self._prog = _decode_program(server)

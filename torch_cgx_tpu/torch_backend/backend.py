@@ -70,6 +70,7 @@ from .. import config as cfg
 from ..observability import exporter as obs_exporter
 from ..observability import flightrec
 from ..observability import health as health_mod
+from ..observability import memledger as memledger_mod
 from ..observability import timeline
 from ..observability import watch as watch_mod
 from ..ops import codec_host as hcodec
@@ -844,10 +845,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         flightrec.bind_rank(rank)
         timeline.bind_rank(rank)
         obs_exporter.start_exporter(rank)
-        # Live health plane (PR 6): the streaming evaluator (CGX_HEALTH)
-        # and the Prometheus endpoint (CGX_PROM_PORT) — both no-ops with
-        # their knobs unset, like the exporter above.
+        # Live health plane (PR 6): the streaming evaluator (CGX_HEALTH),
+        # the memory ledger (CGX_MEMLEDGER) and the Prometheus endpoint
+        # (CGX_PROM_PORT) — all no-ops with their knobs unset, like the
+        # exporter above.
         health_mod.maybe_start(rank)
+        memledger_mod.maybe_start(rank)
         watch_mod.maybe_start_prom(rank)
         metrics.set("cgx.recovery.generation", float(generation))
         metrics.set("cgx.recovery.ws", float(size))
@@ -3104,6 +3107,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # leader folds every rank's final health status into
         # cluster-health.jsonl over the same store control plane.
         watch_mod.aggregate_health_over_store(
+            self._store, self._rank, self._size, timeout_s=2.0
+        )
+        # Cluster memory view (no-op when the memledger is off): same
+        # merge shape — the leader folds every rank's final ledger
+        # snapshot into cluster-mem.jsonl.
+        watch_mod.aggregate_mem_over_store(
             self._store, self._rank, self._size, timeout_s=2.0
         )
 

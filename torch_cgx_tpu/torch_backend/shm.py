@@ -56,6 +56,7 @@ import socket
 import threading
 import time
 import uuid
+import weakref
 import zlib
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -63,6 +64,7 @@ import numpy as np
 
 from .. import config as cfg
 from ..observability import flightrec
+from ..observability import memledger
 from ..observability import timeline
 from ..robustness import faults as faults_mod
 from ..robustness import retry as retry_mod
@@ -178,7 +180,7 @@ def _reap_dead_arenas(directory: str) -> None:
 
 class _Region:
     __slots__ = ("gen", "off", "size", "ack_key", "readers", "freed",
-                 "copying")
+                 "copying", "t_birth")
 
     def __init__(self, gen: int, off: int, size: int, ack_key: str, readers: int):
         self.gen = gen
@@ -187,6 +189,10 @@ class _Region:
         self.ack_key = ack_key
         self.readers = readers
         self.freed = False
+        # Allocation timestamp (monotonic): the pressure post-mortem and
+        # the memory ledger's region table report age, so a dump names
+        # the *hoarder* (oldest un-acked owner), not just the symptom.
+        self.t_birth = time.monotonic()
         # Payload memcpy in flight outside the arena lock (ShmArena.write):
         # an epoch-bump abandon must not mark this region freed — freed
         # bytes can be re-allocated, and the new frame would interleave
@@ -247,6 +253,13 @@ class _GenFile:
                 pass
 
 
+# Live arenas, for the memory ledger's pull-model samplers (the ledger
+# never holds a strong ref — a closed bridge's arena must stay
+# collectable). Dead arenas self-evict.
+# cgx-analysis: allow(orphan-memo) — weak liveness set: each member's bytes drain through abandon_pending/close (reached from the recovery cascade); clearing the set itself would only blind the memory ledger to live arenas
+_LIVE_ARENAS: "weakref.WeakSet" = weakref.WeakSet()
+
+
 class ShmArena:
     """Writer-owned payload ring (grow-don't-block reclaim policy, capped
     at ``max_bytes`` total — past the cap, writes enter a bounded
@@ -282,9 +295,76 @@ class ShmArena:
             else (bt / 1000.0 if bt else 60.0)
         )
         self._new_gen(min_capacity)
+        _LIVE_ARENAS.add(self)
 
     def path_of(self, gen: int) -> str:
         return os.path.join(self._dir, f"{self._name}-g{gen}")
+
+    def region_table(self, limit: int = 8) -> List[Dict[str, object]]:
+        """Oldest-first table of pending regions (owner = ack key, age,
+        size, gen, acked-or-not) — the pressure post-mortem attachment
+        and the ledger's fragmentation forensics. No Store RPCs: the
+        freed flag reflects the last reclaim pass, which is exactly the
+        state the stalled writer saw."""
+        now = time.monotonic()
+        with self._lock:
+            pend = list(self._pending)
+        pend.sort(key=lambda r: r.t_birth)
+        return [
+            {
+                "owner": r.ack_key or "<wrap-filler>",
+                "gen": r.gen,
+                "off": r.off,
+                "size": r.size,
+                "age_s": round(now - r.t_birth, 3),
+                "readers": r.readers,
+                "freed": r.freed,
+            }
+            for r in pend[: max(limit, 1)]
+        ]
+
+    def mem_stats(self) -> Dict[str, object]:
+        """Occupancy + fragmentation snapshot for the memory ledger.
+
+        Free extents per generation ring follow straight from the bump
+        allocator's head/tail: empty ring = one extent of ``capacity``;
+        ``head >= tail`` (no wrap outstanding) = the two edge extents
+        ``[head, capacity)`` and ``[0, tail)``; ``head < tail`` (wrapped)
+        = the single middle extent ``[head, tail)``. Fragmentation is
+        1 − largest-free-extent / total-free (0.0 = one contiguous hole,
+        → 1.0 = free bytes shattered across rings); a multi-generation
+        arena is inherently fragmented because no extent spans files."""
+        extents: List[int] = []
+        with self._lock:
+            capacity = sum(gf.capacity for gf in self._gens.values())
+            live = sum(gf.live for gf in self._gens.values())
+            for gf in self._gens.values():
+                if gf.live == 0:
+                    extents.append(gf.capacity)
+                elif gf.live >= gf.capacity:
+                    pass  # full ring: no free extent
+                elif gf.head >= gf.tail:
+                    extents.extend(
+                        e for e in (gf.capacity - gf.head, gf.tail) if e > 0
+                    )
+                else:
+                    extents.append(gf.tail - gf.head)
+            pending = len(self._pending)
+            gens = len(self._gens)
+        total_free = sum(extents)
+        largest = max(extents) if extents else 0
+        frag = (1.0 - largest / total_free) if total_free > 0 else 0.0
+        return {
+            "name": self._name,
+            "gens": gens,
+            "capacity_bytes": capacity,
+            "live_bytes": live,
+            "free_bytes": total_free,
+            "largest_free_bytes": largest,
+            "frag": round(frag, 4),
+            "pending_regions": pending,
+            "cap_bytes": self._max_bytes,
+        }
 
     def _new_gen(self, capacity: int) -> None:
         self._gen += 1
@@ -326,6 +406,10 @@ class ShmArena:
                 still.append(r)
         # Out-of-order acks: a freed region behind an unfreed one stays in
         # `still` (its bytes aren't reusable yet) — keep it for next pass.
+        kept = {id(r) for r in still}
+        for r in self._pending:
+            if id(r) not in kept and r.ack_key:
+                memledger.note_release("shm.arena", nbytes=r.size)
         self._pending = [r for r in still]
         for g, gf in list(self._gens.items()):
             if g != self._gen and gf.live == 0 and gf.pins == 0 and not any(
@@ -410,6 +494,7 @@ class ShmArena:
                     region.copying = True
                     self._pending.append(region)
                     gf.pins += 1
+                    memledger.note_alloc("shm.arena", nbytes=size)
             if off >= 0:
                 try:
                     t_copy = time.perf_counter()
@@ -447,8 +532,13 @@ class ShmArena:
                     f"not draining — {detail}; a reader is dead or stalled",
                     key=stalled.ack_key if stalled is not None else None,
                 )
+                # Post-mortem forensics: the per-region owner/age/size
+                # table names the hoarder (oldest un-acked ack key), not
+                # just the pressure symptom — without it a dump says "at
+                # cap" and nothing about WHOSE bytes pinned the ring.
                 flightrec.record_failure(
-                    err, op="shm.put", key=err.key, bytes=len(data)
+                    err, op="shm.put", key=err.key, bytes=len(data),
+                    regions=self.region_table(limit=8),
                 )
                 raise err
             metrics.add("cgx.arena_pressure_waits")
