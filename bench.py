@@ -2248,6 +2248,217 @@ def bench_rejoin(mb: int = 8, ws: int = 2, donors: int = 1,
     }
 
 
+# ---------------------------------------------------------------------------
+# Socket transport vs store fallback (ISSUE 20): the same bridge
+# allreduce through both cross-process byte planes — CGX_TRANSPORT=socket
+# (push-mode frames over supervised TCP links) vs the legacy store path
+# (publish + bounded-poll get) — with CGX_SHM=0 in both children so the
+# contrast is purely the transport, a crc bit-equality pre-flight (the
+# socket plane must be a byte-identical carrier), and a small-message
+# latency contrast: the store path pays a poll tick per take, the socket
+# plane wakes on frame arrival, so small collectives are expected >= 2x
+# faster. A LinkThrottle-modeled slow-link row prices the same payload
+# through a constrained link (the serving plane's byte-proportional
+# model) against the model's own serialization time.
+# ---------------------------------------------------------------------------
+
+
+def _transport_bridge_rank(rank, ws, initfile, mb, iters, small_iters,
+                           mode, q):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["CGX_SHM"] = "0"  # isolate the cross-process byte plane
+    if mode == "socket":
+        os.environ["CGX_TRANSPORT"] = "socket"
+    else:
+        os.environ.pop("CGX_TRANSPORT", None)
+    import zlib
+
+    import torch
+    import torch.distributed as dist
+
+    import torch_cgx_tpu.torch_backend  # noqa: F401 — registers "cgx"
+
+    n = mb * 2**20 // 4
+    base = torch.arange(n, dtype=torch.float32) / n - 0.5
+    big = (rank + 1) * base
+    small = ((rank + 1) * base[:1024]).clone()
+    dist.init_process_group(
+        "cgx", init_method=f"file://{initfile}", rank=rank, world_size=ws
+    )
+    try:
+        res = big.clone()
+        dist.all_reduce(res)  # warm (arena growth) + crc capture
+        dist.barrier()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            dist.all_reduce(big)
+        dist.barrier()
+        t_big = (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        for _ in range(small_iters):
+            dist.all_reduce(small)
+        dist.barrier()
+        t_small = (time.perf_counter() - t0) / small_iters
+        if rank == 0:
+            q.put({
+                "t_big_ms": t_big * 1e3,
+                "t_small_ms": t_small * 1e3,
+                "crc": zlib.crc32(res.numpy().tobytes()),
+            })
+    finally:
+        dist.destroy_process_group()
+
+
+def _transport_bridge_child(mb, ws, iters, small_iters, mode):
+    """Child: time the bridge allreduce over one transport mode (ws real
+    processes); one JSON line."""
+    import multiprocessing as mp
+    import tempfile
+
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    with tempfile.TemporaryDirectory() as d:
+        initfile = os.path.join(d, "init")
+        procs = [
+            ctx.Process(
+                target=_transport_bridge_rank,
+                args=(r, ws, initfile, mb, iters, small_iters, mode, q),
+            )
+            for r in range(ws)
+        ]
+        for p in procs:
+            p.start()
+        try:
+            rec = q.get(timeout=600)
+        finally:
+            for p in procs:
+                p.join(timeout=60)
+                if p.is_alive():
+                    p.terminate()
+    print(json.dumps(rec))
+
+
+def _transport_throttle_row(mb: int = 4, gbps: float = 0.5) -> dict:
+    """LinkThrottle-modeled slow-link row: one SocketTransport pair in
+    this process, the sender constrained by the serving plane's
+    byte-proportional LinkThrottle at ``gbps`` — measured wall clock for
+    an ``mb``-MB post+fetch vs the model's own serialization time."""
+    import threading as _threading
+
+    from torch_cgx_tpu.serving.transport import LinkThrottle
+    from torch_cgx_tpu.torch_backend import transport as _tp
+
+    class _DictStore:
+        def __init__(self):
+            self._d = {}
+            self._lock = _threading.Lock()
+
+        def set(self, k, v):
+            with self._lock:
+                self._d[k] = bytes(v)
+
+        def get(self, k):
+            with self._lock:
+                return self._d[k]
+
+        def check(self, keys):
+            with self._lock:
+                return all(k in self._d for k in keys)
+
+    store = _DictStore()
+
+    def addr(p):
+        return f"tpbench/addr/{p}"
+
+    tx = _tp.SocketTransport(
+        store, "0", addr, rank=0, io_timeout_s=10.0,
+        throttle=LinkThrottle(gbps),
+    )
+    rx = _tp.SocketTransport(store, "1", addr, rank=1, io_timeout_s=10.0)
+    payload = os.urandom(mb * 2**20)
+    try:
+        tx.post("tpbench/warm", b"x" * 64, to=("1",))
+        rx.fetch("tpbench/warm", timeout_s=10.0, peer="0")
+        t0 = time.perf_counter()
+        tx.post("tpbench/pay", payload, to=("1",))
+        got = rx.fetch("tpbench/pay", timeout_s=120.0, peer="0")
+        dt = time.perf_counter() - t0
+    finally:
+        tx.close()
+        rx.close()
+    if got != payload:
+        raise RuntimeError("throttled socket roundtrip corrupted payload")
+    modeled_s = len(payload) / (gbps * 1e9)
+    return {
+        "gbps": gbps,
+        "payload_MB": mb,
+        "measured_ms": round(dt * 1e3, 3),
+        "modeled_ms": round(modeled_s * 1e3, 3),
+        "measured_gbps": round(len(payload) / 1e9 / dt, 4),
+    }
+
+
+def bench_transport(mb: int = 4, ws: int = 2, iters: int = 10,
+                    small_iters: int = 40) -> dict:
+    """Socket-vs-store data-plane record (the ISSUE 20 acceptance row).
+    Children are fresh spawned process groups (the transport engages at
+    backend construction, so the mode must be in the env before init)."""
+    me = str(Path(__file__).resolve())
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    for k in ("CGX_FAULTS", "CGX_TRANSPORT", "CGX_SHM",
+              "CGX_SHM_HOST_ID"):
+        env.pop(k, None)
+    args = [str(mb), str(ws), str(iters), str(small_iters)]
+    store = _run_json_child(
+        [sys.executable, me, "--transport-bridge-child", *args, "store"],
+        env,
+    )
+    sock = _run_json_child(
+        [sys.executable, me, "--transport-bridge-child", *args, "socket"],
+        env,
+    )
+    if store["crc"] != sock["crc"]:
+        raise RuntimeError(
+            "transport crc pre-flight failed: store crc "
+            f"{store['crc']:#010x} != socket crc {sock['crc']:#010x} — "
+            "the socket plane must be a byte-identical carrier"
+        )
+    small_speedup = (
+        store["t_small_ms"] / sock["t_small_ms"]
+        if sock["t_small_ms"] else 0.0
+    )
+    big_speedup = (
+        store["t_big_ms"] / sock["t_big_ms"] if sock["t_big_ms"] else 0.0
+    )
+    gbytes = mb * 2**20 / 1e9
+    return {
+        "metric": f"transport_socket_vs_store_{mb}MB_x{ws}",
+        "value": round(gbytes / (sock["t_big_ms"] / 1e3), 3),
+        "unit": "GB/s",
+        "vs_baseline": round(big_speedup, 3),
+        "backend": "host",
+        "chip": "host",
+        "detail": {
+            "ws": ws,
+            "payload_MB": mb,
+            "iters": iters,
+            "small_iters": small_iters,
+            "t_big_socket_ms": round(sock["t_big_ms"], 3),
+            "t_big_store_ms": round(store["t_big_ms"], 3),
+            "t_small_socket_ms": round(sock["t_small_ms"], 3),
+            "t_small_store_ms": round(store["t_small_ms"], 3),
+            "small_msg_speedup": round(small_speedup, 3),
+            "small_msg_expectation": ">=2x — the store take pays a poll "
+                                     "tick, the socket fetch wakes on "
+                                     "frame arrival",
+            "crc_preflight": "bit-identical",
+            "slow_link": _transport_throttle_row(mb=min(mb, 4)),
+            "bridge": "ProcessGroupCGX, ws real processes, CGX_SHM=0 "
+                      "both modes; socket mode adds CGX_TRANSPORT=socket",
+        },
+    }
+
+
 def main() -> None:
     argv = sys.argv[1:]
     if argv and argv[0] == "--xla-allreduce-staged-child":
@@ -2295,6 +2506,34 @@ def main() -> None:
         results = bench_serve(**kw)
         rc = _gate_and_log(results)
         print(json.dumps(results))
+        sys.exit(rc)
+    if argv and argv[0] == "--transport-bridge-child":
+        _transport_bridge_child(
+            int(argv[1]), int(argv[2]), int(argv[3]), int(argv[4]), argv[5]
+        )
+        return
+    if argv and argv[0] == "--transport":
+        # Socket-vs-store transport record (tools/hw_session.sh queues
+        # this): bridge children are fresh CPU-pinned process groups —
+        # runs on any box without touching the device transport.
+        _preflight_lint()
+        kw = {}
+        for flag, name in (("--mb", "mb"), ("--ws", "ws"),
+                           ("--iters", "iters"),
+                           ("--small-iters", "small_iters")):
+            if flag in argv:
+                idx = argv.index(flag) + 1
+                val = argv[idx] if idx < len(argv) else ""
+                try:
+                    kw[name] = int(val)
+                except ValueError:
+                    sys.exit(
+                        f"bench: {flag} requires an integer value, "
+                        f"got {val!r}"
+                    )
+        result = bench_transport(**kw)
+        rc = _gate_and_log([result])
+        print(json.dumps(result))
         sys.exit(rc)
     if argv and argv[0] == "--rejoin-child":
         _rejoin_child(int(argv[1]), int(argv[2]), int(argv[3]))

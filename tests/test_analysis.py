@@ -540,6 +540,50 @@ def test_cross_thread_write_with_common_lock_is_clean(tmp_path):
     assert found == [], [f.render() for f in found]
 
 
+# The socket transport (PR 20) guards all of its cross-thread state with
+# threading.Condition — `with cond:` acquires the condition's underlying
+# lock, so the discipline pass must treat a Condition exactly like a
+# Lock: a common-Condition write/read pair is clean, dropping the guard
+# on the writer side is one thread-shared-write finding.
+_COND_TMPL = """\
+import threading
+
+
+class PeerLink:
+    def __init__(self):
+        self._cond = threading.Condition()
+        self.seq = 0
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        {write}
+
+    def read(self):
+        {read}
+"""
+
+
+def test_cross_thread_write_under_condition_is_clean(tmp_path):
+    found = _lock_findings(tmp_path, _COND_TMPL.format(
+        write="with self._cond:\n            self.seq = 1",
+        read="with self._cond:\n            return self.seq",
+    ))
+    assert found == [], [f.render() for f in found]
+
+
+def test_unlocked_write_beside_condition_flagged(tmp_path):
+    # The firing twin: same class, writer skips the Condition the reader
+    # holds — exactly the transport.py bug class the sweep caught
+    # (last_send_t / resends bumped outside self._cond).
+    found = _lock_findings(tmp_path, _COND_TMPL.format(
+        write="self.seq = 1",
+        read="with self._cond:\n            return self.seq",
+    ))
+    assert len(found) == 1, [f.render() for f in found]
+    assert found[0].rule == "thread-shared-write"
+    assert "self.seq" in found[0].message or "'self.seq'" in found[0].message
+
+
 def test_inverting_one_lock_pair_is_one_finding(tmp_path):
     # The acceptance mutation: the clean twin passes, the scratch-branch
     # inversion of f2's nesting produces exactly one finding.

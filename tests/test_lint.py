@@ -1001,3 +1001,122 @@ def test_linter_accepts_serve_metric_namespace(tmp_path):
     proc = _run_lint(bad)
     assert proc.returncode == 1
     assert "sreve" in proc.stdout
+
+
+def test_linter_flags_unbounded_socket_recv(tmp_path):
+    # The transport gate (ISSUE 20 satellite): blocking socket i/o with
+    # no deadline in scope is the data-plane twin of an unbounded wait —
+    # a cut link becomes a hang instead of a reconnect verdict.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "def pump(sock):\n"
+        "    data = sock.recv(4)\n"
+        "    return data\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "unbounded socket i/o" in proc.stdout
+
+
+def test_linter_flags_settimeout_none_and_setblocking_true(tmp_path):
+    # Both forms silently re-arm infinite-block mode; each is a finding
+    # on its own line.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "def rearm(sock):\n"
+        "    sock.settimeout(None)\n\n\n"
+        "def rearm2(sock):\n"
+        "    sock.setblocking(True)\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "settimeout(None)" in proc.stdout
+    assert "setblocking(True)" in proc.stdout
+
+
+def test_linter_flags_leaked_socket_creation(tmp_path):
+    # A socket created with neither a failure-path close() nor attribute
+    # ownership leaks the fd on every reconnect attempt.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "import socket\n\n\n"
+        "def dial(addr, io_timeout_s):\n"
+        "    s = socket.create_connection(addr, timeout=io_timeout_s)\n"
+        "    s.sendall(b'hello')\n"
+        "    return s\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "leaks the fd" in proc.stdout
+
+
+def test_linter_accepts_bounded_owned_socket_io(tmp_path):
+    # The clean twin mirrors transport.py's own idiom: timeout= at the
+    # creation site, close() on the failure path, ownership handed to an
+    # attribute, and every recv under an armed deadline.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "good.py"
+    good.write_text(
+        "import socket\n\n\n"
+        "class Link:\n"
+        "    def dial(self, addr, io_timeout_s):\n"
+        "        s = socket.create_connection(addr, timeout=io_timeout_s)\n"
+        "        try:\n"
+        "            s.settimeout(io_timeout_s)\n"
+        "        except OSError:\n"
+        "            s.close()\n"
+        "            raise\n"
+        "        self._sock = s\n\n"
+        "    def pump(self):\n"
+        "        self._sock.settimeout(2.0)\n"
+        "        return self._sock.recv(4)\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_socket_gate_scoped_to_library(tmp_path):
+    # Outside torch_cgx_tpu/ (tools, tests, examples) the same code is
+    # fine — the discipline is a library data-plane contract.
+    odir = tmp_path / "elsewhere"
+    odir.mkdir()
+    out = odir / "probe.py"
+    out.write_text(
+        "def pump(sock):\n"
+        "    data = sock.recv(4)\n"
+        "    return data\n"
+    )
+    proc = _run_lint(out)
+    assert proc.returncode == 0, proc.stdout
+
+
+def test_linter_accepts_transport_metric_namespace(tmp_path):
+    # `cgx.transport.*` is a documented sub-namespace (the ISSUE 20
+    # family); a typo'd family still fails.
+    ldir = tmp_path / "torch_cgx_tpu"
+    ldir.mkdir()
+    good = ldir / "mod.py"
+    good.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.transport.resends')\n"
+        "    metrics.add('cgx.transport.reconnects')\n"
+    )
+    proc = _run_lint(good)
+    assert proc.returncode == 0, proc.stdout
+    bad = ldir / "bad.py"
+    bad.write_text(
+        "from torch_cgx_tpu.utils.logging import metrics\n"
+        "def f():\n"
+        "    metrics.add('cgx.trnsport.resends')\n"
+    )
+    proc = _run_lint(bad)
+    assert proc.returncode == 1
+    assert "trnsport" in proc.stdout

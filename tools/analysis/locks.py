@@ -41,7 +41,11 @@ from .report import Finding
 
 DEFAULT_SCOPES = ("torch_backend", "observability", "parallel/async_plane.py")
 
-_LOCK_CTORS = {"Lock", "RLock"}
+# Condition joined with the socket transport (PR 20): ``with cond:``
+# acquires the condition's underlying (R)Lock, so a Condition IS a lock
+# for ordering/blocking/shared-write purposes — the transport's per-link
+# sender protocol is built entirely on one.
+_LOCK_CTORS = {"Lock", "RLock", "Condition"}
 _SOCKET_BLOCKING = {"recv", "recvfrom", "accept", "connect", "sendall"}
 
 LockId = Tuple[str, str, str]  # (module, owner ("" = module scope), attr)

@@ -446,10 +446,14 @@ _METRIC_CGX_SUBNAMESPACES = frozenset({
     # used/free/tte/frag gauges, the total/peak high-water gauges,
     # leak-suspect and sample counters, and the mem_leak/mem_pressure
     # event counters — docs/OBSERVABILITY.md "Memory plane".
+    # "transport" is the supervised socket data plane (PR 20): framed
+    # tx/rx counters, ack/ping/resend/reconnect counters, crc/dedup
+    # drops, link_down + degraded-edge gauges and the store-fallback
+    # counters — docs/OBSERVABILITY.md "Network transport".
     "async", "codec", "collective", "critpath", "elastic", "faults",
     "flightrec", "health", "heartbeat", "mem", "plan", "qerr",
     "recovery", "ring", "runtime", "sched", "serve", "shm", "sra",
-    "step", "trace", "wire", "xla",
+    "step", "trace", "transport", "wire", "xla",
 })
 
 
@@ -1277,6 +1281,128 @@ def check_health_event_kinds(path: Path, tree: ast.Module) -> List[str]:
     ]
 
 
+_SOCKET_IO_CALLS = frozenset({
+    "recv", "recv_into", "recvfrom", "accept", "connect", "connect_ex",
+})
+_SOCKET_CREATE_CALLS = frozenset({"socket", "create_connection"})
+
+
+def check_transport_bounded_io(path: Path, tree: ast.Module) -> List[str]:
+    """Socket-plane discipline gate (PR 20), scoped to torch_cgx_tpu/:
+
+    * every function performing blocking socket i/o (``recv*`` /
+      ``accept`` / ``connect``) must arm a deadline in the same scope —
+      a ``settimeout(...)`` call, a ``timeout=`` keyword, or a
+      deadline/timeout-named binding. An unbounded recv is the
+      transport's version of an unbounded wait: a cut link becomes a
+      hang instead of a reconnect/degrade verdict (docs/ROBUSTNESS.md
+      "Network transport").
+    * ``settimeout(None)`` and ``setblocking(True)`` are forbidden
+      outright — both silently re-arm the infinite-block mode the
+      whole plane is designed to exclude.
+    * a function that CREATES a socket (``socket.socket(...)`` /
+      ``create_connection(...)``) must either close it on the failure
+      path (a ``.close()`` inside a ``try`` handler/finally) or hand
+      ownership to an attribute (``self._sock = ...``) whose owner's
+      ``close()`` is supervised — otherwise a mid-construction raise
+      leaks the fd every reconnect attempt."""
+    if _LIB_DIR not in path.parts:
+        return []
+    findings: List[str] = []
+    funcs = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+    for fn_node in funcs:
+        io_lines: List[int] = []
+        creates: List[int] = []
+        bounded = False
+        closed_in_handler = False
+        attr_owned = False
+        for n in ast.walk(fn_node):
+            if isinstance(n, ast.Call):
+                f = n.func
+                name = (
+                    f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else ""
+                )
+                if name in _SOCKET_IO_CALLS and isinstance(
+                    f, ast.Attribute
+                ):
+                    io_lines.append(n.lineno)
+                if name in _SOCKET_CREATE_CALLS:
+                    # socket.socket(...) / socket.create_connection(...)
+                    # — the bare Name form (a local helper called
+                    # ``socket``) is not a creation site.
+                    if isinstance(f, ast.Attribute):
+                        creates.append(n.lineno)
+                if name == "settimeout":
+                    if n.args and isinstance(
+                        n.args[0], ast.Constant
+                    ) and n.args[0].value is None:
+                        findings.append(
+                            f"{path}:{n.lineno}: settimeout(None) re-arms "
+                            "unbounded blocking socket i/o — arm a real "
+                            "deadline (docs/ROBUSTNESS.md)"
+                        )
+                    else:
+                        bounded = True
+                if name == "setblocking" and n.args and isinstance(
+                    n.args[0], ast.Constant
+                ) and n.args[0].value is True:
+                    findings.append(
+                        f"{path}:{n.lineno}: setblocking(True) re-arms "
+                        "unbounded blocking socket i/o — use settimeout "
+                        "with a bounded deadline"
+                    )
+                if any(
+                    kw.arg and "timeout" in kw.arg.lower()
+                    for kw in n.keywords
+                ):
+                    bounded = True
+            elif isinstance(n, ast.Name) and any(
+                m in n.id.lower() for m in _BOUND_MARKERS
+            ):
+                bounded = True
+            elif isinstance(n, ast.Attribute) and any(
+                m in n.attr.lower() for m in _BOUND_MARKERS
+            ):
+                bounded = True
+            elif isinstance(n, ast.Assign):
+                for t in n.targets:
+                    if isinstance(t, ast.Attribute):
+                        attr_owned = True
+        for n in ast.walk(fn_node):
+            if not isinstance(n, ast.Try):
+                continue
+            cleanup = list(n.finalbody)
+            for h in n.handlers:
+                cleanup.extend(h.body)
+            for c in cleanup:
+                for cn in ast.walk(c):
+                    if (
+                        isinstance(cn, ast.Call)
+                        and isinstance(cn.func, ast.Attribute)
+                        and cn.func.attr == "close"
+                    ):
+                        closed_in_handler = True
+        if io_lines and not bounded:
+            findings.append(
+                f"{path}:{io_lines[0]}: unbounded socket i/o: "
+                f"'{fn_node.name}' calls recv/connect/accept without a "
+                "settimeout/deadline in scope — a cut link becomes a "
+                "hang instead of a reconnect verdict"
+            )
+        if creates and not (closed_in_handler or attr_owned):
+            findings.append(
+                f"{path}:{creates[0]}: socket created in "
+                f"'{fn_node.name}' with no failure-path close() and no "
+                "attribute ownership — a mid-construction raise leaks "
+                "the fd on every reconnect attempt"
+            )
+    return findings
+
+
 # ---------------------------------------------------------------------------
 # The registry + driver.
 # ---------------------------------------------------------------------------
@@ -1286,6 +1412,7 @@ RuleFn = Callable[[Path, ast.Module], List[str]]
 RULES: "OrderedDict[str, RuleFn]" = OrderedDict([
     ("undefined-name", check_undefined_names),
     ("unbounded-wait", check_unbounded_waits),
+    ("transport-bounded-io", check_transport_bounded_io),
     ("exception-hygiene", check_exception_hygiene),
     ("library-hygiene", check_library_hygiene),
     ("timeline-coverage", check_worker_timeline_coverage),

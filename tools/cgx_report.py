@@ -72,6 +72,7 @@ def summarize(data: dict) -> dict:
                      "wire": {}}
     recovery_events: List[dict] = []
     membership_events: List[dict] = []
+    transport_events: List[dict] = []
     coll_time: Dict[str, float] = defaultdict(float)
     coll_n: Dict[str, int] = defaultdict(int)
     ratios: Dict[str, List[float]] = defaultdict(list)
@@ -164,6 +165,13 @@ def summarize(data: dict) -> dict:
                 if kind == "recovery_retry":
                     row["phase"] = "retry"
                 recovery_events.append(row)
+            elif kind in ("transport_link_down", "transport_reconnect"):
+                transport_events.append({
+                    "rank": rank, "kind": kind[len("transport_"):],
+                    "peer": ev.get("peer"), "why": ev.get("why"),
+                    "flushed": ev.get("flushed"),
+                    "replay": ev.get("replay"), "ts": ev.get("ts"),
+                })
             elif kind == "elastic":
                 row = {"rank": rank, "ts": ev.get("ts")}
                 row.update(
@@ -246,6 +254,10 @@ def summarize(data: dict) -> dict:
     )
     for k in [k for k in totals if k.startswith(_SERVE_GAUGE_PREFIXES)]:
         del totals[k]
+    # The socket transport's degraded-edge count is a level too (how
+    # many peer links are CURRENTLY on the store fallback) — 4 ranks
+    # each reporting 1 degraded edge is 1 edge per rank, not 4 summed.
+    totals.pop("cgx.transport.degraded_edges", None)
     summary["counters"] = dict(totals)
     summary["faults"] = {
         k[len("cgx.faults."):]: int(v)
@@ -478,6 +490,55 @@ def summarize(data: dict) -> dict:
             ),
             "counters": serve_counters,
         }
+    # Socket transport plane (ISSUE 20): frame/byte tallies and the
+    # supervisor's recovery counters sum across ranks; degraded_edges is
+    # a level (max within a rank, summed across ranks would double-count
+    # nothing but max across ranks hides per-rank edges — each rank
+    # supervises its OWN links, so the cluster-wide edge count is the
+    # SUM of each rank's latest level). The link_down / reconnect event
+    # rows give the per-edge story in time order.
+    tp_counters = {
+        k: v for k, v in totals.items() if k.startswith("cgx.transport.")
+    }
+    deg_by_rank: Dict[int, float] = {}
+    for rank, per_rank in rank_counters.items():
+        v = per_rank.get("cgx.transport.degraded_edges")
+        if v:
+            deg_by_rank[rank] = max(deg_by_rank.get(rank, 0.0), v)
+    for rank, lines in data["metrics"].items():
+        if not lines:
+            continue
+        g = (lines[-1].get("gauges") or {}).get(
+            "cgx.transport.degraded_edges"
+        )
+        if isinstance(g, (int, float)) and g:
+            deg_by_rank[rank] = max(deg_by_rank.get(rank, 0.0), g)
+    deg_edges = sum(deg_by_rank.values())
+    if tp_counters or transport_events or deg_edges:
+        summary["transport"] = {
+            "posts": int(tp_counters.get("cgx.transport.posts", 0)),
+            "frames_tx": int(tp_counters.get("cgx.transport.frames_tx", 0)),
+            "frames_rx": int(tp_counters.get("cgx.transport.frames_rx", 0)),
+            "bytes_tx": int(tp_counters.get("cgx.transport.bytes_tx", 0)),
+            "bytes_rx": int(tp_counters.get("cgx.transport.bytes_rx", 0)),
+            "resends": int(tp_counters.get("cgx.transport.resends", 0)),
+            "reconnects": int(
+                tp_counters.get("cgx.transport.reconnects", 0)
+            ),
+            "crc_drops": int(tp_counters.get("cgx.transport.crc_drops", 0)),
+            "dedup_drops": int(
+                tp_counters.get("cgx.transport.dedup_drops", 0)
+            ),
+            "link_down": int(tp_counters.get("cgx.transport.link_down", 0)),
+            "degraded_posts": int(
+                tp_counters.get("cgx.transport.degraded_posts", 0)
+            ),
+            "degraded_edges": int(deg_edges),
+            "events": sorted(
+                transport_events, key=lambda e: (e.get("ts") or 0)
+            ),
+            "counters": tp_counters,
+        }
     if data["cluster"]:
         summary["cluster"] = data["cluster"][-1]
     return summary
@@ -693,6 +754,48 @@ def render(summary: dict) -> str:
                 "(streams degraded to local prefill)"
             )
         for k, v in sorted(s.get("counters", {}).items()):
+            parts.append(f"  {k}: {v:g}")
+    if summary.get("transport"):
+        t = summary["transport"]
+        parts.append("\n== transport (supervised socket data plane) ==")
+        parts.append(
+            f"  posts: {t['posts']}  "
+            f"frames tx/rx: {t['frames_tx']}/{t['frames_rx']}  "
+            f"bytes tx/rx: {t['bytes_tx'] / 1e6:.2f}/"
+            f"{t['bytes_rx'] / 1e6:.2f} MB"
+        )
+        parts.append(
+            f"  reconnects: {t['reconnects']}  resends: {t['resends']}  "
+            f"crc drops: {t['crc_drops']}  "
+            f"dedup drops: {t['dedup_drops']}"
+        )
+        if t["link_down"] or t["degraded_edges"]:
+            parts.append(
+                f"  DEGRADED edges: {t['degraded_edges']} "
+                f"(link_down events: {t['link_down']}, "
+                f"posts routed via store fallback: {t['degraded_posts']})"
+            )
+        rows = [
+            (
+                ev.get("rank"),
+                ev.get("kind", "?"),
+                ev.get("peer", ""),
+                ev.get("why") or "",
+                (
+                    f"flushed={ev.get('flushed')}"
+                    if ev.get("flushed") is not None
+                    else f"replay={ev.get('replay')}"
+                    if ev.get("replay") is not None
+                    else ""
+                ),
+            )
+            for ev in t["events"]
+        ]
+        if rows:
+            parts.append(
+                _fmt_table(rows, ("rank", "event", "peer", "why", "detail"))
+            )
+        for k, v in sorted(t.get("counters", {}).items()):
             parts.append(f"  {k}: {v:g}")
     if summary.get("codec"):
         c = summary["codec"]

@@ -8,7 +8,7 @@ atomically-replaced status snapshots (``health-status-rank<N>.json``),
 health event streams (``health-rank<N>.jsonl``) and flight-recorder
 dumps — and renders one row per rank:
 
-    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  lag  async$  straggler  gen  ws  last fault
+    rank  steps/s  allreduce p50/p99 (ms)  wire ratio  edges  overlap  sched$  plan$  pred  atune$  roofl  lag  async$  link  straggler  gen  ws  last fault
 
 * **steps/s** — delta of the ``cgx.step.count`` counter between two
   refreshes (the first frame shows ``-``); bridge-only ranks (no JAX
@@ -45,6 +45,11 @@ dumps — and renders one row per rank:
 * **async$** — share of outer rounds where every peer's delta arrived
   on time (``cgx.async.rounds_on_time / cgx.async.rounds``): the
   decoupled exchange's health number, same reading as sched$/plan$.
+* **link** — socket-transport link state (``cgx.transport.*``): ``ok``
+  while every peer link is connected, ``ok+rN`` after N
+  reconnect-and-replay recoveries (the fabric is flaky but the
+  supervisor is winning), ``degN`` once N links degraded to the store
+  fallback, ``-`` when the plane is off (``CGX_TRANSPORT`` unset).
 * **straggler** — the health engine's worst per-peer skew score as
   ``score→peer`` (needs CGX_HEALTH on the ranks).
 * **gen** — the recovery generation gauge (``cgx.recovery.generation``).
@@ -346,6 +351,28 @@ def _async_rate(m: Dict[str, float]) -> str:
     return f"{m.get('cgx.async.rounds_on_time', 0.0) / total * 100:.0f}%"
 
 
+def _link(m: Dict[str, float]) -> str:
+    """Socket-transport link state (``cgx.transport.*``, ISSUE 20):
+    ``-`` until the socket plane has moved a frame; ``ok`` while every
+    peer link is connected (``+rN`` after N reconnect-and-replay
+    recoveries — the supervisor is working, but the fabric is flaky);
+    ``degN`` once N peer links have degraded to the store path (the
+    ``degraded_edges`` gauge, falling back to ``link_down`` when only
+    counters exported)."""
+    if not (
+        m.get("cgx.transport.frames_tx")
+        or m.get("cgx.transport.frames_rx")
+        or m.get("cgx.transport.posts")
+    ):
+        return "-"
+    deg = int(m.get("cgx.transport.degraded_edges", 0.0))
+    downs = int(m.get("cgx.transport.link_down", 0.0))
+    if deg or downs:
+        return f"deg{deg or downs}"
+    rec = int(m.get("cgx.transport.reconnects", 0.0))
+    return f"ok+r{rec}" if rec else "ok"
+
+
 def _serve_tps(m: Dict[str, float]) -> str:
     """Serving throughput (``cgx.serve.tokens_per_s`` gauge — EWMA over
     decode steps); ``-`` until the serving plane has generated."""
@@ -412,7 +439,7 @@ def render(directory: str, state: dict) -> str:
     ]
     headers = ("rank", "steps/s", "ar_p50ms", "ar_p99ms", "wire",
                "edges", "overlap", "sched$", "plan$", "pred", "crit",
-               "atune$", "roofl", "lag", "async$", "tok/s", "ttft",
+               "atune$", "roofl", "lag", "async$", "link", "tok/s", "ttft",
                "mem", "frag", "straggler", "gen", "ws", "last_fault")
     rows: List[Tuple[str, ...]] = []
     events: List[str] = []
@@ -437,6 +464,7 @@ def render(directory: str, state: dict) -> str:
             _roofline(m),
             _async_lag(m),
             _async_rate(m),
+            _link(m),
             _serve_tps(m),
             _serve_ttft(m),
             _mem_mb(m),

@@ -131,6 +131,12 @@ session() {
   # process groups — never touches the device transport; resumable like
   # every other step (its marker skips it on re-runs).
   run_cpu 900 "async dcn plane" env JAX_PLATFORMS=cpu python bench.py --async-dcn --mb 8 --ws 4
+  # Socket transport vs store fallback (ISSUE 20): bridge children are
+  # CPU-pinned process groups over the supervised TCP plane vs the
+  # legacy store path — crc bit-equality pre-flight, small-message
+  # latency contrast, and the LinkThrottle slow-link row. Never touches
+  # the device transport; resumable like every other step.
+  run_cpu 900 "socket transport vs store" env JAX_PLATFORMS=cpu python bench.py --transport --mb 4 --ws 2
   # Serving plane (ISSUE 15): quantized-vs-raw-f16 KV shipping under a
   # bandwidth-modeled prefill→decode wire — tokens/s + TTFT trajectories.
   # Both children are CPU-pinned single-process runs; never touches the

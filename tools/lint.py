@@ -44,6 +44,7 @@ from tools.analysis import perfile  # noqa: E402
 BUILTINS = perfile.BUILTINS
 Checker = perfile.Checker
 check_unbounded_waits = perfile.check_unbounded_waits
+check_transport_bounded_io = perfile.check_transport_bounded_io
 check_exception_hygiene = perfile.check_exception_hygiene
 check_library_hygiene = perfile.check_library_hygiene
 check_worker_timeline_coverage = perfile.check_worker_timeline_coverage

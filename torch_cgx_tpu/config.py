@@ -140,6 +140,14 @@ MEMLEDGER = "CGX_MEMLEDGER"  # master enable for the per-rank memory ledger
 MEM_FLUSH_S = "CGX_MEM_FLUSH_S"  # ledger sample/flush interval (seconds)
 MEM_LEAK_WINDOW = "CGX_MEM_LEAK_WINDOW"  # sliding-window samples for leak/OOM calls
 PROM_PORT = "CGX_PROM_PORT"  # Prometheus text exposition endpoint
+# Supervised socket data plane (torch_backend/transport.py — PR 20):
+TRANSPORT = "CGX_TRANSPORT"  # "" | auto | socket | store | shm
+TRANSPORT_RETRIES = "CGX_TRANSPORT_RETRIES"  # reconnects before degrade
+TRANSPORT_BACKOFF_MS = "CGX_TRANSPORT_BACKOFF_MS"  # reconnect backoff base
+TRANSPORT_IO_TIMEOUT_MS = "CGX_TRANSPORT_IO_TIMEOUT_MS"  # per-op socket bound
+TRANSPORT_PING_MS = "CGX_TRANSPORT_PING_MS"  # idle-link ping cadence
+TRANSPORT_RING = "CGX_TRANSPORT_RING"  # un-acked resend ring capacity
+TRANSPORT_HOST = "CGX_TRANSPORT_HOST"  # advertised listener address
 
 # Defaults — reference values (common.h:24-41, compressor.h:32,
 # mpi_allreduce_operations.h:32).
@@ -884,6 +892,74 @@ def recovery_corrupt_threshold() -> int:
     reading)."""
     v = _env.get_int_env_or_default(RECOVERY_CORRUPT_THRESHOLD, 2)
     return v if v > 0 else 2
+
+
+_VALID_TRANSPORTS = ("", "auto", "socket", "store", "shm")
+
+
+def transport_mode() -> str:
+    """CGX_TRANSPORT: which data plane carries cross-rank payload bytes.
+    Unset/"" (default) = the legacy store+shm paths, byte-identical to
+    every prior release. ``socket`` = the supervised TCP plane of
+    ``torch_backend/transport.py`` for every remote hop; ``auto`` =
+    socket only when the group actually spans hosts (same-host groups
+    keep shm); ``store``/``shm`` = pin the legacy planes explicitly
+    (documentation aliases of the default routing). Host-side routing
+    only — no staged program or wire *payload* byte depends on it."""
+    v = _env.get_str_env_or_default(TRANSPORT, "").strip().lower()
+    if v not in _VALID_TRANSPORTS:
+        raise ValueError(
+            f"{TRANSPORT} must be one of {_VALID_TRANSPORTS[1:]}, got {v!r}"
+        )
+    return v
+
+
+def transport_retries() -> int:
+    """CGX_TRANSPORT_RETRIES: failed reconnect attempts (backoff +
+    jitter, ``retry.WaitRetry``) before the supervisor degrades a peer
+    edge from the socket plane back to the store plane mid-run."""
+    v = _env.get_int_env_or_default(TRANSPORT_RETRIES, 3)
+    return max(v, 0)
+
+
+def transport_backoff_ms() -> float:
+    """CGX_TRANSPORT_BACKOFF_MS: base of the reconnect ladder's
+    exponential backoff (doubled per attempt, up-to-50% jitter)."""
+    v = _env.get_float_env_or_default(TRANSPORT_BACKOFF_MS, 50.0)
+    return v if v > 0 else 50.0
+
+
+def transport_io_timeout_ms() -> float:
+    """CGX_TRANSPORT_IO_TIMEOUT_MS: deadline for every socket operation
+    on the transport plane — connect, recv slice, send. No call on the
+    plane ever blocks past it (the analyzer's bounded-io rule enforces
+    the discipline statically)."""
+    v = _env.get_float_env_or_default(TRANSPORT_IO_TIMEOUT_MS, 2000.0)
+    return v if v > 0 else 2000.0
+
+
+def transport_ping_ms() -> float:
+    """CGX_TRANSPORT_PING_MS: idle-link health-check cadence of the
+    ``ConnectionSupervisor`` (a PING frame per quiet interval keeps
+    dead-peer detection ahead of the bridge timeout)."""
+    v = _env.get_float_env_or_default(TRANSPORT_PING_MS, 500.0)
+    return v if v > 0 else 500.0
+
+
+def transport_ring() -> int:
+    """CGX_TRANSPORT_RING: capacity (frames) of the per-peer un-acked
+    resend ring. A full ring bounds the sender: posts wait for acks and
+    eventually degrade the edge rather than growing without bound."""
+    v = _env.get_int_env_or_default(TRANSPORT_RING, 256)
+    return v if v > 0 else 256
+
+
+def transport_host() -> str:
+    """CGX_TRANSPORT_HOST: the address each rank advertises for its
+    transport listener (default 127.0.0.1 — single-host; a fleet sets
+    the NIC address)."""
+    v = _env.get_str_env_or_default(TRANSPORT_HOST, "").strip()
+    return v or "127.0.0.1"
 
 
 def snapshot_every() -> int:

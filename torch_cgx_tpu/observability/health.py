@@ -86,6 +86,11 @@ PLAN_DRIFT = "plan_drift"
 # window — before the hard wall, not at it.
 MEM_LEAK = "mem_leak"
 MEM_PRESSURE = "mem_pressure"
+# Transport plane (PR 20): a peer edge of the supervised socket plane
+# exhausted its reconnect ladder (CGX_TRANSPORT_RETRIES) and degraded to
+# the store path — the link, not the peer, is the suspect's failing
+# component, but the peer rank is still the actionable name.
+LINK_DOWN = "link_down"
 
 # The closed kind registry (lint's health-event-kinds rule cross-checks
 # every HealthEvent construction site against this tuple; the
@@ -93,6 +98,7 @@ MEM_PRESSURE = "mem_pressure"
 EVENT_KINDS = (
     STRAGGLER, STEP_REGRESSION, QERR_SLO, ARENA_PRESSURE, ASYNC_LAG,
     PREEMPT_NOTICE, MEMBERSHIP, PLAN_DRIFT, MEM_LEAK, MEM_PRESSURE,
+    LINK_DOWN,
 )
 
 # Wait-signal floor: peer skew is judged relative to the median peer, but
@@ -336,6 +342,25 @@ class HealthEngine:
             kind=ASYNC_LAG, rank=self.rank, value=round(float(lag), 6),
             threshold=float(threshold), suspect=int(suspect),
             detail=(("lag_rounds", float(lag)),),
+            ts=round(time.time(), 6),
+            t_mono=round(time.perf_counter(), 6),
+        )
+        return ev if self._emit(ev) else None
+
+    def note_link_down(
+        self, suspect: int, failures: float, threshold: float, **detail
+    ) -> Optional[HealthEvent]:
+        """Transport-plane hook: the socket edge to peer ``suspect`` (a
+        GLOBAL rank, like every other event's attribution — scores must
+        survive reconfigurations) burned ``failures`` reconnect attempts against
+        a ladder of ``threshold`` and degraded to the store path. No
+        sustain window — the reconnect ladder already IS the sustain
+        (each rung a full connect timeout + backoff); the per-(kind,
+        suspect) cooldown keeps a flapping link to one event stream."""
+        ev = HealthEvent(
+            kind=LINK_DOWN, rank=self.rank, value=round(float(failures), 6),
+            threshold=float(threshold), suspect=int(suspect),
+            detail=tuple(detail.items()),
             ts=round(time.time(), 6),
             t_mono=round(time.perf_counter(), 6),
         )
@@ -928,6 +953,19 @@ def note_plan_drift(
     if eng is None:
         return None
     return eng.note_plan_drift(ratio, threshold, component, **detail)
+
+
+def note_link_down(
+    suspect: Optional[int], failures: float, threshold: float, **detail
+) -> Optional["HealthEvent"]:
+    """Transport-plane hook: report a peer edge degraded off the socket
+    plane (no-op when the engine is off or the peer is unknown — the
+    transport's own metrics/flight-recorder trail does not depend on the
+    event plane)."""
+    eng = _engine
+    if eng is None or suspect is None or suspect < 0:
+        return None
+    return eng.note_link_down(suspect, failures, threshold, **detail)
 
 
 def note_mem_event(

@@ -365,6 +365,16 @@ class _SnapshotDonor:
         deadline: float,
         injector=None,
     ):
+        # PR 20 (CGX_TRANSPORT=socket): snapshot pages ride the socket
+        # plane toward the joiner's receive endpoint (derived from the
+        # stream's join-g<N>-r<J> base — all of a joiner's donor streams
+        # share it). Re-request control keys stay on the plain store:
+        # their reader is per-donor, not the stream's peer set.
+        store = wire.maybe_socket_store(
+            store, endpoint=f"jtx/{stream}",
+            peers=(f"jrx/{stream.rsplit('-d', 1)[0]}",),
+            prefixes=(f"cgxkv/{stream}/",), exclude=("/rereq/",),
+        )
         self._store = store
         self._stream = stream
         self._wires = wires
@@ -471,6 +481,18 @@ class _SnapshotReceiver:
     frame's leaf descriptors; every wait is bounded by the deadline."""
 
     def __init__(self, store, streams: Sequence[str], deadline: float):
+        streams = list(streams)
+        if streams:
+            # Joiner endpoint (PR 20): one socket mailbox for every donor
+            # stream of this join; re-requests stay on the plain store
+            # (the donors poll them with bounded counter reads there).
+            store = wire.maybe_socket_store(
+                store,
+                endpoint=f"jrx/{streams[0].rsplit('-d', 1)[0]}",
+                peers=(),
+                prefixes=tuple(f"cgxkv/{s}/" for s in streams),
+                exclude=("/rereq/",),
+            )
         self._store = store
         self._streams = list(streams)
         self._deadline = deadline

@@ -49,6 +49,25 @@ mode                      effect at its injection site
                           memory ledger's sliding-window detector must
                           name (``mem_leak`` on ``serve.kv_pool``)
                           before the pool exhausts
+``conn_reset``            transport plane: the first qualifying send on
+                          the gated rank opens a window of ``delay``
+                          during which every socket write/connect fails
+                          with a reset — the reconnect + resend-ring
+                          replay rehearsal
+``partial_write``         transport plane: a frame is truncated mid-wire
+                          and the connection torn down (fires on the
+                          first send event unless ``step=``/prob gates
+                          say otherwise) — the receiver must discard the
+                          torn frame and the replay must complete
+``slow_link``             transport plane: sleep ``delay`` in the
+                          per-peer sender thread (``edge=tcp``, the
+                          default and only edge) — a slow NIC/route, not
+                          a slow rank
+``partition``             transport plane: sends AND reconnects between
+                          the two ranks of ``ranks=a,b`` fail for
+                          ``delay`` — the degrade-to-store rehearsal
+                          (both directions; each rank's injector opens
+                          its window on first traffic across the pair)
 ========================  =====================================================
 
 Spec tokens: a bare float is a per-event probability; ``NNms``/``NNs`` a
@@ -60,7 +79,11 @@ on ``kill_rank``/``slow_rank``/``preempt`` is shorthand for
 exchange sites ONLY — the two-level reduction's cross stage and the
 async plane's sender thread — modeling a slow DCN *edge* instead of a
 rank slow at every collective (the ``bench.py --async-dcn`` fault: the
-synchronous two-level path stalls on it, the async plane does not).
+synchronous two-level path stalls on it, the async plane does not);
+``edge=tcp`` is the transport plane's analogue for ``slow_link``;
+``ranks=a,b`` names the two endpoints of a ``partition`` (the embedded
+comma is recognized by the parser — a bare trailing integer after a
+``ranks=`` entry joins it instead of starting a new entry).
 
 Determinism: probabilistic gates draw from a per-rank stream seeded by
 ``CGX_FAULTS_SEED`` (default 0), so a failing chaos run replays exactly.
@@ -99,7 +122,15 @@ MODES = (
     "preempt",
     "corrupt_join_page",
     "leak_page",
+    "conn_reset",
+    "partial_write",
+    "slow_link",
+    "partition",
 )
+
+# Transport-plane modes whose window/fire sites live inside
+# torch_backend/transport.py (the SocketTransport injection surface).
+NET_MODES = ("conn_reset", "partial_write", "slow_link", "partition")
 
 PREEMPT_RESPAWN_ENV = "CGX_PREEMPT_RESPAWN"
 
@@ -115,22 +146,64 @@ class FaultSpec:
     step: Optional[int] = None
     rank: Optional[int] = None
     delay_ms: float = 0.0
-    edge: Optional[str] = None  # None = legacy sites; "dcn" = cross only
+    edge: Optional[str] = None  # None = legacy sites; "dcn"/"tcp" = edge only
+    ranks: Optional[Tuple[int, ...]] = None  # partition endpoints
 
     def __post_init__(self):
         if self.mode not in MODES:
             raise ValueError(
                 f"CGX_FAULTS: unknown mode {self.mode!r} (known: {MODES})"
             )
-        if self.edge is not None and self.edge != "dcn":
+        if self.mode == "slow_link" and self.edge is None:
+            # slow_link IS an edge fault; defaulting the edge keeps the
+            # legacy per-collective delay() site from ever firing it.
+            object.__setattr__(self, "edge", "tcp")
+        if self.edge is not None and self.edge not in ("dcn", "tcp"):
             raise ValueError(
-                f"CGX_FAULTS: edge= must be 'dcn', got {self.edge!r}"
+                f"CGX_FAULTS: edge= must be 'dcn' or 'tcp', got {self.edge!r}"
             )
-        if self.edge is not None and self.mode != "slow_rank":
+        if self.edge == "dcn" and self.mode != "slow_rank":
             raise ValueError(
-                f"CGX_FAULTS: edge= only applies to slow_rank, not "
+                f"CGX_FAULTS: edge=dcn only applies to slow_rank, not "
                 f"{self.mode!r}"
             )
+        if self.edge == "tcp" and self.mode != "slow_link":
+            raise ValueError(
+                f"CGX_FAULTS: edge=tcp only applies to slow_link, not "
+                f"{self.mode!r}"
+            )
+        if self.ranks is not None and self.mode != "partition":
+            raise ValueError(
+                f"CGX_FAULTS: ranks= only applies to partition, not "
+                f"{self.mode!r}"
+            )
+        if self.mode == "partition":
+            if self.ranks is None or len(self.ranks) != 2:
+                raise ValueError(
+                    "CGX_FAULTS: partition needs exactly two endpoints, "
+                    "e.g. 'partition:10s@ranks=0,1'"
+                )
+            if self.delay_ms <= 0:
+                raise ValueError(
+                    "CGX_FAULTS: partition needs a duration, e.g. "
+                    "'partition:10s@ranks=0,1'"
+                )
+        if self.mode in ("conn_reset", "slow_link") and self.delay_ms <= 0:
+            # The window/delay IS the fault — without one the injection
+            # sites never fire and the chaos run is vacuously green.
+            raise ValueError(
+                f"CGX_FAULTS: {self.mode} needs a duration, e.g. "
+                f"'{self.mode}:500ms'"
+            )
+        if (
+            self.mode == "partial_write"
+            and self.prob is None
+            and self.step is None
+        ):
+            # An ungated partial_write would truncate EVERY frame — the
+            # link could never make progress and the replay under test
+            # would never complete. Default to the first send event.
+            object.__setattr__(self, "step", 0)
         if self.prob is not None and not 0.0 < self.prob <= 1.0:
             raise ValueError(
                 f"CGX_FAULTS: {self.mode} probability must be in (0, 1], "
@@ -157,8 +230,21 @@ class FaultSpec:
 def parse_faults(raw: str) -> List[FaultSpec]:
     """Parse the ``CGX_FAULTS`` grammar; raises ValueError on junk (a typo
     silently injecting nothing would make a chaos run vacuously green)."""
+    # Pre-pass: ``ranks=a,b`` embeds the entry separator — a fragment
+    # that is purely digits re-joins a preceding fragment ending in a
+    # ranks= list instead of starting a (junk) entry of its own.
+    parts: List[str] = []
+    for frag in raw.split(","):
+        if (
+            parts
+            and frag.strip().isdigit()
+            and re.search(r"ranks=\d+(?:,\d+)*\s*$", parts[-1])
+        ):
+            parts[-1] += "," + frag
+        else:
+            parts.append(frag)
     specs: List[FaultSpec] = []
-    for entry in raw.split(","):
+    for entry in parts:
         entry = entry.strip()
         if not entry:
             continue
@@ -173,6 +259,15 @@ def parse_faults(raw: str) -> List[FaultSpec]:
                 )
             elif tok.startswith("step="):
                 kw["step"] = int(tok[len("step="):])
+            elif tok.startswith("ranks="):
+                try:
+                    kw["ranks"] = tuple(
+                        int(x) for x in tok[len("ranks="):].split(",")
+                    )
+                except ValueError:
+                    raise ValueError(
+                        f"CGX_FAULTS: cannot parse ranks= token {tok!r}"
+                    ) from None
             elif tok.startswith("rank="):
                 kw["rank"] = int(tok[len("rank="):])
             elif tok.startswith("edge="):
@@ -213,6 +308,7 @@ class FaultInjector:
         # rank B's, so multi-rank chaos runs replay rank-locally.
         self._rng = random.Random((seed << 8) ^ ((rank if rank else 0) + 1))
         self._counts: Dict[str, int] = defaultdict(int)
+        self._windows: Dict[str, float] = {}  # mode -> monotonic end time
         self._lock = threading.Lock()
 
     def spec(self, mode: str) -> Optional[FaultSpec]:
@@ -245,6 +341,49 @@ class FaultInjector:
             event=n, step=step if step is not None else n,
         )
         return True
+
+    def window(self, mode: str, peer: Optional[int] = None) -> bool:
+        """Network fault window (``conn_reset``/``partition``): the first
+        qualifying event opens a window of the spec's duration; True
+        while the window is open. ``conn_reset`` gates on ``rank=``;
+        ``partition`` gates on the unordered ``{self, peer}`` pair
+        matching ``ranks=a,b`` (each endpoint's injector opens its own
+        window on first traffic across the pair — roughly simultaneous,
+        exactly like a real cut)."""
+        s = self._specs.get(mode)
+        if s is None:
+            return False
+        if s.ranks is not None:
+            if self._rank is None or peer is None:
+                return False
+            if {self._rank, peer} != set(s.ranks):
+                return False
+        elif s.rank is not None and self._rank is not None:
+            if s.rank != self._rank:
+                return False
+        if s.delay_ms <= 0:
+            return False
+        now = time.monotonic()
+        opened = False
+        with self._lock:
+            end = self._windows.get(mode)
+            if end is None:
+                end = now + s.delay_ms / 1000.0
+                self._windows[mode] = end
+                opened = True
+        if opened:
+            metrics.add(f"cgx.faults.{mode}")
+            from ..observability import flightrec
+
+            flightrec.record(
+                "fault", mode=mode, rank=self._rank, peer=peer,
+                window_s=round(s.delay_ms / 1000.0, 3),
+            )
+            log.warning(
+                "CGX_FAULTS %s window open on rank %s (%.0fms)",
+                mode, self._rank, s.delay_ms,
+            )
+        return now < end
 
     def delay(self, mode: str = "delay_take") -> None:
         s = self._specs.get(mode)

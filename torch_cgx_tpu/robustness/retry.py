@@ -34,10 +34,23 @@ class WaitRetry:
     lockstep. Construct one per logical wait; every expired deadline
     calls :meth:`attempt` once."""
 
-    def __init__(self, op: str):
+    def __init__(
+        self,
+        op: str,
+        retries: int | None = None,
+        backoff_ms: float | None = None,
+    ):
+        # Explicit budgets let other ladders (the transport plane's
+        # reconnect rung rides CGX_TRANSPORT_RETRIES) reuse the one
+        # backoff/jitter/telemetry implementation without coupling their
+        # defaults to the recovery knobs.
         self._op = op
-        self.remaining = cfg.recovery_retries()
-        self._backoff_s = cfg.recovery_backoff_ms() / 1000.0
+        self.remaining = (
+            cfg.recovery_retries() if retries is None else max(retries, 0)
+        )
+        self._backoff_s = (
+            cfg.recovery_backoff_ms() if backoff_ms is None else backoff_ms
+        ) / 1000.0
 
     def attempt(self, key: str, suspects: Sequence[int] = ()) -> bool:
         """One expired bounded wait. True: a backoff was slept and the

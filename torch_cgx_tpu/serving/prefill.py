@@ -50,9 +50,17 @@ class PrefillWorker:
         *,
         shm=None,
         throttle_gbps: Optional[float] = None,
+        transport_endpoint: str = "kvtx",
+        transport_peers: Sequence[str] = ("kvrx",),
     ):
         self.server = server
-        self._store = store
+        # PR 20: with CGX_TRANSPORT=socket every KvPageSender this worker
+        # creates ships its frames over the socket plane toward
+        # ``transport_peers`` (the decode receiver's endpoint); unset
+        # keeps the store path byte-identical.
+        self._store = tp.maybe_socket_store(
+            store, endpoint=transport_endpoint, peers=transport_peers,
+        )
         self._shm = shm
         # One shared modeled link across every stream this worker ships
         # (the bench contrast's shape — a per-stream rate would let N

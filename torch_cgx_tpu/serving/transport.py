@@ -75,6 +75,32 @@ _SHIP_BACKOFF_S = 0.05
 DEFAULT_SHIP_DEPTH = 4
 
 
+def maybe_socket_store(
+    store, endpoint: str, peers=(), prefixes=("cgxkv/",), exclude=(),
+):
+    """Route this store's page-stream keys over the supervised socket
+    plane when ``CGX_TRANSPORT=socket`` (PR 20). Lazy + best-effort by
+    design: the torch_backend package (where the plane lives) is only
+    imported once the knob asks for it, and any failure falls back to
+    the plain store — serving must never lose a stream to a transport
+    bootstrap problem. With the knob unset this returns ``store``
+    unchanged (the byte-compatibility pin)."""
+    if cfg_mod.transport_mode() != "socket":
+        return store
+    try:
+        from ..torch_backend.transport import maybe_wrap_store
+
+        return maybe_wrap_store(
+            store, endpoint=endpoint, peers=tuple(peers),
+            prefixes=tuple(prefixes), exclude=tuple(exclude),
+        )
+    except Exception as e:
+        log.warning(
+            "kv transport: socket plane unavailable (%s); store path", e
+        )
+        return store
+
+
 class LinkThrottle:
     """Byte-proportional model of ONE shared bandwidth-bound link
     (bench.py --serve): every sender reserving through the same instance
@@ -369,8 +395,12 @@ class KvPageReceiver:
     probe the scheduler's failover rung consumes.
     """
 
-    def __init__(self, store, *, shm=None):
-        self._store = store
+    def __init__(self, store, *, shm=None, transport_endpoint: str = "kvrx"):
+        # PR 20: with CGX_TRANSPORT=socket the receiver registers a plane
+        # endpoint (default "kvrx" — the prefill side's default peer) so
+        # page frames land in its socket mailbox; unset leaves the store
+        # untouched.
+        self._store = maybe_socket_store(store, endpoint=transport_endpoint)
         self._shm = shm
         self._streams: Dict[str, _StreamState] = {}
         self._store_can_delete: Optional[bool] = None

@@ -895,6 +895,11 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # thread, created lazily by async_sender() and rebuilt per
         # generation.
         self._async_sender = None
+        # Socket data plane (PR 20, CGX_TRANSPORT) + cross-host liveness
+        # judge — both engage only when their gates say so; None keeps
+        # every legacy path byte-identical.
+        self._transport = None
+        self._remote_live = None
         if size > 1:
             try:
                 self._init_shm(peer_info)
@@ -903,6 +908,13 @@ class ProcessGroupCGX(dist.ProcessGroup):
                     "cgx shm rendezvous failed (%s); store transport only", e
                 )
                 self._shm = None
+            try:
+                self._init_transport()
+            except Exception as e:
+                log.warning(
+                    "cgx socket transport init failed (%s); store path", e
+                )
+                self._transport = None
         self._worker = threading.Thread(
             target=self._run_loop, name="cgx-worker", daemon=True
         )
@@ -955,6 +967,21 @@ class ProcessGroupCGX(dist.ProcessGroup):
         self._host_by_rank = hosts
         self._pid_by_rank = pids
         self._local_ranks = [j for j, h in enumerate(hosts) if h == fp]
+        if len(set(hosts)) > 1:
+            # Cross-host liveness (PR 20): the heartbeat file's mtime is
+            # invisible to remote peers, so the same daemon tick also
+            # bumps a per-pid store counter; RemoteLiveness convicts on
+            # counter ADVANCE against local monotonic time only — never
+            # by comparing wall clocks across hosts. Best-effort, like
+            # the file heartbeat below.
+            try:
+                hb_mod.attach_store(shm_mod.default_dir(), self._store)
+                self._remote_live = hb_mod.RemoteLiveness(self._store)
+            except Exception as e:
+                log.warning(
+                    "cgx store heartbeat setup failed (%s); timeouts "
+                    "will not name dead cross-host peers", e,
+                )
         if len(self._local_ranks) > 1:
             # Per-process liveness file (robustness/heartbeat.py): lets a
             # bounded wait NAME a SIGKILL'd same-host peer instead of only
@@ -1016,6 +1043,54 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 self._shm is not None
                 and len(self._local_ranks) == self._size
             )
+
+    def _init_transport(self) -> None:
+        """Socket data plane (PR 20): engage the supervised TCP transport
+        when ``CGX_TRANSPORT`` asks for it. ``socket`` forces it on;
+        ``auto`` engages only for groups that actually span hosts (a
+        same-host group already has the shm arena and a local store —
+        TCP buys nothing). Unset/""/``store``/``shm`` leave
+        ``self._transport`` None and every legacy path byte-identical.
+        Address keys are generation-namespaced, so a reconfigured group
+        re-exchanges endpoints under ``g<N>/`` automatically."""
+        mode = cfg.transport_mode()
+        if mode not in ("socket", "auto") or self._size < 2:
+            return
+        if mode == "auto" and len(set(self._host_by_rank)) < 2:
+            return
+        from . import transport as transport_mod
+
+        self._transport = transport_mod.SocketTransport(
+            self._store,
+            my_id=str(self._rank),
+            addr_key=lambda pid: self._ns(f"cgxtp/a{pid}"),
+            rank=self._rank,
+            on_link_down=self._on_link_down,
+        )
+
+    def _on_link_down(self, peer_id: str, peer_rank) -> None:
+        """Transport supervisor callback (runs on a transport thread): an
+        edge exhausted its reconnect ladder and degraded to the store.
+        Surface it as a PR 6 HealthEvent attributed by GLOBAL rank, like
+        every other health verdict."""
+        r = peer_rank
+        if r is None:
+            try:
+                r = int(peer_id)
+            except ValueError:
+                r = None
+        gpeer = (
+            self._global_ranks[r]
+            if r is not None and 0 <= r < len(self._global_ranks)
+            else None
+        )
+        health_mod.note_link_down(
+            gpeer,
+            failures=cfg.transport_retries(),
+            threshold=cfg.transport_retries(),
+            peer_id=peer_id,
+            generation=self._generation,
+        )
 
     # -- worker loop ------------------------------------------------------
 
@@ -1266,27 +1341,54 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 raise err
 
     def _suspect_dead_peers(self) -> List[int]:
-        """Same-host peers whose liveness heartbeat is missing/stale —
-        best-effort attribution for a timeout (cross-host peers have no
-        heartbeat file here and stay un-named)."""
-        if not self._pid_by_rank or len(self._local_ranks) < 2:
-            return []
+        """Best-effort attribution for a timeout, merged from every
+        liveness signal this rank has: same-host peers by heartbeat-file
+        mtime, cross-host peers by store-counter advance (PR 20 —
+        previously un-nameable), and peers whose socket-transport edge
+        already degraded."""
+        suspects: set = set()
         try:
             from . import shm as shm_mod
 
-            peers = [r for r in self._local_ranks if r != self._rank]
-            dead = set(
-                hb_mod.suspect_dead_pids(
-                    shm_mod.default_dir(),
-                    [self._pid_by_rank[r] for r in peers],
+            if self._pid_by_rank and len(self._local_ranks) >= 2:
+                peers = [r for r in self._local_ranks if r != self._rank]
+                dead = set(
+                    hb_mod.suspect_dead_pids(
+                        shm_mod.default_dir(),
+                        [self._pid_by_rank[r] for r in peers],
+                    )
                 )
-            )
-            suspects = [r for r in peers if self._pid_by_rank[r] in dead]
-            if suspects:
-                metrics.add("cgx.heartbeat_stale", float(len(suspects)))
-            return suspects
-        except Exception:
-            return []
+                local = [r for r in peers if self._pid_by_rank[r] in dead]
+                if local:
+                    metrics.add("cgx.heartbeat_stale", float(len(local)))
+                suspects.update(local)
+            if self._remote_live is not None and self._pid_by_rank:
+                remote = [
+                    r for r in range(self._size)
+                    if r != self._rank
+                    and r not in self._local_ranks
+                    and 0 <= r < len(self._pid_by_rank)
+                    and self._pid_by_rank[r] > 0
+                ]
+                dead_pids = set(
+                    self._remote_live.suspects(
+                        [self._pid_by_rank[r] for r in remote]
+                    )
+                )
+                suspects.update(
+                    r for r in remote if self._pid_by_rank[r] in dead_pids
+                )
+            if self._transport is not None:
+                for p in self._transport.down_peers():
+                    try:
+                        suspects.add(int(p))
+                    except ValueError:
+                        pass
+        except Exception as e:
+            # Attribution is best-effort garnish on a timeout that is
+            # raising anyway — but a broken judge is worth one line.
+            log.warning("cgx: dead-peer suspect scan failed: %s", e)
+        return sorted(suspects)
 
     def abort(self, reason: str = "") -> None:
         """Poison the group: peers blocked in any collective fail fast, and
@@ -1316,13 +1418,31 @@ class ProcessGroupCGX(dist.ProcessGroup):
         return self._all_local if local is None else local
 
     def _put(
-        self, key: str, data, readers: int = 1, local: Optional[bool] = None
+        self, key: str, data, readers: int = 1,
+        local: Optional[bool] = None,
+        to: Optional[Sequence[int]] = None,
     ) -> None:
         """Post ``data`` for ``readers`` consumers. Same-host readers get
-        the SHM byte plane (store carries only a header); otherwise the
-        bytes ride the store itself."""
+        the SHM byte plane (store carries only a header); with the socket
+        plane up the bytes ride framed TCP toward ``to`` (the GROUP-LOCAL
+        reader ranks — None means every other rank); otherwise the bytes
+        ride the store itself."""
         if self._route_shm(local):
             self._shm.put(key, data, readers=readers)
+            return
+        if self._transport is not None:
+            payload = bytes(data) if not isinstance(data, bytes) else data
+            dests = (
+                [j for j in range(self._size) if j != self._rank]
+                if to is None
+                else [j for j in to if j != self._rank]
+            )
+            t0 = time.perf_counter()
+            self._transport.post(key, payload, to=[str(j) for j in dests])
+            timeline.record(
+                "transport.post", timeline.CAT_WIRE, t0,
+                time.perf_counter() - t0, key=key, bytes=len(payload),
+            )
             return
         if self._injector is not None and self._injector.fire("drop_put"):
             return  # store-path drop: the matching take's wait expires
@@ -1393,6 +1513,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
     ) -> np.ndarray:
         if self._route_shm(local):
             return self._shm.take(key)
+        if self._transport is not None:
+            return self._take_socket(key)
         t0 = time.perf_counter()
         try:
             self._wait_key(key)
@@ -1420,6 +1542,74 @@ class ProcessGroupCGX(dist.ProcessGroup):
         elif int(self._store.add(key + "/ack", 1)) >= readers:
             self._delete_key(key + "/ack")
             self._delete_key(key)
+        return np.frombuffer(data, np.uint8)
+
+    def _take_socket(self, key: str) -> np.ndarray:
+        """Socket-plane take: a bounded dual-probe fetch (mailbox every
+        slice, store fallback — a degraded WRITER still delivers) with
+        the same abort/shutdown/retry/timeout semantics as ``_wait_key``.
+        No reader refcount: each target got its own framed copy, popped
+        on delivery. A degraded multi-reader post lands as one store key
+        that is never refcount-deleted — a bounded leak, at most the
+        collectives in flight during a degrade incident."""
+        from . import transport as transport_mod
+
+        last_poll = [0.0]
+
+        def _abort_probe() -> None:
+            if self._aborted.is_set():
+                self._raise_abort()
+            if self._shutdown.is_set():
+                raise RuntimeError("cgx: process group is shut down")
+            now = time.monotonic()
+            # The store-side poison poll keeps the _wait_key cadence
+            # (one check per ~200 ms), not the fetch slice's.
+            if now - last_poll[0] >= 0.2:
+                last_poll[0] = now
+                if self._check_store([self._abort_key]):
+                    self._raise_abort()
+
+        t0 = time.perf_counter()
+        retry: Optional[retry_mod.WaitRetry] = None
+        while True:
+            try:
+                data = self._transport.fetch(
+                    key, timeout_s=self._timeout_s,
+                    abort_check=_abort_probe,
+                )
+                break
+            except transport_mod.TransportTimeout:
+                suspects = self._suspect_dead_peers()
+                if retry is None:
+                    retry = retry_mod.WaitRetry("transport_fetch")
+                if retry.attempt(key, suspects):
+                    continue
+                extra = (
+                    f"; suspected dead peer rank(s): {suspects}"
+                    if suspects
+                    else ""
+                )
+                metrics.add("cgx.bridge_timeout")
+                err = BridgeTimeoutError(
+                    f"cgx: timed out after {self._timeout_s:.0f}s waiting "
+                    f"for {key!r} on the socket transport (peer dead or "
+                    f"stalled?){extra}",
+                    key=key,
+                    suspects=suspects,
+                )
+                flightrec.record_failure(
+                    err, key=key, suspects=list(suspects),
+                    rank=self._rank, timeout_s=self._timeout_s,
+                )
+                timeline.record(
+                    "transport.take", timeline.CAT_WAIT, t0,
+                    time.perf_counter() - t0, key=key, ok=False,
+                )
+                raise err
+        timeline.record(
+            "transport.take", timeline.CAT_WAIT, t0,
+            time.perf_counter() - t0, key=key, bytes=len(data),
+        )
         return np.frombuffer(data, np.uint8)
 
     # -- config -----------------------------------------------------------
@@ -1731,7 +1921,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
                             fused, segs_j, dummy, rng, wdt
                         )
                         enc_state["wire_out"] += len(frame)
-                        self._put(f"{pfx}/c{c}s{me}>{j}", frame, local=local)
+                        self._put(
+                            f"{pfx}/c{c}s{me}>{j}", frame, local=local,
+                            to=[_group[j]],
+                        )
                     dur = time.perf_counter() - t0
                     enc_state["busy_s"] += dur
                     # CAT_SPAN: this is compute running CONCURRENTLY with
@@ -1776,7 +1969,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 wire_out += len(wire)
                 t1 = time.perf_counter()
                 self._put(
-                    f"{pfx}/c{c}g{me}", wire, readers=ws - 1, local=local
+                    f"{pfx}/c{c}g{me}", wire, readers=ws - 1, local=local,
+                    to=[_group[x] for x in range(ws) if x != me],
                 )
                 for j in range(ws):
                     if j != me:
@@ -1851,7 +2045,9 @@ class ProcessGroupCGX(dist.ProcessGroup):
             if j != me:
                 frame = _compress_frames(fused, segs[j], dummy, rng, wdt)
                 wire_out += len(frame)
-                self._put(f"{pfx}/s{me}>{j}", frame, local=local)
+                self._put(
+                    f"{pfx}/s{me}>{j}", frame, local=local, to=[_group[j]]
+                )
         # Accumulate peers into our own chunk (TestRecv + decompress) —
         # the fold association pinned to the dispatcher's ordered_rowsum
         # (see _sra_fold_chunk: the staged<->bridge wire contract).
@@ -1871,7 +2067,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         t1 = time.perf_counter()
         wire = _requantize_frames(fused, segs[me], dummy, rng, wdt)
         wire_out += len(wire)
-        self._put(f"{pfx}/g{me}", wire, readers=ws - 1, local=local)
+        self._put(
+            f"{pfx}/g{me}", wire, readers=ws - 1, local=local,
+            to=[_group[x] for x in range(ws) if x != me],
+        )
         # Round 2: gather every reduced chunk (allgather).
         for j in range(ws):
             if j != me:
@@ -1904,7 +2103,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
             r_idx = (me - step - 1) % ws  # chunk we receive + reduce
             frame = _compress_frames(fused, segs[s_idx], dummy, rng, wdt)
             wire_out += len(frame)
-            self._put(f"{pfx}/r{step}>{right}", frame, local=local)
+            self._put(
+                f"{pfx}/r{step}>{right}", frame, local=local,
+                to=[_group[right]],
+            )
             buf = self._take(
                 f"{pfx}/r{step}>{me}", local=local,
                 peer=_group[(me - 1) % ws],
@@ -1918,7 +2120,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         for step in range(ws - 1):
             r_idx = (me - step) % ws  # chunk arriving this step
             wire_out += len(hold)
-            self._put(f"{pfx}/a{step}>{right}", hold, local=local)
+            self._put(
+                f"{pfx}/a{step}>{right}", hold, local=local,
+                to=[_group[right]],
+            )
             buf = self._take(
                 f"{pfx}/a{step}>{me}", local=local,
                 peer=_group[(me - 1) % ws],
@@ -1937,7 +2142,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         rng = self._stochastic_rng()
         segs = _segments_in(layers, 0, fused.shape[0])
         wire = _compress_frames(fused, segs, dummy, rng, wdt)
-        self._put(f"{pfx}/x{me}", wire, readers=ws - 1, local=local)
+        self._put(
+            f"{pfx}/x{me}", wire, readers=ws - 1, local=local,
+            to=[_group[x] for x in range(ws) if x != me],
+        )
         # Decode own wire too so every rank sums identical quantized terms.
         _decompress_frames(
             np.frombuffer(wire, np.uint8), segs, fused, dummy, add=False,
@@ -2026,7 +2234,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             self._put(
                 f"{pfx}/h1.{leader}.{li}",
                 _compress_frames(fused, segs, dummy or intra_raw, rng, wdt),
-                local=True,
+                local=True, to=[leader],
             )
             buf = self._take(
                 f"{pfx}/h3.{leader}", readers=len(locals_) - 1, local=True,
@@ -2075,7 +2283,10 @@ class ProcessGroupCGX(dist.ProcessGroup):
         # would break global symmetry.
         wire = _requantize_frames(fused, segs, dummy or intra_raw, rng3, wdt)
         if len(locals_) > 1:
-            self._put(f"{pfx}/h3.{leader}", wire, readers=len(locals_) - 1, local=True)
+            self._put(
+                f"{pfx}/h3.{leader}", wire, readers=len(locals_) - 1,
+                local=True, to=[r for r in locals_ if r != leader],
+            )
 
     def _sum_alltoall(self, arr: np.ndarray, np_dtype, pfx: str) -> None:
         """Uncompressed small-slice reduction: full exchange + local sum
@@ -2222,7 +2433,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                         with torch.no_grad():
                             outs[j].copy_(self._tensor_from(buf, outs[j]))
             else:
-                self._put(f"{key}/{self._rank}", self._bytes_of(inp))
+                self._put(f"{key}/{self._rank}", self._bytes_of(inp),
+                          to=[root])
 
         return self._submit(run, output_tensors, op="gather", seq=seq)
 
@@ -2241,7 +2453,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                         with torch.no_grad():
                             out.copy_(ins[j])
                     else:
-                        self._put(f"{key}/{j}", self._bytes_of(ins[j]))
+                        self._put(f"{key}/{j}", self._bytes_of(ins[j]),
+                                  to=[j])
             else:
                 buf = self._take(f"{key}/{self._rank}")
                 with torch.no_grad():
@@ -2283,7 +2496,8 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 with torch.no_grad():
                     t.detach().reshape(-1).copy_(red.to(t.dtype))
             else:
-                self._put(f"{key}/{self._rank}", self._bytes_of(t))
+                self._put(f"{key}/{self._rank}", self._bytes_of(t),
+                          to=[root])
 
         return self._submit(run, tensors, op="reduce", seq=seq)
 
@@ -2295,7 +2509,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
             for j in range(self._size):
                 if j != self._rank:
                     self._put(f"{key}/{self._rank}>{j}",
-                              self._bytes_of(input_tensors[j]))
+                              self._bytes_of(input_tensors[j]), to=[j])
             for j in range(self._size):
                 if j == self._rank:
                     with torch.no_grad():
@@ -2380,6 +2594,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                 self._put(
                     f"{key}/{me}>{j}",
                     self._bytes_of(piece) if in_lens[j] else b"",
+                    to=[j],
                 )
             with torch.no_grad():
                 flat_out[out_offs[me] : out_offs[me] + out_lens[me]].copy_(
@@ -2451,7 +2666,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
 
         def run():
             self._put(key, self._bytes_of(t),
-                      local=dst_rank in self._local_ranks)
+                      local=dst_rank in self._local_ranks, to=[dst_rank])
             # Announce for any-source matching: one ticket per send, written
             # under a dense per-(dst, tag) sequence so the receiver can
             # store.wait on the next ticket instead of polling mailboxes.
@@ -2642,6 +2857,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                         self._put(
                             f"{key}/{me}>{j}",
                             _compress_frames(chunk, seg, False, rng, wdt),
+                            to=[j],
                         )
                 own = np.ascontiguousarray(arr[me * n : (me + 1) * n])
                 for j in range(ws):
@@ -2659,6 +2875,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
                             np.ascontiguousarray(
                                 arr[j * n : (j + 1) * n]
                             ).astype(np_dtype, copy=False).tobytes(),
+                            to=[j],
                         )
                 own = np.ascontiguousarray(arr[me * n : (me + 1) * n])
                 for j in range(ws):
@@ -2757,7 +2974,7 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if snd is None or snd.generation != self._generation:
             if snd is not None:
                 snd.stop()
-            my_slice, n_slices, _leaders, _lg, gen = self.async_slice_info()
+            my_slice, n_slices, leaders, _lg, gen = self.async_slice_info()
             # One consumer per peer slice: only LEADERS poll the DCN
             # streams (non-leaders apply the leader's fold through the
             # intra broadcast — parallel/async_plane.py's two-level
@@ -2766,8 +2983,22 @@ class ProcessGroupCGX(dist.ProcessGroup):
             readers = {
                 s: max(1, n_slices - 1) for s in range(max(1, n_slices))
             }
+            store = self._store
+            if self._transport is not None:
+                # PR 20: the outer-exchange stream rides the socket plane
+                # toward the peer slice LEADERS — same keys, same
+                # publish-after-write counters, framed payload hops. The
+                # wrapper routes only the payload-prefix keys; counters
+                # (add) and everything else stay on the store.
+                from . import transport as transport_mod
+
+                store = transport_mod.TransportStore(
+                    self._store, self._transport,
+                    peers=[str(r) for r in leaders if r != self._rank],
+                    prefixes=(self._ns("cgxasync/"),),
+                )
             snd = async_bridge.AsyncBridgeSender(
-                self._store, my_slice, max(1, n_slices),
+                store, my_slice, max(1, n_slices),
                 ns=self._ns, injector=self._injector, generation=gen,
                 readers_by_slice=readers,
             )
@@ -2815,6 +3046,15 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if self._shm is not None:
             self._shm.close()
             self._shm = None
+        if self._transport is not None:
+            # Group-coordinated like the shm close above: every rank runs
+            # this rung, so no writer keeps the socket plane while a
+            # reader dropped to store-only waits.
+            try:
+                self._transport.close()
+            except Exception as e:
+                log.warning("cgx: socket plane close failed: %s", e)
+            self._transport = None
         self._all_local = False
         metrics.add("cgx.recovery.transport_degraded")
         flightrec.record(
@@ -2971,6 +3211,32 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if self._async_sender is not None:
             self._async_sender.stop()
             self._async_sender = None
+        # The socket plane's links/seqs/address book all describe the
+        # dead generation's membership: tear it down and rebuild — the
+        # ns'd address keys re-exchange endpoints under g<N>/.
+        if self._transport is not None:
+            try:
+                self._transport.close()
+            except Exception as e:
+                log.warning("cgx transport close on reconfigure: %s", e)
+            self._transport = None
+        try:
+            self._init_transport()
+        except Exception as e:
+            log.warning(
+                "cgx socket transport rebuild failed (%s); store path", e
+            )
+            self._transport = None
+        if self._remote_live is None and len(set(self._host_by_rank)) > 1:
+            # A grow just made the group span hosts: arm the cross-host
+            # liveness judge exactly as boot would have.
+            try:
+                from . import shm as shm_mod
+
+                hb_mod.attach_store(shm_mod.default_dir(), self._store)
+                self._remote_live = hb_mod.RemoteLiveness(self._store)
+            except Exception as e:
+                log.warning("cgx store heartbeat setup failed (%s)", e)
         if self._shm is not None:
             if len(self._local_ranks) > 1:
                 self._shm.bump_epoch(generation)
@@ -3038,6 +3304,12 @@ class ProcessGroupCGX(dist.ProcessGroup):
         if self._async_sender is not None:
             self._async_sender.stop()
             self._async_sender = None
+        if self._transport is not None:
+            try:
+                self._transport.close()
+            except Exception as e:
+                log.warning("cgx transport close on shutdown: %s", e)
+            self._transport = None
         # Observability flush: black-box dump + final metrics export + the
         # leader-side cross-rank merge over the store. Gated on
         # CGX_METRICS_DIR and leashed like the announce GC below — the
